@@ -1,0 +1,50 @@
+"""Headline benchmark — prints ONE JSON line for the driver.
+
+Metric (BASELINE.md north star): Mcell-updates/s/chip on a 4096x4096 grid,
+1000 steps, single chip. ``vs_baseline`` is the ratio against the
+reference's best published per-chip figure: its CUDA kernel at 2560x2048,
+~669 Mcells/s (Report.pdf p.26 Table 10, derived in BASELINE.md).
+
+Timing follows the reference protocol (SURVEY.md §5.1): compile excluded
+(warmup call), fenced with block_until_ready — the cudaEvent pair analogue.
+"""
+
+import json
+import os
+import sys
+
+# Smaller/faster run for smoke-testing: BENCH_QUICK=1.
+QUICK = os.environ.get("BENCH_QUICK") == "1"
+
+NX = NY = 1024 if QUICK else 4096
+STEPS = 100 if QUICK else 1000
+BASELINE_MCELLS = 669.0  # reference CUDA, 2560x2048 (BASELINE.md Table 10)
+
+
+def main() -> int:
+    from heat2d_tpu.config import HeatConfig
+    from heat2d_tpu.models.solver import Heat2DSolver
+
+    mode = os.environ.get("BENCH_MODE", "pallas")
+    cfg = HeatConfig(nxprob=NX, nyprob=NY, steps=STEPS, mode=mode)
+    solver = Heat2DSolver(cfg)
+    result = solver.run(timed=True)
+
+    # sanity: physics must be non-vacuous (unlike the reference CUDA run —
+    # SURVEY.md A.1): interior evolved, boundary clamped at zero.
+    u = result.u
+    assert float(u[1:-1, 1:-1].max()) > 0.0, "interior wiped — vacuous run"
+    assert float(abs(u[0]).max()) == 0.0, "boundary not clamped"
+
+    value = result.mcells_per_s
+    print(json.dumps({
+        "metric": f"Mcells/s/chip {NX}x{NY}x{STEPS} ({mode})",
+        "value": round(value, 1),
+        "unit": "Mcells/s",
+        "vs_baseline": round(value / BASELINE_MCELLS, 2),
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

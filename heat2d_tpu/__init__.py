@@ -1,0 +1,22 @@
+"""heat2d-tpu: a TPU-native 2D heat-equation stencil framework.
+
+JAX/XLA/Pallas/shard_map re-design of the capabilities of patschris/Heat2D
+(see SURVEY.md for the blueprint and BASELINE.md for the numbers to beat).
+"""
+
+__version__ = "0.1.0"
+
+__all__ = ["HeatConfig", "ConfigError", "Heat2DSolver", "RunResult",
+           "__version__"]
+
+
+def __getattr__(name):
+    # Lazy re-exports: keep `import heat2d_tpu` (and the CLI's --help path)
+    # free of jax import cost.
+    if name in ("HeatConfig", "ConfigError"):
+        import heat2d_tpu.config as _c
+        return getattr(_c, name)
+    if name in ("Heat2DSolver", "RunResult"):
+        from heat2d_tpu.models import solver as _s
+        return getattr(_s, name)
+    raise AttributeError(f"module 'heat2d_tpu' has no attribute {name!r}")

@@ -1,0 +1,206 @@
+"""Command-line driver — the reference's four ``main()``s as one CLI.
+
+The reference's programs take no arguments; every knob is a compile-time
+``#define`` and the run recipes live in readme.md:9-19 (mpicc/mpiexec/nvcc
+lines). Here the same knobs are flags with the same names and defaults, and
+the three run modes are subcommand-free ``--mode`` choices:
+
+    heat2d-tpu --mode serial                       # 1-task reference run
+    heat2d-tpu --mode pallas --nxprob 640 --nyprob 1024 --steps 10000
+    heat2d-tpu --mode dist2d --gridx 2 --gridy 2   # mpiexec -n 4 analogue
+    heat2d-tpu --mode dist1d --numworkers 4
+
+Outputs mirror the reference: ``initial.dat``/``final.dat`` text dumps
+(rowmajor layout by default, ``--dat-layout baseline`` for the
+mpi_heat2Dn.c orientation — SURVEY.md A.6), optional binary dumps, startup
+banner and ``Elapsed time: %e sec`` line (grad1612_mpi_heat.c:66-69, 287),
+plus a structured JSON run record the reference lacked (SURVEY.md §5.5).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+from heat2d_tpu.config import ConfigError, HeatConfig
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="heat2d-tpu",
+        description="TPU-native 2D heat-equation solver "
+                    "(capabilities of patschris/Heat2D)")
+    p.add_argument("--mode", default="serial",
+                   choices=["serial", "pallas", "dist1d", "dist2d", "hybrid"])
+    g = p.add_argument_group("problem (reference #define names)")
+    g.add_argument("--nxprob", type=int, default=10)
+    g.add_argument("--nyprob", type=int, default=10)
+    g.add_argument("--steps", type=int, default=100)
+    g.add_argument("--cx", type=float, default=0.1)
+    g.add_argument("--cy", type=float, default=0.1)
+    d = p.add_argument_group("decomposition")
+    d.add_argument("--gridx", type=int, default=1)
+    d.add_argument("--gridy", type=int, default=1)
+    d.add_argument("--numworkers", type=int, default=None,
+                   help="dist1d row-strip count (defaults to --gridx)")
+    d.add_argument("--strict-baseline", action="store_true",
+                   help="enforce mpi_heat2Dn.c's 3..8 worker range")
+    c = p.add_argument_group("convergence")
+    c.add_argument("--convergence", action="store_true")
+    c.add_argument("--interval", type=int, default=20)
+    c.add_argument("--sensitivity", type=float, default=0.1)
+    o = p.add_argument_group("output")
+    o.add_argument("--outdir", default=".")
+    o.add_argument("--dat-layout", default="rowmajor",
+                   choices=["rowmajor", "baseline", "none"],
+                   help="text dump layout; 'baseline' matches "
+                        "mpi_heat2Dn.c prtdat orientation")
+    o.add_argument("--binary-dumps", action="store_true",
+                   help="also write initial_binary.dat/final_binary.dat "
+                        "(MPI-IO byte format)")
+    o.add_argument("--checkpoint", default=None,
+                   help="path to write a loadable checkpoint of the final "
+                        "state")
+    o.add_argument("--resume", default=None,
+                   help="checkpoint to resume from (remaining steps run)")
+    o.add_argument("--run-record", default=None,
+                   help="path for the JSON run record")
+    p.add_argument("--accum-dtype", default="float32",
+                   choices=["float32", "float64"],
+                   help="float64 mirrors the C reference's double promotion")
+    p.add_argument("--debug", action="store_true")
+    p.add_argument("--device-info", action="store_true",
+                   help="print device summary (detailsGPU analogue) and exit")
+    p.add_argument("--platform", default=None, choices=["cpu", "tpu"],
+                   help="force a JAX platform (cpu enables the virtual "
+                        "host-device mesh for distributed modes without "
+                        "TPU hardware)")
+    p.add_argument("--host-device-count", type=int, default=None,
+                   help="with --platform cpu: number of virtual host "
+                        "devices (XLA_FLAGS --xla_force_host_platform_"
+                        "device_count)")
+    return p
+
+
+def _apply_platform(args) -> None:
+    """Must run before any jax backend use. The image's sitecustomize may
+    force-register a TPU backend, so the env var alone is not enough — the
+    live config update is what wins."""
+    if args.host_device_count:
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + f" --xla_force_host_platform_device_count="
+                f"{args.host_device_count}").strip()
+    if args.platform:
+        os.environ["JAX_PLATFORMS"] = args.platform
+        import jax
+        jax.config.update("jax_platforms", args.platform)
+    if args.accum_dtype == "float64":
+        import jax
+        jax.config.update("jax_enable_x64", True)
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    _apply_platform(args)
+
+    if args.device_info:
+        from heat2d_tpu.utils.device import print_device_summary
+        print_device_summary()
+        return 0
+
+    try:
+        cfg = HeatConfig(
+            nxprob=args.nxprob, nyprob=args.nyprob, steps=args.steps,
+            cx=args.cx, cy=args.cy, gridx=args.gridx, gridy=args.gridy,
+            convergence=args.convergence, interval=args.interval,
+            sensitivity=args.sensitivity, mode=args.mode,
+            accum_dtype=args.accum_dtype, numworkers=args.numworkers,
+            strict_baseline=args.strict_baseline, debug=args.debug)
+    except ConfigError as e:
+        print(f"{e}\nQuitting...", file=sys.stderr)
+        return 1
+
+    # Imports deferred so --help/--device-info don't pay jax startup.
+    import numpy as np
+    from heat2d_tpu.io import (save_checkpoint, load_checkpoint,
+                               write_binary, write_grid_baseline,
+                               write_grid_rowmajor)
+    from heat2d_tpu.models.solver import Heat2DSolver
+
+    # Startup banner (grad1612_mpi_heat.c:66-69).
+    print(f"Starting with {cfg.n_shards} shards")
+    print(f"Problem size:{cfg.nxprob}x{cfg.nyprob}")
+    if cfg.mode in ("dist2d", "hybrid"):
+        print(f"Each shard will take: {cfg.xcell}x{cfg.ycell}")
+    print(f"Amount of iterations: {cfg.steps}")
+    if cfg.convergence:
+        print(f"Check for convergence every {cfg.interval} iterations")
+
+    try:
+        solver = Heat2DSolver(cfg)
+    except (ConfigError, ValueError) as e:
+        print(f"{e}\nQuitting...", file=sys.stderr)
+        return 1
+
+    start_step = 0
+    if args.resume:
+        grid, start_step, ck_cfg = load_checkpoint(args.resume,
+                                                   shape=cfg.shape)
+        if tuple(grid.shape) != cfg.shape:
+            print(f"ERROR: checkpoint grid is {grid.shape[0]}x"
+                  f"{grid.shape[1]} but config is {cfg.nxprob}x"
+                  f"{cfg.nyprob}\nQuitting...", file=sys.stderr)
+            return 1
+        remaining = max(cfg.steps - start_step, 0)
+        solver = Heat2DSolver(cfg.replace(steps=remaining))
+        u0 = solver.place(grid)
+    else:
+        u0 = solver.init_state()
+
+    def write_dat(u, name):
+        if args.dat_layout == "none":
+            return
+        path = os.path.join(args.outdir, name)
+        if args.dat_layout == "baseline":
+            write_grid_baseline(u, path)
+        else:
+            write_grid_rowmajor(u, path)
+        print(f"Writing {name} ...")
+
+    os.makedirs(args.outdir, exist_ok=True)
+    u0_host = np.asarray(u0)
+    write_dat(u0_host, "initial.dat")
+    if args.binary_dumps:
+        write_binary(u0_host, os.path.join(args.outdir, "initial_binary.dat"))
+
+    try:
+        result = solver.run(u0=u0)
+    except ConfigError as e:
+        print(f"{e}\nQuitting...", file=sys.stderr)
+        return 1
+
+    total_steps = start_step + result.steps_done
+    print(f"Exiting after {result.steps_done} iterations")
+    print(f"Elapsed time: {result.elapsed:e} sec")
+    write_dat(result.u, "final.dat")
+    if args.binary_dumps:
+        write_binary(result.u, os.path.join(args.outdir, "final_binary.dat"))
+    if args.checkpoint:
+        save_checkpoint(result.u, total_steps, cfg, args.checkpoint)
+
+    record = result.to_record()
+    record["total_steps_including_resume"] = total_steps
+    if args.run_record:
+        with open(args.run_record, "w") as f:
+            json.dump(record, f, indent=2)
+    if cfg.debug:
+        print(json.dumps(record, indent=2))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,152 @@
+"""Configuration surface for heat2d-tpu.
+
+Mirrors the reference's compile-time ``#define`` knob census
+(mpi_heat2Dn.c:29-44, grad1612_mpi_heat.c:5-21, grad1612_hybrid_heat.c:6-24,
+grad1612_cuda_heat.cu:6-13 — see SURVEY.md §5.6) as one runtime dataclass:
+every knob keeps the reference's name and default, but changing it no longer
+means recompiling a C program.
+
+Validation reproduces the reference's startup checks:
+- worker-count range 3..8 for the baseline master/worker mode
+  (mpi_heat2Dn.c:72-78),
+- GRIDX*GRIDY == device count and divisibility NXPROB%GRIDX == 0,
+  NYPROB%GRIDY == 0 for the 2D SPMD mode (grad1612_mpi_heat.c:54-64),
+raised as ``ConfigError`` instead of ``MPI_Abort`` (with an *initialized*
+error code, unlike mpi_heat2Dn.c:76 — SURVEY.md A.5).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+
+class ConfigError(ValueError):
+    """Invalid solver configuration (the framework's MPI_Abort analogue)."""
+
+
+#: Execution modes — one engine, pluggable modes, replacing the reference's
+#: four copy-pasted programs (SURVEY.md §7.1):
+#:   serial  — pure jnp golden model, single device  (serial 1/1 runs)
+#:   pallas  — Pallas/Mosaic TPU kernel, single chip  (grad1612_cuda_heat.cu)
+#:   dist1d  — 1D row-strip sharding, N/S halo        (mpi_heat2Dn.c)
+#:   dist2d  — 2D block sharding, 4-neighbor halo     (grad1612_mpi_heat.c)
+#:   hybrid  — 2D block sharding with the Pallas kernel per shard
+#:             (grad1612_hybrid_heat.c: MPI across chips + intra-chip tiling)
+MODES = ("serial", "pallas", "dist1d", "dist2d", "hybrid")
+
+
+@dataclasses.dataclass(frozen=True)
+class HeatConfig:
+    # -- shared knobs (grad1612_mpi_heat.c:5-21) ----------------------------
+    nxprob: int = 10          # NXPROB — x dimension of problem grid
+    nyprob: int = 10          # NYPROB — y dimension of problem grid
+    steps: int = 100          # STEPS  — number of time steps
+    cx: float = 0.1           # CX     — x diffusivity coefficient
+    cy: float = 0.1           # CY     — y diffusivity coefficient
+    debug: bool = False       # DEBUG  — extra messages
+
+    # -- decomposition (grad1612_mpi_heat.c:10-12) --------------------------
+    gridx: int = 1            # GRIDX — process-grid extent along x (rows)
+    gridy: int = 1            # GRIDY — process-grid extent along y (cols)
+    reorganisation: bool = True  # REORGANISATION — let the runtime reorder
+    # ranks (MPI_Cart_create reorder flag). For the TPU mesh this is purely
+    # informational: device order is chosen by jax.make_mesh for ICI locality.
+
+    # -- convergence (grad1612_mpi_heat.c:14-16) ----------------------------
+    convergence: bool = False  # CONVERGENCE — early-exit on residual
+    interval: int = 20         # INTERVAL — steps between residual checks
+    sensitivity: float = 0.1   # SENSITIVITY — residual threshold (EPSILON)
+
+    # -- execution ----------------------------------------------------------
+    mode: str = "serial"
+    # f64 accumulation mirrors the C reference's promotion of the f32 stencil
+    # through double (literals 0.1/2.0 — SURVEY.md Appendix B); f32 is the
+    # TPU-fast path. Storage is always float32, as in the reference.
+    accum_dtype: str = "float32"   # "float32" | "float64"
+
+    # -- baseline-mode knobs (mpi_heat2Dn.c:32-33) --------------------------
+    # Number of row-strip shards for dist1d. The reference requires 3..8
+    # workers; we validate the same range only when `strict_baseline` is on.
+    numworkers: Optional[int] = None
+    strict_baseline: bool = False
+
+    # ------------------------------------------------------------------ #
+
+    def __post_init__(self):
+        if self.mode not in MODES:
+            raise ConfigError(f"mode must be one of {MODES}, got {self.mode!r}")
+        if self.nxprob < 3 or self.nyprob < 3:
+            raise ConfigError(
+                f"grid must be at least 3x3 to have interior cells, got "
+                f"{self.nxprob}x{self.nyprob}")
+        if self.steps < 0:
+            raise ConfigError(f"steps must be >= 0, got {self.steps}")
+        if self.accum_dtype not in ("float32", "float64"):
+            raise ConfigError(
+                f"accum_dtype must be float32 or float64, got {self.accum_dtype!r}")
+        if self.gridx < 1 or self.gridy < 1:
+            raise ConfigError("gridx/gridy must be >= 1")
+        if self.mode in ("dist2d", "hybrid"):
+            # grad1612_mpi_heat.c:60-64 divisibility validation
+            if self.nxprob % self.gridx or self.nyprob % self.gridy:
+                raise ConfigError(
+                    f"ERROR: ({self.nxprob}/{self.gridx}) or "
+                    f"({self.nyprob}/{self.gridy}) is not an integer")
+        if self.mode == "dist1d":
+            nw = self.numworkers or self.gridx
+            if self.strict_baseline and not (3 <= nw <= 8):
+                # mpi_heat2Dn.c:72-78 (MINWORKER=3, MAXWORKER=8)
+                raise ConfigError(
+                    "ERROR: the number of tasks must be between 4 and 9.")
+            if self.nxprob % nw:
+                # The reference handles uneven strips (averow/extra,
+                # mpi_heat2Dn.c:89-94); the sharded engine requires equal
+                # shards for now, so reject up front.
+                raise ConfigError(
+                    f"dist1d requires numworkers to divide nxprob "
+                    f"({nw} does not divide {self.nxprob})")
+        if self.convergence and self.interval < 1:
+            raise ConfigError("interval must be >= 1 when convergence is on")
+
+    # Convenience views ------------------------------------------------- #
+
+    @property
+    def shape(self) -> tuple[int, int]:
+        return (self.nxprob, self.nyprob)
+
+    @property
+    def xcell(self) -> int:
+        """Per-shard rows in the 2D decomposition (grad1612_mpi_heat.c:47)."""
+        return self.nxprob // self.gridx
+
+    @property
+    def ycell(self) -> int:
+        """Per-shard cols in the 2D decomposition (grad1612_mpi_heat.c:48)."""
+        return self.nyprob // self.gridy
+
+    @property
+    def n_shards(self) -> int:
+        if self.mode == "dist1d":
+            return self.numworkers or self.gridx
+        return self.gridx * self.gridy
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "HeatConfig":
+        fields = {f.name for f in dataclasses.fields(cls)}
+        return cls(**{k: v for k, v in d.items() if k in fields})
+
+    def replace(self, **kw) -> "HeatConfig":
+        return dataclasses.replace(self, **kw)
+
+
+# Reference per-program defaults, for parity runs and tests.
+
+#: mpi_heat2Dn.c:29-31 — 10x10 grid, 100 steps.
+BASELINE_DEFAULTS = dict(nxprob=10, nyprob=10, steps=100)
+
+#: grad1612_cuda_heat.cu:6-8 — 640x1024 grid, 10000 steps.
+CUDA_DEFAULTS = dict(nxprob=640, nyprob=1024, steps=10000)

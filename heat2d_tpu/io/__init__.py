@@ -1,0 +1,25 @@
+from heat2d_tpu.io.writers import (
+    format_grid_baseline,
+    format_grid_rowmajor,
+    write_grid_baseline,
+    write_grid_rowmajor,
+    read_grid_text,
+)
+from heat2d_tpu.io.binary import (
+    write_binary,
+    read_binary,
+    save_checkpoint,
+    load_checkpoint,
+)
+
+__all__ = [
+    "format_grid_baseline",
+    "format_grid_rowmajor",
+    "write_grid_baseline",
+    "write_grid_rowmajor",
+    "read_grid_text",
+    "write_binary",
+    "read_binary",
+    "save_checkpoint",
+    "load_checkpoint",
+]

@@ -1,0 +1,62 @@
+"""Binary state dumps and checkpoint/resume.
+
+Format parity: the reference's MPI-IO collective writes
+(grad1612_mpi_heat.c:178-190, 283-285) produce the full global grid as raw
+native-endian float32 in global row-major order — a checkpoint format
+without a loader (SURVEY.md §5.4). We keep the byte format identical
+(``read_binary`` can load the reference's ``*_binary.dat`` files) and add
+the missing loader plus a JSON sidecar (step counter + config) so the dump
+doubles as a restart point.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+import numpy as np
+
+
+def write_binary(u, path) -> None:
+    """Raw f32 row-major dump — byte-identical to the MPI-IO file layout."""
+    a = np.asarray(u, dtype=np.float32)
+    a.tofile(path)
+
+
+def read_binary(path, shape) -> np.ndarray:
+    a = np.fromfile(path, dtype=np.float32)
+    expected = int(np.prod(shape))
+    if a.size != expected:
+        raise ValueError(
+            f"{path}: expected {expected} float32 values for shape {shape}, "
+            f"found {a.size}")
+    return a.reshape(shape)
+
+
+def save_checkpoint(u, step: int, config, path) -> None:
+    """State dump + sidecar. ``path`` is the binary file; sidecar is
+    ``path + '.meta.json'``."""
+    write_binary(u, path)
+    meta = {
+        "step": int(step),
+        "shape": [int(s) for s in np.asarray(u).shape],
+        "dtype": "float32",
+        "config": config.to_dict() if hasattr(config, "to_dict") else dict(config or {}),
+        "format": "heat2d-tpu-checkpoint-v1",
+    }
+    with open(str(path) + ".meta.json", "w") as f:
+        json.dump(meta, f, indent=2)
+
+
+def load_checkpoint(path, shape=None):
+    """Returns (grid, step, config_dict). If no sidecar exists (e.g. a raw
+    reference ``final_binary.dat``), ``shape`` is required and step=0."""
+    meta_path = str(path) + ".meta.json"
+    if os.path.exists(meta_path):
+        with open(meta_path) as f:
+            meta = json.load(f)
+        grid = read_binary(path, tuple(meta["shape"]))
+        return grid, int(meta["step"]), meta.get("config", {})
+    if shape is None:
+        raise ValueError(f"no sidecar at {meta_path}; pass shape= explicitly")
+    return read_binary(path, shape), 0, {}

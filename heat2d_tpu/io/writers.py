@@ -1,0 +1,96 @@
+"""Text grid writers — byte-compatible with the reference's ``.dat`` files.
+
+The reference has two *different* text layouts for the same physics
+(SURVEY.md A.6), and parity requires both:
+
+- **baseline** (mpi_heat2Dn.c:253-268, ``prtdat``): lines iterate the y
+  index *descending*, each line sweeps x ascending; values ``%6.1f``,
+  single space *between* values, newline at line end (no trailing space).
+  This is a transposed/flipped view of the grid.
+- **rowmajor** (grad1612_mpi_heat.c:191-203, 286-298): global row-major
+  i-then-j order; every value formatted ``"%6.1f "`` (trailing space on
+  every value, including the last), newline per row.
+
+Formatting parity: C's ``%6.1f`` of a float promoted to double and Python's
+``format(float(v), '6.1f')`` produce identical bytes (both do
+correctly-rounded decimal conversion of the same binary64 value, including
+``  -0.0``). A native C++ formatter (heat2d_tpu/native) accelerates large
+grids; this module transparently uses it when built.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def _as_host_f32(u) -> np.ndarray:
+    a = np.asarray(u, dtype=np.float32)
+    if a.ndim != 2:
+        raise ValueError(f"expected a 2D grid, got shape {a.shape}")
+    return a
+
+
+_NATIVE = None
+_NATIVE_PROBED = False
+
+
+def _native():
+    global _NATIVE, _NATIVE_PROBED
+    if not _NATIVE_PROBED:
+        _NATIVE_PROBED = True
+        try:
+            from heat2d_tpu.native import lib as native_lib
+            _NATIVE = native_lib.load()
+        except Exception:
+            _NATIVE = None
+    return _NATIVE
+
+
+def format_grid_baseline(u) -> str:
+    """mpi_heat2Dn.c prtdat byte format (y-descending lines, x across)."""
+    a = _as_host_f32(u)
+    nat = _native()
+    if nat is not None:
+        return nat.format_baseline(a)
+    nx, ny = a.shape
+    lines = []
+    for iy in range(ny - 1, -1, -1):
+        lines.append(" ".join(format(float(a[ix, iy]), "6.1f")
+                              for ix in range(nx)))
+    return "\n".join(lines) + "\n"
+
+
+def format_grid_rowmajor(u) -> str:
+    """grad1612 writer byte format (row-major, trailing space per value)."""
+    a = _as_host_f32(u)
+    nat = _native()
+    if nat is not None:
+        return nat.format_rowmajor(a)
+    rows = []
+    for i in range(a.shape[0]):
+        rows.append("".join(format(float(v), "6.1f") + " " for v in a[i]))
+    return "\n".join(rows) + "\n"
+
+
+def write_grid_baseline(u, path) -> None:
+    with open(path, "w") as f:
+        f.write(format_grid_baseline(u))
+
+
+def write_grid_rowmajor(u, path) -> None:
+    with open(path, "w") as f:
+        f.write(format_grid_rowmajor(u))
+
+
+def read_grid_text(path, layout: str = "rowmajor") -> np.ndarray:
+    """Parse either .dat layout back into a row-major (nx, ny) float32 grid."""
+    with open(path) as f:
+        rows = [[float(tok) for tok in line.split()]
+                for line in f if line.strip()]
+    a = np.asarray(rows, dtype=np.float32)
+    if layout == "rowmajor":
+        return a
+    if layout == "baseline":
+        # File lines are iy = ny-1..0, columns ix = 0..nx-1.
+        return a[::-1].T.copy()
+    raise ValueError(f"unknown layout {layout!r}")

@@ -1,0 +1,3 @@
+from heat2d_tpu.models.solver import Heat2DSolver, RunResult
+
+__all__ = ["Heat2DSolver", "RunResult"]

@@ -1,0 +1,166 @@
+"""Heat2DSolver — one engine, pluggable execution modes (SURVEY.md §7.1).
+
+The reference ships four standalone programs; this facade reproduces each as
+a mode of a single solver:
+
+====================  ====================================================
+mode                  reference counterpart
+====================  ====================================================
+serial                1-task runs of the MPI programs (Report.pdf 1/1 col)
+pallas                grad1612_cuda_heat.cu single-accelerator kernel
+dist1d                mpi_heat2Dn.c row-strip decomposition
+dist2d                grad1612_mpi_heat.c 2D block decomposition
+hybrid                grad1612_hybrid_heat.c (multi-chip mesh × per-chip
+                      tiled kernel; the OpenMP tier maps to intra-chip
+                      parallelism, which the compiler owns)
+====================  ====================================================
+
+Unlike the reference's CUDA program (SURVEY.md A.1: first step reads a
+zeroed source plane and the result never leaves the device), every mode
+here steps from the real initial condition and returns the final grid.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from heat2d_tpu.config import ConfigError, HeatConfig
+from heat2d_tpu.models import engine
+from heat2d_tpu.ops.init import inidat
+from heat2d_tpu.ops.stencil import residual_sq, stencil_step
+from heat2d_tpu.parallel.mesh import make_mesh
+from heat2d_tpu.parallel.sharded import make_sharded_runner, sharded_inidat
+from heat2d_tpu.utils.timing import timed_call
+
+
+@dataclasses.dataclass
+class RunResult:
+    u: np.ndarray           # final global grid, host-side, row-major
+    steps_done: int
+    elapsed: float          # seconds, reference timing protocol
+    config: HeatConfig
+
+    @property
+    def mcells_per_s(self) -> float:
+        """Cell-updates/s in millions — BASELINE.md's derived metric
+        (cells × iterations / time)."""
+        if self.elapsed <= 0 or self.steps_done == 0:
+            return float("nan")
+        nx, ny = self.config.shape
+        return nx * ny * self.steps_done / self.elapsed / 1e6
+
+    def to_record(self) -> dict:
+        """Structured run record (SURVEY.md §5.5)."""
+        return {
+            "config": self.config.to_dict(),
+            "steps_done": int(self.steps_done),
+            "elapsed_s": float(self.elapsed),
+            "mcells_per_s": float(self.mcells_per_s),
+        }
+
+
+class Heat2DSolver:
+    def __init__(self, config: HeatConfig, devices=None):
+        self.config = config
+        if (config.accum_dtype == "float64"
+                and not jax.config.jax_enable_x64):
+            # Without x64, astype(float64) silently truncates to f32 and
+            # the C-double-promotion parity mode would be a no-op.
+            raise ConfigError(
+                "accum_dtype='float64' requires jax_enable_x64; call "
+                "jax.config.update('jax_enable_x64', True) first (the CLI "
+                "does this automatically)")
+        self.mesh = None
+        self._sharding = None
+        if config.mode == "dist1d":
+            nw = config.numworkers or config.gridx
+            self.mesh = make_mesh(nw, 1, devices=devices)
+        elif config.mode in ("dist2d", "hybrid"):
+            self.mesh = make_mesh(config.gridx, config.gridy, devices=devices)
+        self._runner = None
+
+    # ------------------------------------------------------------------ #
+
+    def init_state(self):
+        """Initial condition, placed where the run needs it (sharded for
+        distributed modes)."""
+        cfg = self.config
+        if self.mesh is not None:
+            return sharded_inidat(cfg, self.mesh)
+        return inidat(cfg.nxprob, cfg.nyprob)
+
+    def place(self, u):
+        """Device-put a host grid with this solver's sharding (the
+        device_put-with-NamedSharding analogue of the reference's work
+        distribution, mpi_heat2Dn.c:107-112)."""
+        if self.mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+            ax, ay = self.mesh.axis_names
+            return jax.device_put(u, NamedSharding(self.mesh, P(ax, ay)))
+        return jax.device_put(u)
+
+    def _kernel(self):
+        if self.config.mode in ("pallas", "hybrid"):
+            try:
+                from heat2d_tpu.ops.pallas_stencil import make_padded_kernel
+            except ImportError as e:
+                raise ConfigError(
+                    f"mode {self.config.mode!r} needs the Pallas kernel, "
+                    f"which failed to import: {e}") from e
+            return make_padded_kernel(self.config)
+        return None
+
+    def make_runner(self):
+        """Compiled ``u0 -> (u_final, steps_done)``."""
+        if self._runner is not None:
+            return self._runner
+        cfg = self.config
+        if self.mesh is not None:
+            self._runner, self._sharding = make_sharded_runner(
+                cfg, self.mesh, kernel=self._kernel())
+            return self._runner
+
+        accum = jnp.dtype(cfg.accum_dtype)
+        if cfg.mode == "pallas":
+            try:
+                from heat2d_tpu.ops.pallas_stencil import (
+                    make_single_chip_runner)
+            except ImportError as e:
+                raise ConfigError(
+                    f"mode 'pallas' needs the Pallas kernel, which failed "
+                    f"to import: {e}") from e
+            self._runner = make_single_chip_runner(cfg)
+            return self._runner
+
+        def step(u):
+            return stencil_step(u, cfg.cx, cfg.cy, accum)
+
+        def run(u):
+            if cfg.convergence:
+                return engine.run_convergence(
+                    step, lambda a, b: residual_sq(a, b, accum), u,
+                    cfg.steps, cfg.interval, cfg.sensitivity)
+            return engine.run_fixed(step, u, cfg.steps)
+
+        self._runner = jax.jit(run)
+        return self._runner
+
+    def run(self, u0=None, timed: bool = True) -> RunResult:
+        """Init (unless given), step, gather. Timing follows the reference
+        protocol: compile excluded (warmup), barrier-fenced, max over
+        processes (SURVEY.md §5.1)."""
+        if u0 is None:
+            u0 = self.init_state()
+        runner = self.make_runner()
+        if timed:
+            (u, k), elapsed = timed_call(runner, u0)
+        else:
+            u, k = jax.block_until_ready(runner(u0))
+            elapsed = float("nan")
+        return RunResult(u=np.asarray(u), steps_done=int(k),
+                         elapsed=elapsed, config=self.config)

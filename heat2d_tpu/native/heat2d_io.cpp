@@ -1,0 +1,57 @@
+// Native text-grid formatter for heat2d-tpu.
+//
+// The reference's I/O layer is native C stdio (prtdat, mpi_heat2Dn.c:253-268;
+// the readfloat binary->text conversion loop, grad1612_mpi_heat.c:191-203).
+// This library is its TPU-framework counterpart: the same printf("%6.1f")
+// byte format, vectorized over whole grids, callable from Python via ctypes
+// (heat2d_tpu/native/lib.py). Python's format(v, '6.1f') produces identical
+// bytes; this path exists because per-value Python formatting is the
+// bottleneck when dumping large grids (the reference dumps 2560x2048 .dat
+// files), and because the build mandate keeps the runtime's native layers
+// native.
+//
+// Build: make -C heat2d_tpu/native   (g++ -O2 -shared -fPIC)
+
+#include <cstdio>
+#include <cstring>
+
+extern "C" {
+
+// Row-major layout (grad1612 writers): every value "%6.1f " with trailing
+// space, newline per row. Returns bytes written, or -1 if cap too small.
+long heat2d_format_rowmajor(const float* u, long nx, long ny,
+                            char* out, long cap) {
+    long w = 0;
+    for (long i = 0; i < nx; ++i) {
+        for (long j = 0; j < ny; ++j) {
+            if (cap - w < 64) return -1;
+            int n = snprintf(out + w, cap - w, "%6.1f ",
+                             static_cast<double>(u[i * ny + j]));
+            if (n < 0) return -1;
+            w += n;
+        }
+        if (cap - w < 2) return -1;
+        out[w++] = '\n';
+    }
+    return w;
+}
+
+// Baseline layout (mpi_heat2Dn.c prtdat): lines iterate the y index
+// descending, x across; single space *between* values, none trailing.
+long heat2d_format_baseline(const float* u, long nx, long ny,
+                            char* out, long cap) {
+    long w = 0;
+    for (long iy = ny - 1; iy >= 0; --iy) {
+        for (long ix = 0; ix < nx; ++ix) {
+            if (cap - w < 64) return -1;
+            int n = snprintf(out + w, cap - w, "%6.1f",
+                             static_cast<double>(u[ix * ny + iy]));
+            if (n < 0) return -1;
+            w += n;
+            out[w++] = (ix != nx - 1) ? ' ' : '\n';
+        }
+    }
+    return w;
+}
+
+}  // extern "C"

@@ -1,0 +1,70 @@
+"""ctypes loader for the native text-grid formatter (heat2d_io.cpp).
+
+Builds the shared library on first use if a compiler is available (the
+environment has no pybind11; plain ctypes over an extern-C ABI keeps the
+binding dependency-free). Callers treat any failure here as "no native
+path" and fall back to pure Python — the two paths are byte-identical
+(tests/test_native.py proves it against the C formatter directly).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import shutil
+import subprocess
+
+import numpy as np
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SO = os.path.join(_DIR, "libheat2d_io.so")
+
+
+class _NativeIO:
+    def __init__(self, cdll: ctypes.CDLL):
+        self._lib = cdll
+        for name in ("heat2d_format_rowmajor", "heat2d_format_baseline"):
+            fn = getattr(cdll, name)
+            fn.restype = ctypes.c_long
+            fn.argtypes = [ctypes.POINTER(ctypes.c_float), ctypes.c_long,
+                           ctypes.c_long, ctypes.c_char_p, ctypes.c_long]
+
+    def _format(self, fn_name: str, a: np.ndarray) -> str:
+        a = np.ascontiguousarray(a, dtype=np.float32)
+        nx, ny = a.shape
+        cap = nx * ny * 24 + nx + 64
+        buf = ctypes.create_string_buffer(cap)
+        n = getattr(self._lib, fn_name)(
+            a.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+            nx, ny, buf, cap)
+        if n < 0:
+            raise RuntimeError(f"{fn_name}: buffer too small (cap={cap})")
+        return buf.raw[:n].decode("ascii")
+
+    def format_rowmajor(self, a) -> str:
+        return self._format("heat2d_format_rowmajor", a)
+
+    def format_baseline(self, a) -> str:
+        return self._format("heat2d_format_baseline", a)
+
+
+def _build() -> bool:
+    cxx = shutil.which("g++") or shutil.which("c++")
+    if cxx is None:
+        return False
+    try:
+        subprocess.run(
+            [cxx, "-O2", "-Wall", "-fPIC", "-shared",
+             os.path.join(_DIR, "heat2d_io.cpp"), "-o", _SO],
+            check=True, capture_output=True, timeout=120)
+        return True
+    except Exception:
+        return False
+
+
+def load() -> _NativeIO:
+    """Load (building if needed) the native formatter; raises on failure."""
+    if not os.path.exists(_SO) and not _build():
+        raise ImportError("native heat2d_io library unavailable "
+                          "(no compiler or build failed)")
+    return _NativeIO(ctypes.CDLL(_SO))

@@ -1,0 +1,14 @@
+from heat2d_tpu.ops.init import inidat, inidat_block
+from heat2d_tpu.ops.stencil import (
+    stencil_step,
+    stencil_step_padded,
+    residual_sq,
+)
+
+__all__ = [
+    "inidat",
+    "inidat_block",
+    "stencil_step",
+    "stencil_step_padded",
+    "residual_sq",
+]

@@ -1,0 +1,41 @@
+"""Initial-condition generator (the reference's ``inidat``).
+
+The reference computes ``u0[ix][iy] = ix*(nx-ix-1)*iy*(ny-iy-1)`` — zero on
+all edges, peaked in the middle — in three copy-pasted places
+(mpi_heat2Dn.c:242-248, grad1612_mpi_heat.c:163-168 in per-rank local
+coordinates, grad1612_cuda_heat.cu:48-53 as a CUDA kernel). Here it is one
+pure-jnp broadcast expression usable in either global or per-shard index
+space: a shard passes its global top-left offset, exactly replacing the
+reference's broadcast ``xs``/``ys`` offset tables (grad1612_mpi_heat.c:125-147)
+with locally computed ``lax.axis_index`` offsets.
+
+Numerics note: the C reference evaluates the product in ``int`` arithmetic,
+which overflows int32 for grids ≳600² (undefined behavior in C); we evaluate
+in float32 (exact for the small parity grids, well-defined everywhere).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax
+
+
+def inidat(nx: int, ny: int, dtype=jnp.float32) -> jnp.ndarray:
+    """Full-grid initial condition, identical to mpi_heat2Dn.c:242-248."""
+    return inidat_block((nx, ny), nx, ny, 0, 0, dtype)
+
+
+def inidat_block(block_shape: tuple[int, int], nx: int, ny: int,
+                 x_offset, y_offset, dtype=jnp.float32) -> jnp.ndarray:
+    """Initial condition for a local block at global offset (x_offset, y_offset).
+
+    Equivalent to grad1612_mpi_heat.c:163-168 with ``xs``/``ys`` the global
+    coordinates of the block's top-left cell. Offsets may be traced values
+    (e.g. derived from ``lax.axis_index`` inside ``shard_map``).
+    """
+    bm, bn = block_shape
+    ix = lax.broadcasted_iota(dtype, (bm, bn), 0) + jnp.asarray(x_offset, dtype)
+    iy = lax.broadcasted_iota(dtype, (bm, bn), 1) + jnp.asarray(y_offset, dtype)
+    nxf = jnp.asarray(nx, dtype)
+    nyf = jnp.asarray(ny, dtype)
+    return ix * (nxf - ix - 1) * iy * (nyf - iy - 1)

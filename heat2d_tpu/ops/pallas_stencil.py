@@ -1,0 +1,246 @@
+"""Pallas/Mosaic TPU stencil kernels — the grad1612_cuda_heat.cu analogue.
+
+The reference's CUDA path (grad1612_cuda_heat.cu:55-62 ``update`` kernel,
+:82-85 ping-pong launch loop) maps one GPU thread to one cell and enqueues
+two kernel launches per loop iteration from the host. The TPU-native design
+inverts that: the *loop* lives on the device and the kernel owns *tiles*,
+not cells:
+
+- ``multi_step_vmem`` — whole-grid-in-VMEM kernel that runs many time steps
+  per invocation (double buffering is a functional ``fori_loop`` carry in
+  vector memory, replacing the CUDA pointer swap). One launch ≈ thousands
+  of CUDA launches, zero HBM traffic between steps. Used when the grid fits
+  the VMEM budget — covers the reference's own CUDA configs (640×1024 =
+  2.5 MB).
+- ``band_step`` — streaming one-step kernel for HBM-resident grids: the
+  grid of programs walks row bands; each band reads its (bm, ny) block plus
+  two precomputed neighbor-row strips (the intra-chip halo — the VMEM-tile
+  analogue of the device-level ppermute halo), updates, and masks the
+  global boundary in-register. Host-side strip extraction touches ~2 rows
+  per band per step — negligible next to the band traffic itself.
+
+Unlike the reference kernel, which computes per-cell in *double* (CUDA
+promotes through the 2.0/0.1 literals — SURVEY.md Appendix B) and whose
+result is vacuous anyway (A.1), these kernels compute in float32 (TPU has
+no fast f64; parity tests run the golden model) and are verified against
+the jnp golden model in interpreter mode and on-device.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+
+try:
+    from jax.experimental.pallas import tpu as pltpu
+except ImportError:  # pragma: no cover
+    pltpu = None
+
+from heat2d_tpu.models import engine
+from heat2d_tpu.ops.stencil import residual_sq
+
+#: VMEM working-set budget for the resident kernel (carry + temporaries);
+#: v5e has ~16 MB/core — stay well under.
+VMEM_BUDGET_BYTES = 8 * 1024 * 1024
+
+
+def _interpret() -> bool:
+    """Interpreter mode off-TPU (tests on the virtual CPU mesh)."""
+    return jax.default_backend() != "tpu"
+
+
+def _step_value(u, cx, cy):
+    """One clamped-boundary time step on an array *value* (in-kernel).
+
+    Reassembles via concatenation rather than ``.at[].set`` — Mosaic has no
+    scatter lowering, and concatenation of static slices vectorizes cleanly.
+    """
+    c = u[1:-1, 1:-1]
+    new = (c
+           + cx * (u[2:, 1:-1] + u[:-2, 1:-1] - 2.0 * c)
+           + cy * (u[1:-1, 2:] + u[1:-1, :-2] - 2.0 * c))
+    mid = jnp.concatenate([u[1:-1, :1], new, u[1:-1, -1:]], axis=1)
+    return jnp.concatenate([u[:1, :], mid, u[-1:, :]], axis=0)
+
+
+# --------------------------------------------------------------------- #
+# Kernel A: VMEM-resident multi-step
+# --------------------------------------------------------------------- #
+
+def _vmem_kernel(u_ref, out_ref, *, steps, cx, cy):
+    u = u_ref[:]
+    u = lax.fori_loop(0, steps, lambda _, v: _step_value(v, cx, cy), u,
+                      unroll=False)
+    out_ref[:] = u
+
+
+def fits_vmem(shape, dtype=jnp.float32) -> bool:
+    nbytes = shape[0] * shape[1] * jnp.dtype(dtype).itemsize
+    return 3 * nbytes <= VMEM_BUDGET_BYTES
+
+
+def multi_step_vmem(u, steps: int, cx: float, cy: float):
+    """Run ``steps`` time steps in one kernel, grid resident in VMEM."""
+    kwargs = {}
+    if pltpu is not None and not _interpret():
+        kwargs = dict(
+            in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)],
+            out_specs=pl.BlockSpec(memory_space=pltpu.VMEM))
+    return pl.pallas_call(
+        functools.partial(_vmem_kernel, steps=steps, cx=cx, cy=cy),
+        out_shape=jax.ShapeDtypeStruct(u.shape, u.dtype),
+        interpret=_interpret(),
+        **kwargs)(u)
+
+
+# --------------------------------------------------------------------- #
+# Kernel B: streaming row-band one-step
+# --------------------------------------------------------------------- #
+
+def _band_kernel(up_ref, u_ref, dn_ref, out_ref, *, bm, nx, ny, cx, cy):
+    i = pl.program_id(0)
+    up = up_ref[:].reshape(1, ny)   # strips ride as (1, 1, ny) blocks
+    dn = dn_ref[:].reshape(1, ny)
+    ext = jnp.concatenate([up, u_ref[:], dn], axis=0)
+    c = ext[1:-1, :]                       # the band itself, (bm, ny)
+    north = ext[:-2, :]
+    south = ext[2:, :]
+    newc = (c[:, 1:-1]
+            + cx * (south[:, 1:-1] + north[:, 1:-1] - 2.0 * c[:, 1:-1])
+            + cy * (c[:, 2:] + c[:, :-2] - 2.0 * c[:, 1:-1]))
+    new = jnp.concatenate([c[:, :1], newc, c[:, -1:]], axis=1)
+    # Global first/last row are boundary: keep (CUDA guard ix>0 && ix<NX-1,
+    # grad1612_cuda_heat.cu:58).
+    gi = i * bm + lax.broadcasted_iota(jnp.int32, (bm, 1), 0)
+    keep = (gi == 0) | (gi == nx - 1)
+    out_ref[:] = jnp.where(keep, c, new)
+
+
+def pick_band_rows(nx: int, ny: int, dtype=jnp.float32,
+                   target_bytes: int = 2 * 1024 * 1024) -> int:
+    """Largest divisor of nx whose (bm, ny) band fits the target size."""
+    row_bytes = ny * jnp.dtype(dtype).itemsize
+    cap = max(1, target_bytes // row_bytes)
+    best = 1
+    for bm in range(1, nx + 1):
+        if nx % bm == 0 and bm <= cap:
+            best = bm
+    return best
+
+
+def band_step(u, cx: float, cy: float, bm: int | None = None):
+    """One time step of an HBM-resident grid via a row-band program grid."""
+    nx, ny = u.shape
+    if bm is None:
+        bm = pick_band_rows(nx, ny, u.dtype)
+    nblk = nx // bm
+    zero_row = jnp.zeros((1, ny), u.dtype)
+    # Neighbor-row strips: band i needs global rows i*bm-1 and (i+1)*bm.
+    # Strided-slice extraction; edge bands get a zero row (never read into
+    # the result — their first/last row is global boundary and kept).
+    # Shaped (nblk, 1, ny) so each block is (1, 1, ny): Mosaic requires the
+    # last two block dims to divide (8, 128) or equal the array dims.
+    ups = jnp.concatenate([zero_row, u[bm - 1::bm][:nblk - 1]],
+                          axis=0).reshape(nblk, 1, ny)
+    dns = jnp.concatenate([u[bm::bm], zero_row],
+                          axis=0).reshape(nblk, 1, ny)
+
+    kwargs = {}
+    mspace = {}
+    if pltpu is not None and not _interpret():
+        mspace = dict(memory_space=pltpu.VMEM)
+    grid_spec = pl.GridSpec(
+        grid=(nblk,),
+        in_specs=[
+            pl.BlockSpec((1, 1, ny), lambda i: (i, 0, 0), **mspace),
+            pl.BlockSpec((bm, ny), lambda i: (i, 0), **mspace),
+            pl.BlockSpec((1, 1, ny), lambda i: (i, 0, 0), **mspace),
+        ],
+        out_specs=pl.BlockSpec((bm, ny), lambda i: (i, 0), **mspace),
+    )
+    return pl.pallas_call(
+        functools.partial(_band_kernel, bm=bm, nx=nx, ny=ny, cx=cx, cy=cy),
+        out_shape=jax.ShapeDtypeStruct(u.shape, u.dtype),
+        grid_spec=grid_spec,
+        interpret=_interpret(),
+        **kwargs)(ups, u, dns)
+
+
+# --------------------------------------------------------------------- #
+# Engine integration
+# --------------------------------------------------------------------- #
+
+def make_single_chip_runner(config):
+    """Compiled ``u0 -> (u_final, steps_done)`` for mode='pallas'.
+
+    Fixed-step runs on a VMEM-sized grid execute as ONE kernel invocation;
+    convergence runs chunk INTERVAL steps per invocation so the residual
+    check (implemented correctly, unlike the reference — SURVEY.md A.2)
+    stays on-device between chunks. HBM-sized grids stream band-kernel
+    steps under lax.fori/while exactly like the golden engine.
+    """
+    cx, cy = config.cx, config.cy
+    nx, ny = config.nxprob, config.nyprob
+    resident = fits_vmem((nx, ny))
+
+    if resident:
+        def step(u):
+            return multi_step_vmem(u, 1, cx, cy)
+
+        def chunk(u, n):  # n is a static Python int: baked into the kernel
+            return multi_step_vmem(u, n, cx, cy)
+    else:
+        def step(u):
+            return band_step(u, cx, cy)
+
+    def run(u):
+        residual = lambda a, b: residual_sq(a, b)  # noqa: E731
+        if config.convergence and resident:
+            return engine.run_convergence_chunked(
+                chunk, step, residual, u,
+                config.steps, config.interval, config.sensitivity)
+        if config.convergence:
+            return engine.run_convergence(
+                step, residual, u,
+                config.steps, config.interval, config.sensitivity)
+        if resident:
+            # the whole fixed-step run is ONE kernel invocation
+            u = chunk(u, config.steps)
+            return u, jnp.asarray(config.steps, jnp.int32)
+        return engine.run_fixed(step, u, config.steps)
+
+    return jax.jit(run)
+
+
+def make_padded_kernel(config):
+    """Per-shard kernel for mode='hybrid': one step on a halo-padded
+    (bm+2, bn+2) block, returning the updated (bm, bn) interior — the
+    drop-in replacement for ops.stencil.stencil_step_padded inside the
+    shard_map step (caller masks the global boundary)."""
+    cx, cy = config.cx, config.cy
+
+    def kernel(p_ref, out_ref):
+        p = p_ref[:]
+        c = p[1:-1, 1:-1]
+        out_ref[:] = (c
+                      + cx * (p[2:, 1:-1] + p[:-2, 1:-1] - 2.0 * c)
+                      + cy * (p[1:-1, 2:] + p[1:-1, :-2] - 2.0 * c))
+
+    def padded_step(padded, cx_unused=None, cy_unused=None):
+        bm, bn = padded.shape[0] - 2, padded.shape[1] - 2
+        kwargs = {}
+        if pltpu is not None and not _interpret():
+            kwargs = dict(
+                in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)],
+                out_specs=pl.BlockSpec(memory_space=pltpu.VMEM))
+        return pl.pallas_call(
+            kernel,
+            out_shape=jax.ShapeDtypeStruct((bm, bn), padded.dtype),
+            interpret=_interpret(),
+            **kwargs)(padded)
+
+    return padded_step

@@ -1,0 +1,73 @@
+"""5-point Jacobi stencil — the numeric core (golden jnp model).
+
+The reference implements ``u' = u + cx*(uE + uW - 2u) + cy*(uN + uS - 2u)``
+four times over (mpi_heat2Dn.c:225-237, grad1612_mpi_heat.c:239-259,
+grad1612_hybrid_heat.c:256-281, grad1612_cuda_heat.cu:55-62 — SURVEY.md A.9).
+This module is the single source of truth for the math; the Pallas kernel
+(heat2d_tpu/ops/pallas_stencil.py) and the sharded engines are tested
+against it.
+
+Boundary semantics: edge cells are never updated (loop bounds in the
+reference, e.g. mpi_heat2Dn.c:228-229, guard grad1612_cuda_heat.cu:58) —
+they keep their initial value, which the initial condition makes 0 (the
+clamped/absorbing boundary of readme.md:3-5).
+
+Precision semantics (SURVEY.md Appendix B): storage is float32 everywhere in
+the reference, but C promotes each update through double because CX/CY/2.0
+are double literals. ``accum_dtype=float64`` reproduces that exactly
+(compute in f64, store f32); ``float32`` is the TPU-fast path.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def _laplacian_update(v, cx, cy):
+    """Stencil applied to the interior of a (halo-inclusive) array ``v``.
+
+    Returns updated values for v[1:-1, 1:-1] in v's dtype.
+    """
+    c = v[1:-1, 1:-1]
+    return (c
+            + cx * (v[2:, 1:-1] + v[:-2, 1:-1] - 2.0 * c)
+            + cy * (v[1:-1, 2:] + v[1:-1, :-2] - 2.0 * c))
+
+
+def stencil_step(u: jnp.ndarray, cx: float, cy: float,
+                 accum_dtype=jnp.float32) -> jnp.ndarray:
+    """One global time step. Interior updated, edges held (clamped BC)."""
+    v = u.astype(accum_dtype)
+    cxa = jnp.asarray(cx, accum_dtype)
+    cya = jnp.asarray(cy, accum_dtype)
+    new_interior = _laplacian_update(v, cxa, cya).astype(u.dtype)
+    return u.at[1:-1, 1:-1].set(new_interior)
+
+
+def stencil_step_padded(padded: jnp.ndarray, cx: float, cy: float,
+                        accum_dtype=jnp.float32) -> jnp.ndarray:
+    """One step on a halo-padded local block.
+
+    ``padded`` has shape (bm+2, bn+2): a (bm, bn) shard surrounded by a
+    1-cell ghost ring (the reference's block_x × block_y halo'd block,
+    grad1612_mpi_heat.c:50-52). Returns the updated (bm, bn) interior —
+    *every* interior cell updated; global-boundary masking is the caller's
+    job (the sharded engine knows the shard's mesh position, this op does
+    not).
+    """
+    v = padded.astype(accum_dtype)
+    cxa = jnp.asarray(cx, accum_dtype)
+    cya = jnp.asarray(cy, accum_dtype)
+    return _laplacian_update(v, cxa, cya).astype(padded.dtype)
+
+
+def residual_sq(u_new: jnp.ndarray, u_old: jnp.ndarray,
+                accum_dtype=jnp.float32) -> jnp.ndarray:
+    """Local convergence residual: sum of squared per-cell deltas.
+
+    The reference's locdiff (grad1612_mpi_heat.c:264-267), computed over the
+    shard interior and summed across ranks with MPI_Allreduce; the engine
+    psums this. Reference accumulates in float32; we follow accum_dtype.
+    """
+    d = u_new.astype(accum_dtype) - u_old.astype(accum_dtype)
+    return jnp.sum(d * d)

@@ -1,0 +1,24 @@
+from heat2d_tpu.parallel.mesh import make_mesh, mesh_devices_summary
+from heat2d_tpu.parallel.halo import (
+    shift_from_lower,
+    shift_from_upper,
+    exchange_halo_2d,
+    pad_with_halo,
+)
+from heat2d_tpu.parallel.sharded import (
+    make_local_step,
+    make_sharded_runner,
+    sharded_inidat,
+)
+
+__all__ = [
+    "make_mesh",
+    "mesh_devices_summary",
+    "shift_from_lower",
+    "shift_from_upper",
+    "exchange_halo_2d",
+    "pad_with_halo",
+    "make_local_step",
+    "make_sharded_runner",
+    "sharded_inidat",
+]

@@ -1,0 +1,78 @@
+"""Ghost-cell (halo) exchange via ``lax.ppermute`` — the MPI halo analogue.
+
+Replaces the reference's three halo mechanisms with one primitive:
+- blocking MPI_Send/MPI_Recv of edge rows (mpi_heat2Dn.c:179-192),
+- persistent non-blocking 4-neighbor requests (grad1612_mpi_heat.c:209-244),
+- MPI derived row/column datatypes (grad1612_mpi_heat.c:139-144 — strided
+  column views are unnecessary here; XLA materializes contiguous slices).
+
+Non-periodic boundaries: MPI_Cart_shift on a non-periodic grid yields
+MPI_PROC_NULL at the edges, so edge ranks' ghost cells keep their
+initialized value 0 (grad1612_mpi_heat.c:150-161). ``lax.ppermute`` with a
+*partial* permutation has exactly that semantics — devices not named as a
+destination receive zeros — so the ghost ring at the domain edge is 0 by
+construction, and the engine's global-boundary mask keeps those cells from
+ever being written anyway.
+
+"Persistence" (amortized request setup, MPI_Send_init) maps to jit: the
+exchange is traced once and compiled into the step program. Comm/compute
+overlap (grad1612_mpi_heat.c:233-259 inner/boundary split) is delegated to
+XLA's latency-hiding scheduler, which overlaps the ppermute DMA with the
+interior update automatically — documented here so nobody re-serializes it
+(SURVEY.md A.4).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax
+
+
+def shift_from_lower(x, axis_name: str, axis_size: int):
+    """Each device receives ``x`` from its lower-index neighbor along
+    ``axis_name`` (device 0 receives zeros). MPI analogue: the matched
+    send-to-south/recv-from-north pair."""
+    if axis_size == 1:
+        return jnp.zeros_like(x)
+    perm = [(i, i + 1) for i in range(axis_size - 1)]
+    return lax.ppermute(x, axis_name, perm)
+
+
+def shift_from_upper(x, axis_name: str, axis_size: int):
+    """Each device receives ``x`` from its higher-index neighbor along
+    ``axis_name`` (last device receives zeros)."""
+    if axis_size == 1:
+        return jnp.zeros_like(x)
+    perm = [(i + 1, i) for i in range(axis_size - 1)]
+    return lax.ppermute(x, axis_name, perm)
+
+
+def exchange_halo_2d(u, ax: str, ay: str, gx: int, gy: int):
+    """4-neighbor halo exchange for a (bm, bn) shard.
+
+    Returns (north, south, west, east) ghost strips: ``north`` is the
+    neighbor-above's bottom row (shape (1, bn)), ``west`` the left
+    neighbor's rightmost column (shape (bm, 1)), etc. Edge shards receive
+    zeros (PROC_NULL semantics). The 5-point stencil needs no corner
+    ghosts, matching the reference's 4-message protocol.
+    """
+    north = shift_from_lower(u[-1:, :], ax, gx)   # from row-neighbor above
+    south = shift_from_upper(u[:1, :], ax, gx)    # from row-neighbor below
+    west = shift_from_lower(u[:, -1:], ay, gy)    # from column-neighbor left
+    east = shift_from_upper(u[:, :1], ay, gy)     # from column-neighbor right
+    return north, south, west, east
+
+
+def pad_with_halo(u, north, south, west, east):
+    """Assemble the reference's (xcell+2)×(ycell+2) halo'd block
+    (grad1612_mpi_heat.c:50-52) functionally: shard interior surrounded by
+    the four ghost strips, zero corners (never read by a 5-point stencil).
+    """
+    bm, bn = u.shape
+    padded = jnp.zeros((bm + 2, bn + 2), u.dtype)
+    padded = padded.at[1:-1, 1:-1].set(u)
+    padded = padded.at[0:1, 1:-1].set(north)
+    padded = padded.at[-1:, 1:-1].set(south)
+    padded = padded.at[1:-1, 0:1].set(west)
+    padded = padded.at[1:-1, -1:].set(east)
+    return padded

@@ -1,0 +1,61 @@
+"""Device-mesh construction — the MPI Cartesian-topology analogue.
+
+The reference builds a GRIDY×GRIDX non-periodic Cartesian communicator with
+MPI_Cart_create and discovers N/S/E/W neighbor ranks with MPI_Cart_shift
+(grad1612_mpi_heat.c:73-81). On TPU the same role is played by a
+``jax.sharding.Mesh`` over ('x', 'y'): neighbors are implicit in the
+``lax.ppermute`` permutations (heat2d_tpu/parallel/halo.py), and the
+REORGANISATION reorder flag's job — placing neighboring ranks on
+well-connected hardware — is done by ``jax.make_mesh``'s ICI-aware device
+ordering.
+"""
+
+from __future__ import annotations
+
+import jax
+from jax.sharding import Mesh
+
+
+def make_mesh(gridx: int, gridy: int = 1, devices=None,
+              axis_names=("x", "y")) -> Mesh:
+    """A (gridx, gridy) mesh; axis 'x' shards grid rows, 'y' columns.
+
+    Validates device count the way grad1612_mpi_heat.c:54-59 validates
+    comm_sz == GRIDX*GRIDY.
+    """
+    if devices is None:
+        devices = jax.devices()
+    need = gridx * gridy
+    if len(devices) < need:
+        raise ValueError(
+            f"ERROR: the number of devices must be at least {need} "
+            f"(gridx={gridx} * gridy={gridy}); have {len(devices)}.")
+    try:
+        # ICI-topology-aware ordering when available.
+        return jax.make_mesh((gridx, gridy), axis_names,
+                             devices=devices[:need])
+    except TypeError:
+        import numpy as np
+        dev = np.asarray(devices[:need]).reshape(gridx, gridy)
+        return Mesh(dev, axis_names)
+
+
+def mesh_devices_summary(mesh: Mesh) -> dict:
+    """Device/topology introspection — the detailsGPU analogue
+    (grad1612_cuda_heat.cu:24-37), as structured data."""
+    devs = list(mesh.devices.flat)
+    d0 = devs[0]
+    info = {
+        "mesh_shape": dict(zip(mesh.axis_names, mesh.devices.shape)),
+        "n_devices": len(devs),
+        "device_kind": getattr(d0, "device_kind", "unknown"),
+        "platform": getattr(d0, "platform", "unknown"),
+    }
+    try:
+        stats = d0.memory_stats()
+        if stats:
+            info["bytes_limit"] = stats.get("bytes_limit")
+            info["bytes_in_use"] = stats.get("bytes_in_use")
+    except Exception:
+        pass
+    return info

@@ -1,0 +1,130 @@
+"""SPMD sharded solver path — shard_map + ppermute over a device mesh.
+
+This is the TPU-native replacement for the reference's two distributed
+programs (SURVEY.md §2.1 C7-C14):
+
+- dist1d — 1D row-strip decomposition, the mpi_heat2Dn.c scheme, as a
+  (numworkers, 1) mesh: only N/S halo traffic, no idle master (the
+  reference's master rank never computes; here every device computes —
+  the same fix the reference's own redesign made, Report.pdf p.16).
+- dist2d — 2D block decomposition, the grad1612_mpi_heat.c scheme, as a
+  (GRIDX, GRIDY) mesh with 4-neighbor ppermute halo exchange.
+
+Everything runs inside one ``shard_map``-ed, jit-compiled function: the
+whole time loop, the halo exchanges, and the convergence psum — the step
+program is compiled once (the persistent-request analogue) and the grid
+never leaves the devices until I/O.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+try:  # jax>=0.6 exposes shard_map at top level
+    shard_map = jax.shard_map
+except AttributeError:  # pragma: no cover
+    from jax.experimental.shard_map import shard_map
+
+from heat2d_tpu.models import engine
+from heat2d_tpu.ops.init import inidat_block
+from heat2d_tpu.ops.stencil import residual_sq, stencil_step_padded
+from heat2d_tpu.parallel.halo import exchange_halo_2d, pad_with_halo
+
+
+def _interior_mask(bm, bn, nx, ny, ax, ay):
+    """Boolean (bm, bn): True where this shard's cell is a *global* interior
+    cell (the only cells the reference ever updates — its loop bounds and
+    the CUDA guard grad1612_cuda_heat.cu:58)."""
+    row0 = lax.axis_index(ax) * bm
+    col0 = lax.axis_index(ay) * bn
+    gi = lax.broadcasted_iota(jnp.int32, (bm, bn), 0) + row0
+    gj = lax.broadcasted_iota(jnp.int32, (bm, bn), 1) + col0
+    return ((gi >= 1) & (gi <= nx - 2)) & ((gj >= 1) & (gj <= ny - 2))
+
+
+def make_local_step(config, mesh: Mesh, kernel=None):
+    """Shard-local step: halo exchange -> stencil -> global-boundary mask.
+
+    ``kernel``: optional (padded, cx, cy) -> (bm, bn) stencil implementation
+    (e.g. the Pallas kernel) replacing the jnp golden model.
+    """
+    ax, ay = mesh.axis_names
+    gx, gy = (mesh.devices.shape[0], mesh.devices.shape[1])
+    nx, ny = config.nxprob, config.nyprob
+    bm, bn = nx // gx, ny // gy
+    accum = jnp.dtype(config.accum_dtype)
+    cx, cy = config.cx, config.cy
+
+    def local_step(u):
+        halos = exchange_halo_2d(u, ax, ay, gx, gy)
+        padded = pad_with_halo(u, *halos)
+        if kernel is None:
+            new = stencil_step_padded(padded, cx, cy, accum)
+        else:
+            new = kernel(padded, cx, cy)
+        mask = _interior_mask(bm, bn, nx, ny, ax, ay)
+        return jnp.where(mask, new, u)
+
+    return local_step
+
+
+def make_sharded_runner(config, mesh: Mesh, kernel=None):
+    """Returns (runner, sharding): ``runner(u_sharded) -> (u, steps_done)``,
+    jit-compiled over the mesh. The full loop (and convergence psum over
+    both mesh axes — the MPI_Allreduce analogue, grad1612_mpi_heat.c:268)
+    runs device-side in one program."""
+    ax, ay = mesh.axis_names
+    accum = jnp.dtype(config.accum_dtype)
+    local_step = make_local_step(config, mesh, kernel=kernel)
+    sharding = NamedSharding(mesh, P(ax, ay))
+
+    def local_run(u):
+        if config.convergence:
+            def residual(u_new, u_old):
+                return lax.psum(residual_sq(u_new, u_old, accum),
+                                (ax, ay))
+            u, k = engine.run_convergence(
+                local_step, residual, u, config.steps,
+                config.interval, config.sensitivity)
+        else:
+            u, k = engine.run_fixed(local_step, u, config.steps)
+        return u, k
+
+    try:
+        mapped = shard_map(local_run, mesh=mesh,
+                           in_specs=P(ax, ay),
+                           out_specs=(P(ax, ay), P()),
+                           # pallas_call out_shapes carry no vma info; skip
+                           # the varying-across-mesh-axes check when a
+                           # kernel runs inside the shard (hybrid mode)
+                           check_vma=kernel is None)
+    except TypeError:  # older jax: no check_vma kwarg
+        mapped = shard_map(local_run, mesh=mesh,
+                           in_specs=P(ax, ay),
+                           out_specs=(P(ax, ay), P()))
+    runner = jax.jit(mapped)
+    return runner, sharding
+
+
+def sharded_inidat(config, mesh: Mesh):
+    """Device-resident sharded initial condition. Each shard computes its
+    block from its mesh coordinates (lax.axis_index) — no xs/ys offset
+    broadcast needed (grad1612_mpi_heat.c:125-147 collapses to this)."""
+    ax, ay = mesh.axis_names
+    gx, gy = (mesh.devices.shape[0], mesh.devices.shape[1])
+    nx, ny = config.nxprob, config.nyprob
+    bm, bn = nx // gx, ny // gy
+
+    def local_init():
+        x0 = lax.axis_index(ax) * bm
+        y0 = lax.axis_index(ay) * bn
+        return inidat_block((bm, bn), nx, ny, x0, y0)
+
+    fn = jax.jit(shard_map(local_init, mesh=mesh, in_specs=(),
+                           out_specs=P(ax, ay)))
+    return fn()

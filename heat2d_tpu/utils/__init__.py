@@ -1,0 +1,4 @@
+from heat2d_tpu.utils.timing import Stopwatch, timed_call, max_over_processes
+from heat2d_tpu.utils.device import device_summary
+
+__all__ = ["Stopwatch", "timed_call", "max_over_processes", "device_summary"]

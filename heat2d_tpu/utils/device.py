@@ -1,0 +1,37 @@
+"""Device introspection — the detailsGPU analogue (grad1612_cuda_heat.cu:24-37).
+
+Where the reference printed SM version, memory sizes and warp/block limits,
+we report the TPU/host platform facts that matter for this workload: device
+kind, count, HBM limits, and the process topology.
+"""
+
+from __future__ import annotations
+
+import jax
+
+
+def device_summary() -> dict:
+    devs = jax.devices()
+    d0 = devs[0]
+    info = {
+        "platform": d0.platform,
+        "device_kind": getattr(d0, "device_kind", "unknown"),
+        "n_devices": len(devs),
+        "process_index": jax.process_index(),
+        "process_count": jax.process_count(),
+        "jax_version": jax.__version__,
+    }
+    try:
+        stats = d0.memory_stats()
+        if stats:
+            info["memory_stats"] = {
+                k: stats[k] for k in ("bytes_limit", "bytes_in_use")
+                if k in stats}
+    except Exception:
+        pass
+    return info
+
+
+def print_device_summary() -> None:
+    for k, v in device_summary().items():
+        print(f"{k}: {v}")

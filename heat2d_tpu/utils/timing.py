@@ -1,0 +1,86 @@
+"""Timing protocol — like-for-like with the reference (SURVEY.md §5.1).
+
+The reference's protocol: barrier, MPI_Wtime, step loop, MPI_Wtime, then
+MAX over ranks (grad1612_mpi_heat.c:206-207, 277-280; manual recv-max in
+mpi_heat2Dn.c:199-210; cudaEvent pair in grad1612_cuda_heat.cu:79-89).
+Setup/compile time is excluded — the clock starts after init, so we
+likewise exclude jit compilation by warming up the compiled function
+before the timed call.
+
+TPU mapping: the barrier is ``block_until_ready`` on the inputs (plus
+``sync_global_devices`` when multi-process); MPI_Wtime is
+``time.perf_counter``; the rank-max is a host-side max over processes.
+"""
+
+from __future__ import annotations
+
+import time
+
+import jax
+
+
+def max_over_processes(value: float) -> float:
+    """Cluster-max of a host scalar — the MPI_Reduce(MPI_MAX) analogue."""
+    if jax.process_count() == 1:
+        return float(value)
+    from jax.experimental import multihost_utils
+    import numpy as np
+    gathered = multihost_utils.process_allgather(np.asarray(value))
+    return float(gathered.max())
+
+
+class Stopwatch:
+    """Barrier-fenced wall-clock span."""
+
+    def __enter__(self):
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        self.elapsed = time.perf_counter() - self._t0
+        return False
+
+
+def _fence(tree) -> None:
+    """Hard completion fence: force a tiny host readback from every output.
+
+    ``block_until_ready`` alone is not a reliable fence on every backend
+    (remote-tunneled runtimes can acknowledge queued dispatches as ready);
+    a 4-byte scalar D2H cannot complete before the producing computation
+    has. This is the cudaEventSynchronize analogue
+    (grad1612_cuda_heat.cu:87) with teeth.
+    """
+    leaves = jax.tree_util.tree_leaves(tree)
+    import jax.numpy as jnp
+    # A reduction to a replicated scalar works for sharded and unsharded
+    # leaves alike; its HBM pass is negligible next to any timed run.
+    probes = [jnp.sum(leaf) if getattr(leaf, "ndim", 0) else leaf
+              for leaf in leaves]
+    jax.device_get(probes)
+
+
+def timed_call(fn, *args, warmup: bool = True):
+    """Run ``fn(*args)`` with the reference's timing protocol.
+
+    Returns (outputs, elapsed_seconds). ``warmup=True`` runs once first so
+    compilation (the analogue of MPI setup, excluded by the reference's
+    clock placement) is not measured.
+    """
+    if warmup:
+        # Warm up by *executing*, not just AOT-compiling: first execution
+        # also pays program load / remote-device transfer, which belongs to
+        # setup (the reference starts its clock after init). AOT compile
+        # alone leaves that cost inside the timed region (measured: 15x
+        # inflation through the remote-TPU tunnel).
+        _fence(fn(*args))
+    for a in args:
+        jax.block_until_ready(a)
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+        multihost_utils.sync_global_devices("heat2d timing barrier")
+    t0 = time.perf_counter()
+    out = fn(*args)
+    jax.block_until_ready(out)
+    _fence(out)
+    elapsed = time.perf_counter() - t0
+    return out, max_over_processes(elapsed)

@@ -1,0 +1,67 @@
+"""Test harness: 8 virtual CPU devices (SURVEY.md §4 — the TPU answer to
+"multi-node without a cluster"), x64 enabled so accum_dtype=float64 can
+mirror the C reference's double promotion."""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"  # force: the session env may point at TPU
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+# The image's sitecustomize imports jax at interpreter startup with
+# JAX_PLATFORMS=axon, so the env var above can be captured too early —
+# override via the live config as well (backends initialize lazily, so
+# this still lands before first use).
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(1612)
+
+
+def ref_inidat(nx: int, ny: int) -> np.ndarray:
+    """Independent NumPy oracle for the reference's inidat
+    (mpi_heat2Dn.c:242-248): ix*(nx-ix-1)*iy*(ny-iy-1)."""
+    ix = np.arange(nx, dtype=np.float64)[:, None]
+    iy = np.arange(ny, dtype=np.float64)[None, :]
+    return (ix * (nx - ix - 1) * iy * (ny - iy - 1)).astype(np.float32)
+
+
+def ref_step(u: np.ndarray, cx: float = 0.1, cy: float = 0.1) -> np.ndarray:
+    """Independent NumPy oracle for one reference time step: f32 storage,
+    per-cell arithmetic promoted through double (C promotion of the
+    double literals CX/CY/2.0 — SURVEY.md Appendix B), edges never
+    updated."""
+    v = u.astype(np.float64)
+    new = v.copy()
+    c = v[1:-1, 1:-1]
+    new[1:-1, 1:-1] = (c
+                       + cx * (v[2:, 1:-1] + v[:-2, 1:-1] - 2.0 * c)
+                       + cy * (v[1:-1, 2:] + v[1:-1, :-2] - 2.0 * c))
+    return new.astype(np.float32)
+
+
+def ref_run(nx: int, ny: int, steps: int,
+            cx: float = 0.1, cy: float = 0.1) -> np.ndarray:
+    u = ref_inidat(nx, ny)
+    for _ in range(steps):
+        u = ref_step(u, cx, cy)
+    return u
+
+
+@pytest.fixture
+def oracle():
+    class Oracle:
+        inidat = staticmethod(ref_inidat)
+        step = staticmethod(ref_step)
+        run = staticmethod(ref_run)
+    return Oracle

@@ -1,0 +1,64 @@
+"""Checkpoint/resume tests — the loadable version of the reference's MPI-IO
+binary dumps (SURVEY.md §5.4)."""
+
+import numpy as np
+
+from heat2d_tpu.config import HeatConfig
+from heat2d_tpu.io import (load_checkpoint, read_binary, save_checkpoint,
+                           write_binary)
+from heat2d_tpu.models.solver import Heat2DSolver
+from heat2d_tpu.ops import inidat
+
+
+def test_binary_roundtrip(tmp_path):
+    u = np.asarray(inidat(12, 8))
+    p = tmp_path / "state.bin"
+    write_binary(u, p)
+    # byte format: raw row-major f32 — exactly the MPI-IO file layout
+    assert p.stat().st_size == 12 * 8 * 4
+    np.testing.assert_array_equal(read_binary(p, (12, 8)), u)
+
+
+def test_checkpoint_sidecar(tmp_path):
+    cfg = HeatConfig(nxprob=12, nyprob=8, steps=50)
+    u = np.asarray(inidat(12, 8))
+    p = tmp_path / "ckpt.bin"
+    save_checkpoint(u, 30, cfg, p)
+    grid, step, cfg_dict = load_checkpoint(p)
+    assert step == 30
+    assert cfg_dict["nxprob"] == 12
+    np.testing.assert_array_equal(grid, u)
+
+
+def test_resume_equals_straight_run(tmp_path):
+    """run(100) == run(60) -> checkpoint -> resume(40), bitwise."""
+    cfg100 = HeatConfig(nxprob=16, nyprob=16, steps=100)
+    full = Heat2DSolver(cfg100).run(timed=False)
+
+    cfg60 = cfg100.replace(steps=60)
+    first = Heat2DSolver(cfg60).run(timed=False)
+    p = tmp_path / "ckpt.bin"
+    save_checkpoint(first.u, 60, cfg60, p)
+
+    grid, step, _ = load_checkpoint(p)
+    cfg40 = cfg100.replace(steps=100 - step)
+    solver = Heat2DSolver(cfg40)
+    second = solver.run(u0=solver.place(grid), timed=False)
+
+    np.testing.assert_array_equal(second.u, full.u)
+
+
+def test_resume_sharded(tmp_path):
+    """Resume a serial checkpoint into a 2x2 sharded run."""
+    cfg = HeatConfig(nxprob=16, nyprob=16, steps=80)
+    full = Heat2DSolver(cfg).run(timed=False)
+
+    first = Heat2DSolver(cfg.replace(steps=50)).run(timed=False)
+    p = tmp_path / "ckpt.bin"
+    save_checkpoint(first.u, 50, cfg, p)
+
+    grid, step, _ = load_checkpoint(p)
+    cfg2 = cfg.replace(steps=30, mode="dist2d", gridx=2, gridy=2)
+    solver = Heat2DSolver(cfg2)
+    second = solver.run(u0=solver.place(grid), timed=False)
+    np.testing.assert_array_equal(second.u, full.u)

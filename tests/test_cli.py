@@ -1,0 +1,69 @@
+"""CLI end-to-end tests (the reference's build/run recipes, readme.md:9-19,
+as --mode flags)."""
+
+import json
+
+import numpy as np
+
+from heat2d_tpu.cli import main
+from heat2d_tpu.io import read_binary, read_grid_text
+
+
+def test_cli_serial_run(tmp_path, capsys):
+    rc = main(["--mode", "serial", "--outdir", str(tmp_path),
+               "--binary-dumps",
+               "--run-record", str(tmp_path / "record.json")])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "Problem size:10x10" in out
+    assert "Elapsed time:" in out
+    initial = read_grid_text(tmp_path / "initial.dat", "rowmajor")
+    final = read_grid_text(tmp_path / "final.dat", "rowmajor")
+    assert initial.shape == (10, 10)
+    assert final.shape == (10, 10)
+    # binary dump parses to the same grid as the text dump (at %6.1f res)
+    b = read_binary(tmp_path / "final_binary.dat", (10, 10))
+    np.testing.assert_allclose(b, final, atol=0.05)
+    rec = json.loads((tmp_path / "record.json").read_text())
+    assert rec["steps_done"] == 100
+
+
+def test_cli_dist2d_run(tmp_path):
+    rc = main(["--mode", "dist2d", "--gridx", "2", "--gridy", "2",
+               "--nxprob", "16", "--nyprob", "16", "--steps", "20",
+               "--outdir", str(tmp_path)])
+    assert rc == 0
+    final = read_grid_text(tmp_path / "final.dat", "rowmajor")
+    assert final.shape == (16, 16)
+
+
+def test_cli_baseline_layout(tmp_path):
+    rc = main(["--mode", "serial", "--dat-layout", "baseline",
+               "--outdir", str(tmp_path)])
+    assert rc == 0
+    g = read_grid_text(tmp_path / "initial.dat", "baseline")
+    assert g.shape == (10, 10)
+
+
+def test_cli_invalid_config(tmp_path, capsys):
+    rc = main(["--mode", "dist2d", "--gridx", "3", "--nxprob", "10",
+               "--outdir", str(tmp_path)])
+    assert rc == 1
+    assert "Quitting" in capsys.readouterr().err
+
+
+def test_cli_checkpoint_resume(tmp_path):
+    ck = tmp_path / "ck.bin"
+    rc = main(["--mode", "serial", "--nxprob", "16", "--nyprob", "16",
+               "--steps", "60", "--outdir", str(tmp_path / "a"),
+               "--checkpoint", str(ck)])
+    assert rc == 0
+    rc = main(["--mode", "serial", "--nxprob", "16", "--nyprob", "16",
+               "--steps", "100", "--outdir", str(tmp_path / "b"),
+               "--resume", str(ck)])
+    assert rc == 0
+    resumed = read_grid_text(tmp_path / "b" / "final.dat", "rowmajor")
+    rc = main(["--mode", "serial", "--nxprob", "16", "--nyprob", "16",
+               "--steps", "100", "--outdir", str(tmp_path / "c")])
+    straight = read_grid_text(tmp_path / "c" / "final.dat", "rowmajor")
+    np.testing.assert_array_equal(resumed, straight)

@@ -1,0 +1,42 @@
+"""Config validation tests (reference startup checks — SURVEY.md §5.6,
+grad1612_mpi_heat.c:54-64, mpi_heat2Dn.c:72-78)."""
+
+import pytest
+
+from heat2d_tpu.config import ConfigError, HeatConfig
+
+
+def test_defaults_match_reference():
+    c = HeatConfig()
+    assert (c.nxprob, c.nyprob, c.steps) == (10, 10, 100)
+    assert (c.cx, c.cy) == (0.1, 0.1)
+    assert (c.interval, c.sensitivity) == (20, 0.1)
+    assert c.convergence is False  # grad1612_mpi_heat.c:14
+
+
+def test_divisibility_validation():
+    with pytest.raises(ConfigError, match="not an integer"):
+        HeatConfig(nxprob=10, nyprob=10, gridx=3, gridy=2, mode="dist2d")
+
+
+def test_strict_baseline_worker_range():
+    with pytest.raises(ConfigError, match="between"):
+        HeatConfig(mode="dist1d", numworkers=2, strict_baseline=True,
+                   nxprob=10)
+
+
+def test_bad_mode():
+    with pytest.raises(ConfigError):
+        HeatConfig(mode="cuda")
+
+
+def test_cell_sizes():
+    c = HeatConfig(nxprob=640, nyprob=512, gridx=4, gridy=2, mode="dist2d")
+    assert (c.xcell, c.ycell) == (160, 256)
+    assert c.n_shards == 8
+
+
+def test_roundtrip_dict():
+    c = HeatConfig(nxprob=64, nyprob=32, mode="dist2d", gridx=2, gridy=2,
+                   convergence=True)
+    assert HeatConfig.from_dict(c.to_dict()) == c

@@ -1,0 +1,72 @@
+"""Loop-assembly tests: fixed stepping and the (correctly implemented)
+convergence early-exit (SURVEY.md A.2)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from heat2d_tpu.models import engine
+from heat2d_tpu.ops import inidat, residual_sq, stencil_step
+
+
+def _step(u):
+    return stencil_step(u, 0.1, 0.1)
+
+
+def _residual(a, b):
+    return residual_sq(a, b)
+
+
+def test_run_fixed_equals_unrolled():
+    u0 = inidat(10, 10)
+    u_loop, k = jax.jit(lambda u: engine.run_fixed(_step, u, 17))(u0)
+    step = jax.jit(_step)  # same compiled body as the loop
+    u_ref = u0
+    for _ in range(17):
+        u_ref = step(u_ref)
+    assert int(k) == 17
+    np.testing.assert_array_equal(np.asarray(u_loop), np.asarray(u_ref))
+
+
+def test_convergence_runs_all_steps_when_tight():
+    """With an unreachably small sensitivity, all STEPS run."""
+    u0 = inidat(10, 10)
+    run = jax.jit(lambda u: engine.run_convergence(
+        _step, _residual, u, 60, 20, 1e-30))
+    u, k = run(u0)
+    assert int(k) == 60
+    u_fixed, _ = jax.jit(lambda u: engine.run_fixed(_step, u, 60))(u0)
+    np.testing.assert_array_equal(np.asarray(u), np.asarray(u_fixed))
+
+
+def test_convergence_early_exit():
+    """With a huge sensitivity, the loop exits at the first INTERVAL check
+    — grad1612_mpi_heat.c:269's intended break."""
+    u0 = inidat(10, 10)
+    run = jax.jit(lambda u: engine.run_convergence(
+        _step, _residual, u, 100, 20, 1e30))
+    _, k = run(u0)
+    assert int(k) == 20
+
+
+def test_convergence_interval_not_divisible():
+    """STEPS not a multiple of INTERVAL: the final short chunk still runs
+    and the step count is exact."""
+    u0 = inidat(10, 10)
+    run = jax.jit(lambda u: engine.run_convergence(
+        _step, _residual, u, 50, 20, 1e-30))
+    u, k = run(u0)
+    assert int(k) == 50
+    u_fixed, _ = jax.jit(lambda u: engine.run_fixed(_step, u, 50))(u0)
+    np.testing.assert_array_equal(np.asarray(u), np.asarray(u_fixed))
+
+
+def test_convergence_physics_actually_converges():
+    """A real physical run decays to a flat field; the residual check must
+    fire before the step cap."""
+    u0 = inidat(10, 10)
+    run = jax.jit(lambda u: engine.run_convergence(
+        _step, _residual, u, 100000, 20, 0.1))
+    _, k = run(u0)
+    assert int(k) < 100000
+    assert int(k) % 20 == 0

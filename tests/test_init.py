@@ -1,0 +1,35 @@
+"""Initial-condition tests (reference inidat — mpi_heat2Dn.c:242-248,
+grad1612_mpi_heat.c:163-168)."""
+
+import numpy as np
+import pytest
+
+from heat2d_tpu.ops import inidat, inidat_block
+
+
+@pytest.mark.parametrize("nx,ny", [(10, 10), (7, 13), (80, 64)])
+def test_inidat_matches_closed_form(nx, ny, oracle):
+    got = np.asarray(inidat(nx, ny))
+    np.testing.assert_array_equal(got, oracle.inidat(nx, ny))
+    assert got.dtype == np.float32
+
+
+def test_inidat_edges_zero():
+    u = np.asarray(inidat(16, 12))
+    assert (u[0] == 0).all() and (u[-1] == 0).all()
+    assert (u[:, 0] == 0).all() and (u[:, -1] == 0).all()
+    # hot in the middle (readme.md:3-5)
+    assert u.max() == u[8, 6] or u.max() > 0
+
+
+def test_inidat_block_tiles_reassemble():
+    """Per-shard local-coordinate init (grad1612_mpi_heat.c:163-168) must
+    tile back into the global grid."""
+    nx, ny, gx, gy = 12, 8, 3, 2
+    bm, bn = nx // gx, ny // gy
+    full = np.asarray(inidat(nx, ny))
+    for i in range(gx):
+        for j in range(gy):
+            blk = np.asarray(inidat_block((bm, bn), nx, ny, i * bm, j * bn))
+            np.testing.assert_array_equal(
+                blk, full[i * bm:(i + 1) * bm, j * bn:(j + 1) * bn])

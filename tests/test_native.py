@@ -1,0 +1,56 @@
+"""Native C++ formatter parity: byte-identical to the pure-Python writers
+(which are themselves printf-parity-tested in test_writers.py)."""
+
+import numpy as np
+import pytest
+
+from heat2d_tpu.io import writers
+from heat2d_tpu.ops import inidat
+
+
+@pytest.fixture(scope="module")
+def native():
+    try:
+        from heat2d_tpu.native import lib
+        return lib.load()
+    except ImportError:
+        pytest.skip("native library unavailable (no compiler)")
+
+
+def _python_rowmajor(a):
+    rows = []
+    for i in range(a.shape[0]):
+        rows.append("".join(format(float(v), "6.1f") + " " for v in a[i]))
+    return "\n".join(rows) + "\n"
+
+
+def _python_baseline(a):
+    nx, ny = a.shape
+    lines = []
+    for iy in range(ny - 1, -1, -1):
+        lines.append(" ".join(format(float(a[ix, iy]), "6.1f")
+                              for ix in range(nx)))
+    return "\n".join(lines) + "\n"
+
+
+def test_native_rowmajor_byte_parity(native, rng):
+    a = np.concatenate([
+        rng.uniform(-1e6, 1e6, 97),
+        np.array([0.0, -0.0, 0.05, -2.25, 1e8]),
+    ]).astype(np.float32).reshape(6, 17)
+    assert native.format_rowmajor(a) == _python_rowmajor(a)
+
+
+def test_native_baseline_byte_parity(native, rng):
+    a = rng.uniform(-1e4, 1e4, (11, 7)).astype(np.float32)
+    assert native.format_baseline(a) == _python_baseline(a)
+
+
+def test_writers_use_native_when_available(native):
+    """The io.writers module routes through the native path and produces
+    the same bytes either way."""
+    u = np.asarray(inidat(12, 9))
+    via_module = writers.format_grid_rowmajor(u)
+    assert via_module == _python_rowmajor(u)
+    via_module_b = writers.format_grid_baseline(u)
+    assert via_module_b == _python_baseline(u)

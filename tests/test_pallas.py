@@ -1,0 +1,96 @@
+"""Pallas kernel tests (interpreter mode on the CPU harness — the
+SURVEY.md §4 'pltpu interpret' strategy). The kernels must reproduce the
+jnp golden model."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from heat2d_tpu.config import HeatConfig
+from heat2d_tpu.models.solver import Heat2DSolver
+from heat2d_tpu.ops import inidat, stencil_step
+from heat2d_tpu.ops.pallas_stencil import (band_step, fits_vmem,
+                                           make_padded_kernel,
+                                           multi_step_vmem, pick_band_rows)
+
+
+def _golden(u, steps):
+    for _ in range(steps):
+        u = stencil_step(u, 0.1, 0.1)
+    return np.asarray(u)
+
+
+@pytest.mark.parametrize("shape", [(16, 16), (32, 128), (64, 256)])
+def test_vmem_kernel_matches_golden(shape):
+    u0 = inidat(*shape)
+    got = np.asarray(jax.jit(
+        lambda u: multi_step_vmem(u, 5, 0.1, 0.1))(u0))
+    np.testing.assert_allclose(got, _golden(u0, 5), rtol=1e-6, atol=1e-4)
+
+
+@pytest.mark.parametrize("shape,bm", [((32, 128), 8), ((64, 128), 16),
+                                      ((64, 256), None)])
+def test_band_kernel_matches_golden(shape, bm):
+    u0 = inidat(*shape)
+    got = np.asarray(jax.jit(
+        lambda u: band_step(u, 0.1, 0.1, bm=bm))(u0))
+    np.testing.assert_allclose(got, _golden(u0, 1), rtol=1e-6, atol=1e-4)
+
+
+def test_band_kernel_multi_step():
+    u0 = inidat(32, 128)
+    u = u0
+    for _ in range(4):
+        u = band_step(u, 0.1, 0.1, bm=8)
+    np.testing.assert_allclose(np.asarray(u), _golden(u0, 4),
+                               rtol=1e-6, atol=1e-4)
+
+
+def test_pick_band_rows():
+    assert pick_band_rows(4096, 4096) == 128      # 2MB / 16KB rows
+    assert 4096 % pick_band_rows(4096, 4096) == 0
+    assert pick_band_rows(10, 10) == 10           # tiny grid: one band
+
+
+def test_fits_vmem():
+    assert fits_vmem((640, 1024))       # the reference CUDA config
+    assert not fits_vmem((4096, 4096))  # headline config streams
+
+
+def test_pallas_mode_solver_matches_serial():
+    cfg = HeatConfig(nxprob=32, nyprob=128, steps=20, mode="pallas")
+    got = Heat2DSolver(cfg).run(timed=False)
+    want = Heat2DSolver(cfg.replace(mode="serial")).run(timed=False)
+    assert got.steps_done == 20
+    np.testing.assert_allclose(got.u, want.u, rtol=1e-6, atol=1e-4)
+
+
+def test_pallas_mode_convergence():
+    cfg = HeatConfig(nxprob=32, nyprob=128, steps=100000, mode="pallas",
+                     convergence=True, interval=20, sensitivity=0.5)
+    got = Heat2DSolver(cfg).run(timed=False)
+    want = Heat2DSolver(cfg.replace(mode="serial")).run(timed=False)
+    assert got.steps_done == want.steps_done
+    np.testing.assert_allclose(got.u, want.u, rtol=1e-5, atol=1e-3)
+
+
+def test_padded_kernel_matches_padded_golden(rng):
+    from heat2d_tpu.ops.stencil import stencil_step_padded
+    cfg = HeatConfig(nxprob=16, nyprob=16)
+    k = make_padded_kernel(cfg)
+    padded = rng.standard_normal((18, 18)).astype(np.float32)
+    got = np.asarray(k(jnp.asarray(padded), 0.1, 0.1))
+    want = np.asarray(stencil_step_padded(jnp.asarray(padded), 0.1, 0.1))
+    np.testing.assert_allclose(got, want, rtol=1e-6, atol=1e-5)
+
+
+def test_hybrid_mode_matches_serial():
+    """hybrid = 2D mesh x per-shard Pallas kernel (the MPI+OpenMP analogue
+    done right — SURVEY.md A.3)."""
+    cfg = HeatConfig(nxprob=32, nyprob=256, steps=10, mode="hybrid",
+                     gridx=2, gridy=2)
+    got = Heat2DSolver(cfg).run(timed=False)
+    want = Heat2DSolver(cfg.replace(mode="serial", gridx=1, gridy=1)
+                        ).run(timed=False)
+    np.testing.assert_allclose(got.u, want.u, rtol=1e-6, atol=1e-4)

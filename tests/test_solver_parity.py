@@ -1,0 +1,42 @@
+"""End-to-end parity: the solver reproduces the reference's default
+workload (10x10 grid, 100 steps — mpi_heat2Dn.c:29-31) against the
+independent C-semantics oracle, and the .dat outputs round-trip."""
+
+import numpy as np
+
+from heat2d_tpu.config import HeatConfig
+from heat2d_tpu.io import format_grid_rowmajor
+from heat2d_tpu.models.solver import Heat2DSolver
+
+
+def test_serial_f64_accum_bitwise_parity(oracle):
+    cfg = HeatConfig(accum_dtype="float64")
+    result = Heat2DSolver(cfg).run(timed=False)
+    assert result.steps_done == 100
+    np.testing.assert_array_equal(result.u, oracle.run(10, 10, 100))
+
+
+def test_serial_f32_close_parity(oracle):
+    cfg = HeatConfig()  # f32 fast path
+    result = Heat2DSolver(cfg).run(timed=False)
+    np.testing.assert_allclose(result.u, oracle.run(10, 10, 100),
+                               rtol=1e-5, atol=1e-3)
+
+
+def test_final_dat_text_parity(oracle):
+    """The rowmajor final.dat text for the default workload matches the
+    oracle's formatted dump byte-for-byte (f64-accum mode)."""
+    cfg = HeatConfig(accum_dtype="float64")
+    result = Heat2DSolver(cfg).run(timed=False)
+    assert (format_grid_rowmajor(result.u)
+            == format_grid_rowmajor(oracle.run(10, 10, 100)))
+
+
+def test_mcells_metric():
+    cfg = HeatConfig(nxprob=32, nyprob=32, steps=10)
+    result = Heat2DSolver(cfg).run(timed=True)
+    assert result.elapsed > 0
+    assert result.mcells_per_s > 0
+    rec = result.to_record()
+    assert rec["steps_done"] == 10
+    assert rec["config"]["nxprob"] == 32

@@ -1,0 +1,67 @@
+"""Numeric-core tests: the jnp stencil vs the independent NumPy oracle of
+the C semantics (SURVEY.md §2.1 C3, Appendix B)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from heat2d_tpu.ops import inidat, stencil_step, stencil_step_padded, residual_sq
+
+
+def test_one_step_matches_oracle_f64(oracle):
+    """f64 accumulation reproduces C's double-promoted update exactly."""
+    u = np.asarray(inidat(10, 10))
+    got = np.asarray(stencil_step(jnp.asarray(u), 0.1, 0.1, jnp.float64))
+    np.testing.assert_array_equal(got, oracle.step(u))
+
+
+def test_hundred_steps_match_oracle_f64(oracle):
+    """The reference default workload: 10x10, 100 steps
+    (mpi_heat2Dn.c:29-31). Bitwise equality in f64-accum mode."""
+    u = inidat(10, 10)
+    for _ in range(100):
+        u = stencil_step(u, 0.1, 0.1, jnp.float64)
+    np.testing.assert_array_equal(np.asarray(u), oracle.run(10, 10, 100))
+
+
+def test_f32_accum_close_to_oracle(oracle):
+    """The TPU-fast f32 path drifts only at rounding level over 100 steps
+    at parity sizes (SURVEY.md Appendix B recommendation)."""
+    u = inidat(10, 10)
+    for _ in range(100):
+        u = stencil_step(u, 0.1, 0.1, jnp.float32)
+    ref = oracle.run(10, 10, 100)
+    np.testing.assert_allclose(np.asarray(u), ref, rtol=1e-5, atol=1e-3)
+
+
+def test_boundaries_clamped(oracle):
+    """Edges are never updated (mpi_heat2Dn.c:228-229 loop bounds)."""
+    u0 = np.asarray(inidat(12, 9))
+    u = jnp.asarray(u0)
+    for _ in range(7):
+        u = stencil_step(u, 0.1, 0.1)
+    u = np.asarray(u)
+    np.testing.assert_array_equal(u[0], u0[0])
+    np.testing.assert_array_equal(u[-1], u0[-1])
+    np.testing.assert_array_equal(u[:, 0], u0[:, 0])
+    np.testing.assert_array_equal(u[:, -1], u0[:, -1])
+
+
+def test_padded_step_matches_global_interior(rng):
+    """A halo-padded block step reproduces the corresponding window of the
+    global step (the per-shard compute path, grad1612_mpi_heat.c:239-259)."""
+    u = rng.standard_normal((16, 14)).astype(np.float32)
+    full = np.asarray(stencil_step(jnp.asarray(u), 0.1, 0.1))
+    # interior block [4:10, 3:9] with its 1-cell halo ring [3:11, 2:10]
+    padded = jnp.asarray(u[3:11, 2:10])
+    blk = np.asarray(stencil_step_padded(padded, 0.1, 0.1))
+    np.testing.assert_array_equal(blk, full[4:10, 3:9])
+
+
+@pytest.mark.parametrize("accum", [jnp.float32, jnp.float64])
+def test_residual_sq(accum, rng):
+    a = rng.standard_normal((8, 8)).astype(np.float32)
+    b = rng.standard_normal((8, 8)).astype(np.float32)
+    got = float(residual_sq(jnp.asarray(a), jnp.asarray(b), accum))
+    want = np.sum((a.astype(np.float64) - b.astype(np.float64)) ** 2)
+    np.testing.assert_allclose(got, want, rtol=1e-5)

@@ -1,0 +1,66 @@
+"""Writer byte-format tests, including a live C printf parity check
+(the reference's %6.1f writers — mpi_heat2Dn.c:253-268,
+grad1612_mpi_heat.c:191-203; orientation split per SURVEY.md A.6)."""
+
+import shutil
+import subprocess
+
+import numpy as np
+import pytest
+
+from heat2d_tpu.io import (format_grid_baseline, format_grid_rowmajor,
+                           read_grid_text, write_grid_baseline,
+                           write_grid_rowmajor)
+from heat2d_tpu.ops import inidat
+
+
+def test_rowmajor_format_exact():
+    u = np.array([[0.0, 1.5], [-2.25, 1234.5]], dtype=np.float32)
+    # "%6.1f " per value (trailing space), newline per row.
+    assert format_grid_rowmajor(u) == "   0.0    1.5 \n  -2.2 1234.5 \n"
+
+
+def test_baseline_format_exact():
+    u = np.array([[0.0, 1.5], [-2.25, 1234.5]], dtype=np.float32)
+    # Lines iterate iy descending, ix across; space *between* values only.
+    assert format_grid_baseline(u) == "   1.5 1234.5\n   0.0   -2.2\n"
+
+
+def test_roundtrip_rowmajor(tmp_path):
+    u = np.asarray(inidat(10, 10))
+    p = tmp_path / "x.dat"
+    write_grid_rowmajor(u, p)
+    back = read_grid_text(p, "rowmajor")
+    np.testing.assert_array_equal(back, u)  # inidat values are x.0-exact
+
+
+def test_roundtrip_baseline(tmp_path):
+    u = np.asarray(inidat(8, 6))
+    p = tmp_path / "x.dat"
+    write_grid_baseline(u, p)
+    back = read_grid_text(p, "baseline")
+    np.testing.assert_array_equal(back, u)
+
+
+@pytest.mark.skipif(shutil.which("gcc") is None and shutil.which("g++") is None,
+                    reason="no C compiler")
+def test_printf_byte_parity(tmp_path, rng):
+    """Format random floats with an actual C printf("%6.1f") and compare
+    byte-for-byte with the Python formatter."""
+    vals = np.concatenate([
+        rng.uniform(-1e4, 1e4, 200),
+        np.array([0.0, -0.0, 0.05, -0.05, 2.5, -2.5, 99.95, 1e6]),
+    ]).astype(np.float32)
+    src = tmp_path / "fmt.c"
+    src.write_text(
+        '#include <stdio.h>\n'
+        'int main(void){float v;'
+        'while(fread(&v,sizeof v,1,stdin)==1) printf("%6.1f ", v);'
+        'return 0;}\n')
+    exe = tmp_path / "fmt"
+    cc = shutil.which("gcc") or shutil.which("g++")
+    subprocess.run([cc, str(src), "-o", str(exe)], check=True)
+    out = subprocess.run([str(exe)], input=vals.tobytes(),
+                         capture_output=True, check=True).stdout.decode()
+    ours = format_grid_rowmajor(vals.reshape(1, -1)).replace("\n", "")
+    assert out == ours
