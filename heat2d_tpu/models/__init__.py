@@ -1,3 +1,13 @@
-from heat2d_tpu.models.solver import Heat2DSolver, RunResult
-
+# Lazy re-exports: parallel.sharded imports heat2d_tpu.models.engine, and an
+# eager solver import here would close an import cycle (solver -> sharded ->
+# models package -> solver).
 __all__ = ["Heat2DSolver", "RunResult"]
+
+
+def __getattr__(name):
+    if name in __all__:
+        from heat2d_tpu.models import solver
+
+        return getattr(solver, name)
+    raise AttributeError(
+        f"module {__name__!r} has no attribute {name!r}")

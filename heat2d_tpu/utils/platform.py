@@ -1,0 +1,44 @@
+"""Host-platform steering for multi-device runs without TPU pods.
+
+The TPU answer to the reference's "multi-node without owning a cluster"
+problem (SURVEY.md §4): run the real shard_map/ppermute program on N
+virtual CPU devices via --xla_force_host_platform_device_count.
+
+Gotcha this module exists for: the image's sitecustomize imports jax at
+interpreter startup pinned to the TPU plugin, so setting JAX_PLATFORMS in
+the environment is NOT enough — the live ``jax.config`` must be updated
+too, and only before the backend initializes.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+
+_COUNT_RE = re.compile(r"--xla_force_host_platform_device_count=(\d+)")
+
+
+def force_host_devices(n: int, platform: str = "cpu") -> None:
+    """Steer this process to >= ``n`` virtual host devices on ``platform``.
+
+    Raises the XLA host-device count to ``n`` (never shrinks a larger
+    pre-set count — another consumer in this process may need it) and
+    switches the live jax platform config. Must run before the jax
+    backend initializes; afterwards the platform switch is a silent no-op
+    (callers should verify len(jax.devices()) themselves).
+    """
+    flags = os.environ.get("XLA_FLAGS", "")
+    m = _COUNT_RE.search(flags)
+    if m is None:
+        flags = f"{flags} --xla_force_host_platform_device_count={n}".strip()
+    elif int(m.group(1)) < n:
+        flags = _COUNT_RE.sub(
+            f"--xla_force_host_platform_device_count={n}", flags)
+    os.environ["XLA_FLAGS"] = flags
+
+    import jax
+
+    try:
+        jax.config.update("jax_platforms", platform)
+    except Exception:
+        pass  # backend already up; caller's device-count check will catch it

@@ -2,21 +2,12 @@
 "multi-node without a cluster"), x64 enabled so accum_dtype=float64 can
 mirror the C reference's double promotion."""
 
-import os
+from heat2d_tpu.utils.platform import force_host_devices
 
-os.environ["JAX_PLATFORMS"] = "cpu"  # force: the session env may point at TPU
-_flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in _flags:
-    os.environ["XLA_FLAGS"] = (
-        _flags + " --xla_force_host_platform_device_count=8").strip()
+force_host_devices(8)
 
 import jax  # noqa: E402
 
-# The image's sitecustomize imports jax at interpreter startup with
-# JAX_PLATFORMS=axon, so the env var above can be captured too early —
-# override via the live config as well (backends initialize lazily, so
-# this still lands before first use).
-jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_enable_x64", True)
 
 import numpy as np  # noqa: E402
