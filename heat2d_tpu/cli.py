@@ -67,6 +67,10 @@ def build_parser() -> argparse.ArgumentParser:
                    help="checkpoint to resume from (remaining steps run)")
     o.add_argument("--run-record", default=None,
                    help="path for the JSON run record")
+    o.add_argument("--profile", default=None, metavar="LOGDIR",
+                   help="capture a jax.profiler device trace of the timed "
+                        "run (the mpiP analogue; view with tensorboard "
+                        "--logdir or ui.perfetto.dev)")
     p.add_argument("--accum-dtype", default="float32",
                    choices=["float32", "float64"],
                    help="float64 mirrors the C reference's double promotion")
@@ -81,6 +85,18 @@ def build_parser() -> argparse.ArgumentParser:
                    help="with --platform cpu: number of virtual host "
                         "devices (XLA_FLAGS --xla_force_host_platform_"
                         "device_count)")
+    m = p.add_argument_group(
+        "multi-host (the mpiexec launch line; on TPU pods these are "
+        "discovered from the environment — pass none of them)")
+    m.add_argument("--coordinator", default=None,
+                   help="coordinator address host:port "
+                        "(jax.distributed.initialize)")
+    m.add_argument("--num-processes", type=int, default=None)
+    m.add_argument("--process-id", type=int, default=None)
+    m.add_argument("--multihost", action="store_true",
+                   help="initialize jax.distributed from the environment "
+                        "(TPU pod metadata) even with no explicit "
+                        "coordinator")
     return p
 
 
@@ -89,12 +105,14 @@ def _apply_platform(args) -> None:
     force-register a TPU backend, so the env var alone is not enough — the
     live config update is what wins."""
     if args.host_device_count:
-        flags = os.environ.get("XLA_FLAGS", "")
-        if "xla_force_host_platform_device_count" not in flags:
-            os.environ["XLA_FLAGS"] = (
-                flags + f" --xla_force_host_platform_device_count="
-                f"{args.host_device_count}").strip()
-    if args.platform:
+        # Affects only the host (CPU) platform; without --platform cpu this
+        # just pre-sets the flag and the attached platform still wins.
+        from heat2d_tpu.utils.platform import set_host_device_count
+        set_host_device_count(args.host_device_count)
+    if args.platform == "cpu":
+        from heat2d_tpu.utils.platform import force_host_devices
+        force_host_devices(args.host_device_count or 1, platform="cpu")
+    elif args.platform:
         os.environ["JAX_PLATFORMS"] = args.platform
         import jax
         jax.config.update("jax_platforms", args.platform)
@@ -106,6 +124,17 @@ def _apply_platform(args) -> None:
 def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
     _apply_platform(args)
+
+    multihost = (args.multihost or args.coordinator is not None
+                 or args.num_processes is not None
+                 or args.process_id is not None)
+    if multihost:
+        from heat2d_tpu.parallel.multihost import initialize_distributed
+        world = initialize_distributed(
+            args.coordinator, args.num_processes, args.process_id,
+            force=True)
+        if args.debug:
+            print(f"multihost world: {world}")
 
     if args.device_info:
         from heat2d_tpu.utils.device import print_device_summary
@@ -131,14 +160,32 @@ def main(argv=None) -> int:
                                write_grid_rowmajor)
     from heat2d_tpu.models.solver import Heat2DSolver
 
+    # Output and logging are rank-0's job, as in the reference (the master
+    # prints and writes final.dat; rank 0 does the binary->text conversion
+    # — grad1612_mpi_heat.c:66-69, 319-323).
+    import jax
+    primary = jax.process_index() == 0
+
+    def say(msg):
+        if primary:
+            print(msg)
+
+    def to_host(u):
+        """Assemble the full grid on this host (cross-host gather when the
+        array spans non-addressable devices — the MPI result-gather)."""
+        if multihost and jax.process_count() > 1:
+            from jax.experimental import multihost_utils
+            u = multihost_utils.process_allgather(u, tiled=True)
+        return np.asarray(u)
+
     # Startup banner (grad1612_mpi_heat.c:66-69).
-    print(f"Starting with {cfg.n_shards} shards")
-    print(f"Problem size:{cfg.nxprob}x{cfg.nyprob}")
+    say(f"Starting with {cfg.n_shards} shards")
+    say(f"Problem size:{cfg.nxprob}x{cfg.nyprob}")
     if cfg.mode in ("dist2d", "hybrid"):
-        print(f"Each shard will take: {cfg.xcell}x{cfg.ycell}")
-    print(f"Amount of iterations: {cfg.steps}")
+        say(f"Each shard will take: {cfg.xcell}x{cfg.ycell}")
+    say(f"Amount of iterations: {cfg.steps}")
     if cfg.convergence:
-        print(f"Check for convergence every {cfg.interval} iterations")
+        say(f"Check for convergence every {cfg.interval} iterations")
 
     try:
         solver = Heat2DSolver(cfg)
@@ -161,45 +208,55 @@ def main(argv=None) -> int:
     else:
         u0 = solver.init_state()
 
-    def write_dat(u, name):
-        if args.dat_layout == "none":
+    def write_dat(u_host, name):
+        if args.dat_layout == "none" or not primary:
             return
         path = os.path.join(args.outdir, name)
         if args.dat_layout == "baseline":
-            write_grid_baseline(u, path)
+            write_grid_baseline(u_host, path)
         else:
-            write_grid_rowmajor(u, path)
+            write_grid_rowmajor(u_host, path)
         print(f"Writing {name} ...")
 
-    os.makedirs(args.outdir, exist_ok=True)
-    u0_host = np.asarray(u0)
-    write_dat(u0_host, "initial.dat")
-    if args.binary_dumps:
-        write_binary(u0_host, os.path.join(args.outdir, "initial_binary.dat"))
-
     try:
-        result = solver.run(u0=u0)
-    except ConfigError as e:
-        print(f"{e}\nQuitting...", file=sys.stderr)
-        return 1
+        os.makedirs(args.outdir, exist_ok=True)
+        u0_host = to_host(u0)
+        write_dat(u0_host, "initial.dat")
+        if args.binary_dumps and primary:
+            write_binary(u0_host,
+                         os.path.join(args.outdir, "initial_binary.dat"))
 
-    total_steps = start_step + result.steps_done
-    print(f"Exiting after {result.steps_done} iterations")
-    print(f"Elapsed time: {result.elapsed:e} sec")
-    write_dat(result.u, "final.dat")
-    if args.binary_dumps:
-        write_binary(result.u, os.path.join(args.outdir, "final_binary.dat"))
-    if args.checkpoint:
-        save_checkpoint(result.u, total_steps, cfg, args.checkpoint)
+        try:
+            from heat2d_tpu.utils.profiling import profile_span
+            with profile_span(args.profile):
+                result = solver.run(u0=u0)
+        except ConfigError as e:
+            print(f"{e}\nQuitting...", file=sys.stderr)
+            return 1
 
-    record = result.to_record()
-    record["total_steps_including_resume"] = total_steps
-    if args.run_record:
-        with open(args.run_record, "w") as f:
-            json.dump(record, f, indent=2)
-    if cfg.debug:
-        print(json.dumps(record, indent=2))
-    return 0
+        total_steps = start_step + result.steps_done
+        say(f"Exiting after {result.steps_done} iterations")
+        say(f"Elapsed time: {result.elapsed:e} sec")
+        u_host = to_host(result.u)
+        write_dat(u_host, "final.dat")
+        if args.binary_dumps and primary:
+            write_binary(u_host,
+                         os.path.join(args.outdir, "final_binary.dat"))
+        if args.checkpoint and primary:
+            save_checkpoint(u_host, total_steps, cfg, args.checkpoint)
+
+        record = result.to_record()
+        record["total_steps_including_resume"] = total_steps
+        if args.run_record and primary:
+            with open(args.run_record, "w") as f:
+                json.dump(record, f, indent=2)
+        if cfg.debug:
+            print(json.dumps(record, indent=2))
+        return 0
+    finally:
+        if multihost:
+            from heat2d_tpu.parallel.multihost import shutdown_distributed
+            shutdown_distributed()
 
 
 if __name__ == "__main__":

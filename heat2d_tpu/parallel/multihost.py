@@ -1,0 +1,66 @@
+"""Multi-host runtime bring-up — the MPI_Init/Comm_size/Comm_rank analogue.
+
+The reference brings its world up with MPI_Init + Comm_size/Comm_rank
+(grad1612_mpi_heat.c:42-44) under mpiexec, and tears down with
+MPI_Finalize (:314). The TPU equivalent is ``jax.distributed.initialize``:
+each host process connects to a coordinator, after which ``jax.devices()``
+spans every chip in the slice/pod and the single-program shard_map code in
+heat2d_tpu.parallel.sharded runs unchanged — collectives ride ICI within a
+slice and DCN across slices, scheduled by XLA (no NCCL/MPI plumbing to
+manage).
+
+On TPU pods the coordinator/process-id/count triple is normally discovered
+from the environment (TPU metadata), so ``initialize_distributed()`` with
+no arguments is the common path; explicit arguments mirror the mpiexec
+launch line for CPU/GPU-style bring-up.
+"""
+
+from __future__ import annotations
+
+import jax
+
+_initialized = False
+
+
+def initialize_distributed(coordinator: str | None = None,
+                           num_processes: int | None = None,
+                           process_id: int | None = None,
+                           force: bool = False) -> dict:
+    """Bring up the multi-process runtime; returns the world description.
+
+    Safe to call when single-process (no coordinator, no cluster env, and
+    force=False): jax.distributed.initialize is skipped and the world is
+    {1 process}. ``force=True`` initializes with whatever the environment
+    provides (TPU pod metadata discovery). Idempotent within a process
+    (MPI_Init's call-once rule, enforced here by a flag rather than an
+    error).
+    """
+    global _initialized
+    want_init = force or (coordinator is not None
+                          or num_processes is not None
+                          or process_id is not None)
+    if want_init and not _initialized:
+        jax.distributed.initialize(
+            coordinator_address=coordinator,
+            num_processes=num_processes,
+            process_id=process_id)
+        _initialized = True
+    return world_summary()
+
+
+def world_summary() -> dict:
+    """Comm_size/Comm_rank as structured data."""
+    return {
+        "process_index": jax.process_index(),
+        "process_count": jax.process_count(),
+        "local_device_count": jax.local_device_count(),
+        "global_device_count": jax.device_count(),
+    }
+
+
+def shutdown_distributed() -> None:
+    """MPI_Finalize analogue; no-op when never initialized."""
+    global _initialized
+    if _initialized:
+        jax.distributed.shutdown()
+        _initialized = False

@@ -18,14 +18,12 @@ import re
 _COUNT_RE = re.compile(r"--xla_force_host_platform_device_count=(\d+)")
 
 
-def force_host_devices(n: int, platform: str = "cpu") -> None:
-    """Steer this process to >= ``n`` virtual host devices on ``platform``.
+def set_host_device_count(n: int) -> None:
+    """Raise the XLA host-platform device count to ``n``.
 
-    Raises the XLA host-device count to ``n`` (never shrinks a larger
-    pre-set count — another consumer in this process may need it) and
-    switches the live jax platform config. Must run before the jax
-    backend initializes; afterwards the platform switch is a silent no-op
-    (callers should verify len(jax.devices()) themselves).
+    Never shrinks a larger pre-set count — another consumer in this
+    process may need it. Only affects the *host* (CPU) platform, and only
+    if set before the jax backend initializes.
     """
     flags = os.environ.get("XLA_FLAGS", "")
     m = _COUNT_RE.search(flags)
@@ -35,6 +33,17 @@ def force_host_devices(n: int, platform: str = "cpu") -> None:
         flags = _COUNT_RE.sub(
             f"--xla_force_host_platform_device_count={n}", flags)
     os.environ["XLA_FLAGS"] = flags
+
+
+def force_host_devices(n: int, platform: str = "cpu") -> None:
+    """Steer this process to >= ``n`` virtual host devices on ``platform``.
+
+    ``set_host_device_count(n)`` plus a live jax platform switch. Must run
+    before the jax backend initializes; afterwards the platform switch is
+    a silent no-op (callers should verify len(jax.devices()) themselves).
+    """
+    set_host_device_count(n)
+    os.environ["JAX_PLATFORMS"] = platform
 
     import jax
 

@@ -1,0 +1,40 @@
+"""Profiler hooks — the mpiP analogue (SURVEY.md §5.1).
+
+The reference's authors audited *where time goes* with the mpiP link-time
+profiler (Report.pdf p.34-37: per-rank AppTime/MPITime and per-callsite
+aggregate shares — File_open 29%, Waitall 21% at toy size). mpiP hooks in
+via PMPI interposition with zero source changes; the TPU equivalent is
+``jax.profiler.trace``, which captures XLA device traces (kernel timeline,
+collective ops, transfer costs) viewable in Perfetto/XProf/TensorBoard —
+per-op time shares instead of per-MPI-callsite shares.
+
+Usage: ``heat2d-tpu --profile /tmp/trace ...`` wraps the timed run; the
+resulting directory is loadable with ``tensorboard --logdir`` or at
+ui.perfetto.dev.
+"""
+
+from __future__ import annotations
+
+import contextlib
+
+
+@contextlib.contextmanager
+def profile_span(logdir: str | None):
+    """Trace the enclosed span to ``logdir`` (no-op when logdir is None)."""
+    if not logdir:
+        yield
+        return
+    import jax
+
+    with jax.profiler.trace(logdir):
+        yield
+
+
+@contextlib.contextmanager
+def annotate(name: str):
+    """Named sub-span inside a trace (per-phase attribution, e.g. 'halo'
+    vs 'stencil' — the per-callsite flavor of the mpiP tables)."""
+    import jax
+
+    with jax.profiler.TraceAnnotation(name):
+        yield

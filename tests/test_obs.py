@@ -1,0 +1,37 @@
+"""Observability + multi-host bring-up units (SURVEY.md §5.1, §2.4)."""
+
+import os
+
+import jax
+
+from heat2d_tpu.parallel.multihost import (
+    initialize_distributed, world_summary)
+from heat2d_tpu.utils.profiling import annotate, profile_span
+
+
+def test_profile_span_writes_trace(tmp_path):
+    logdir = str(tmp_path / "trace")
+    with profile_span(logdir):
+        with annotate("stencil"):
+            jax.block_until_ready(jax.numpy.ones((8, 8)) * 2.0)
+    files = [os.path.join(r, f)
+             for r, _, fs in os.walk(logdir) for f in fs]
+    assert any("xplane" in f or "trace" in f for f in files), files
+
+
+def test_profile_span_none_is_noop():
+    with profile_span(None):
+        pass  # no logdir -> no tracing machinery touched
+
+
+def test_world_summary_single_process():
+    w = world_summary()
+    assert w["process_index"] == 0
+    assert w["process_count"] == 1
+    assert w["global_device_count"] == len(jax.devices())
+
+
+def test_initialize_distributed_single_process_noop():
+    # No coordinator/pod env and force=False: must not try to connect.
+    w = initialize_distributed()
+    assert w["process_count"] == 1
