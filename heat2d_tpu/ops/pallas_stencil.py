@@ -171,6 +171,101 @@ def band_step(u, cx: float, cy: float, bm: int | None = None):
 
 
 # --------------------------------------------------------------------- #
+# Kernel C: temporally-blocked band multi-step
+# --------------------------------------------------------------------- #
+#
+# Kernel B is HBM-bound: every time step re-reads and re-writes the whole
+# grid (2 x grid bytes/step). Temporal blocking amortizes that: each band
+# carries a T-row halo strip on each side and advances T steps in VMEM per
+# HBM sweep — traffic per step drops ~T x (plus a 2T/bm read overhead).
+# Correctness of the halo depth: after s in-VMEM steps the outermost s rows
+# of the extended band are stale, so the center bm rows are exact for
+# s <= T. Stale data can never cross a *global* boundary row because the
+# clamp mask is applied every internal step: row 0 / row nx-1 never update
+# (the CUDA guard, grad1612_cuda_heat.cu:58), so garbage in the
+# out-of-domain strip rows of edge bands is firewalled at the boundary.
+
+def _band_multi_kernel(up_ref, u_ref, dn_ref, out_ref, *,
+                       bm, tsteps, nx, ny, cx, cy):
+    i = pl.program_id(0)
+    ext = jnp.concatenate([up_ref[0], u_ref[:], dn_ref[0]], axis=0)
+    # Global row ids of ext rows; <=0 also covers out-of-domain strip rows.
+    gi = (i * bm - tsteps
+          + lax.broadcasted_iota(jnp.int32, (bm + 2 * tsteps, 1), 0))
+    keep = (gi <= 0) | (gi >= nx - 1)
+
+    def one(_, v):
+        return jnp.where(keep, v, _step_value(v, cx, cy))
+
+    ext = lax.fori_loop(0, tsteps, one, ext, unroll=False)
+    out_ref[:] = ext[tsteps:-tsteps]
+
+
+def band_multi_step(u, tsteps: int, cx: float, cy: float,
+                    bm: int | None = None):
+    """Advance ``tsteps`` time steps in one sweep of row-band programs."""
+    nx, ny = u.shape
+    if bm is None:
+        bm = pick_band_rows(nx, ny, u.dtype)
+    if tsteps < 1 or bm <= 2 * tsteps:
+        # Not enough band depth to amortize — fall back to stepwise.
+        out = u
+        for _ in range(tsteps):
+            out = band_step(out, cx, cy, bm=bm)
+        return out
+    nblk = nx // bm
+    t = tsteps
+    zeros = jnp.zeros((1, t, ny), u.dtype)
+    blocks = u.reshape(nblk, bm, ny)
+    # Band i's halo strips: global rows [i*bm - t, i*bm) and
+    # [(i+1)*bm, (i+1)*bm + t). Edge bands get zeros — firewalled by the
+    # per-step boundary mask above, never read into the kept result.
+    ups = jnp.concatenate([zeros, blocks[:-1, bm - t:, :]], axis=0)
+    dns = jnp.concatenate([blocks[1:, :t, :], zeros], axis=0)
+
+    kwargs = {}
+    mspace = {}
+    if pltpu is not None and not _interpret():
+        mspace = dict(memory_space=pltpu.VMEM)
+    grid_spec = pl.GridSpec(
+        grid=(nblk,),
+        in_specs=[
+            pl.BlockSpec((1, t, ny), lambda i: (i, 0, 0), **mspace),
+            pl.BlockSpec((bm, ny), lambda i: (i, 0), **mspace),
+            pl.BlockSpec((1, t, ny), lambda i: (i, 0, 0), **mspace),
+        ],
+        out_specs=pl.BlockSpec((bm, ny), lambda i: (i, 0), **mspace),
+    )
+    return pl.pallas_call(
+        functools.partial(_band_multi_kernel, bm=bm, tsteps=t,
+                          nx=nx, ny=ny, cx=cx, cy=cy),
+        out_shape=jax.ShapeDtypeStruct(u.shape, u.dtype),
+        grid_spec=grid_spec,
+        interpret=_interpret(),
+        **kwargs)(ups, u, dns)
+
+
+#: Default temporal depth for HBM-resident grids. Bounded by VMEM (the
+#: band needs bm > 2T rows) and by diminishing returns once traffic per
+#: step is ~grid_bytes/T; 8 cuts HBM traffic ~8x.
+DEFAULT_TSTEPS = 8
+
+
+def band_chunk(u, n: int, cx: float, cy: float,
+               tsteps: int = DEFAULT_TSTEPS, bm: int | None = None):
+    """Advance ``n`` (static) steps: full T-sweeps plus a remainder sweep."""
+    nsweeps, rem = divmod(n, tsteps)
+    if nsweeps:
+        u = lax.fori_loop(
+            0, nsweeps,
+            lambda _, v: band_multi_step(v, tsteps, cx, cy, bm=bm), u,
+            unroll=False)
+    if rem:
+        u = band_multi_step(u, rem, cx, cy, bm=bm)
+    return u
+
+
+# --------------------------------------------------------------------- #
 # Engine integration
 # --------------------------------------------------------------------- #
 
@@ -197,21 +292,19 @@ def make_single_chip_runner(config):
         def step(u):
             return band_step(u, cx, cy)
 
+        def chunk(u, n):  # temporally-blocked sweeps (~T x less HBM traffic)
+            return band_chunk(u, n, cx, cy)
+
     def run(u):
         residual = lambda a, b: residual_sq(a, b)  # noqa: E731
-        if config.convergence and resident:
+        if config.convergence:
             return engine.run_convergence_chunked(
                 chunk, step, residual, u,
                 config.steps, config.interval, config.sensitivity)
-        if config.convergence:
-            return engine.run_convergence(
-                step, residual, u,
-                config.steps, config.interval, config.sensitivity)
-        if resident:
-            # the whole fixed-step run is ONE kernel invocation
-            u = chunk(u, config.steps)
-            return u, jnp.asarray(config.steps, jnp.int32)
-        return engine.run_fixed(step, u, config.steps)
+        # Fixed-step: resident grids run as ONE kernel invocation;
+        # HBM grids as temporally-blocked sweeps.
+        u = chunk(u, config.steps)
+        return u, jnp.asarray(config.steps, jnp.int32)
 
     return jax.jit(run)
 

@@ -10,7 +10,8 @@ import pytest
 from heat2d_tpu.config import HeatConfig
 from heat2d_tpu.models.solver import Heat2DSolver
 from heat2d_tpu.ops import inidat, stencil_step
-from heat2d_tpu.ops.pallas_stencil import (band_step, fits_vmem,
+from heat2d_tpu.ops.pallas_stencil import (band_chunk, band_multi_step,
+                                           band_step, fits_vmem,
                                            make_padded_kernel,
                                            multi_step_vmem, pick_band_rows)
 
@@ -45,6 +46,33 @@ def test_band_kernel_multi_step():
         u = band_step(u, 0.1, 0.1, bm=8)
     np.testing.assert_allclose(np.asarray(u), _golden(u0, 4),
                                rtol=1e-6, atol=1e-4)
+
+
+@pytest.mark.parametrize("tsteps", [1, 2, 3, 7])
+def test_band_multi_step_matches_golden(tsteps):
+    """Temporal blocking: T steps per sweep == T golden steps, including
+    the stale-halo firewall at the global boundary bands."""
+    u0 = inidat(64, 128)
+    got = np.asarray(jax.jit(
+        lambda u: band_multi_step(u, tsteps, 0.1, 0.1, bm=16))(u0))
+    np.testing.assert_allclose(got, _golden(u0, tsteps), rtol=1e-6, atol=1e-4)
+
+
+def test_band_multi_step_shallow_band_fallback():
+    # bm <= 2T: not enough halo depth — must fall back to stepwise and
+    # still be exact.
+    u0 = inidat(32, 128)
+    got = np.asarray(jax.jit(
+        lambda u: band_multi_step(u, 5, 0.1, 0.1, bm=8))(u0))
+    np.testing.assert_allclose(got, _golden(u0, 5), rtol=1e-6, atol=1e-4)
+
+
+@pytest.mark.parametrize("n", [1, 7, 8, 9, 20])
+def test_band_chunk_any_step_count(n):
+    u0 = inidat(64, 128)
+    got = np.asarray(jax.jit(
+        lambda u: band_chunk(u, n, 0.1, 0.1, tsteps=4, bm=16))(u0))
+    np.testing.assert_allclose(got, _golden(u0, n), rtol=1e-6, atol=1e-4)
 
 
 def test_pick_band_rows():
