@@ -1,0 +1,188 @@
+"""Benchmark sweep harness — the Report.pdf methodology, reproduced.
+
+The reference's benchmark protocol (SURVEY.md §2.1 C23, §6; Report.pdf
+p.21-32) sweeps the same problem over a grid-size axis (80x64 ... 2560x2048)
+and a parallelism axis (1..160 MPI tasks; CUDA iteration counts 10..100k),
+timing the step loop with setup excluded and reporting wall-clock, speedup
+vs the 1-task run, and efficiency. This harness reproduces that sweep for
+the TPU framework:
+
+- per-chip axis: every reference grid size (plus 4096x4096, the BASELINE.md
+  north-star config) through the jnp-golden ("serial") and Pallas kernel
+  paths on the attached accelerator — the CUDA-table analogue (Table 10/11).
+- mesh axis: the same grid sizes through dist1d/dist2d/hybrid shard_map
+  programs over an N-device mesh. On a single-chip machine these run on the
+  virtual CPU host platform (--platform cpu), which validates the sharded
+  program at every sweep point; the wall-clock columns are then CPU numbers
+  — flagged in the output — and become real ICI numbers on a pod.
+
+Outputs: one JSON line per point (jsonl), plus a markdown table with the
+reference's published wall-clock beside ours where a figure exists
+(Report.pdf Table 1 serial column and Table 10 CUDA per-step times,
+transcribed in BASELINE.md).
+
+Usage:
+    python benchmarks/sweep.py --suite chip            # real-accelerator perf
+    python benchmarks/sweep.py --suite mesh --platform cpu --host-device-count 8
+    python benchmarks/sweep.py --suite chip --quick    # smoke
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# The reference's sweep sizes (Report.pdf Table 1) + the BASELINE north star.
+REF_SIZES = [(80, 64), (160, 128), (320, 256), (640, 512),
+             (1280, 1024), (2560, 2048)]
+NORTH_STAR = (4096, 4096)
+
+# Reference wall-clock to put beside ours, all 100 steps (BASELINE.md):
+# Table 1 serial (1 node / 1 task) column, and the derived CUDA Mcells/s.
+REF_SERIAL_S = {(80, 64): 2.53e-2, (160, 128): 9.87e-2, (320, 256): 7.52e-1,
+                (640, 512): 3.01, (1280, 1024): 12.7, (2560, 2048): 50.9}
+REF_BEST_S = {(80, 64): 9.30e-3, (160, 128): 2.91e-2, (320, 256): 1.04e-1,
+              (640, 512): 2.13e-1, (1280, 1024): 2.52e-1, (2560, 2048): 5.18e-1}
+REF_CUDA_MCELLS = {(1280, 1024): 705.0, (2560, 2048): 669.0}
+
+
+def run_point(mode, nx, ny, steps, gridx=1, gridy=1, convergence=False):
+    from heat2d_tpu.config import HeatConfig
+    from heat2d_tpu.models.solver import Heat2DSolver
+
+    cfg = HeatConfig(nxprob=nx, nyprob=ny, steps=steps, mode=mode,
+                     gridx=gridx, gridy=gridy, convergence=convergence)
+    solver = Heat2DSolver(cfg)
+    result = solver.run(timed=True)
+    rec = {
+        "mode": mode, "grid": f"{nx}x{ny}", "steps": int(result.steps_done),
+        "mesh": f"{gridx}x{gridy}",
+        "elapsed_s": round(result.elapsed, 6),
+        "mcells_per_s": round(result.mcells_per_s, 2),
+    }
+    ref_s = REF_SERIAL_S.get((nx, ny))
+    if ref_s is not None and steps == 100:
+        rec["ref_serial_s"] = ref_s
+        rec["speedup_vs_ref_serial"] = round(ref_s / result.elapsed, 2)
+        rec["ref_best_160task_s"] = REF_BEST_S[(nx, ny)]
+        rec["speedup_vs_ref_best"] = round(
+            REF_BEST_S[(nx, ny)] / result.elapsed, 2)
+    ref_mc = REF_CUDA_MCELLS.get((nx, ny))
+    if ref_mc is not None:
+        rec["ref_cuda_mcells_per_s"] = ref_mc
+        rec["vs_ref_cuda"] = round(result.mcells_per_s / ref_mc, 2)
+    return rec
+
+
+def mesh_shapes(n_devices):
+    """Closest-to-square factorization plus the 1D strip shape."""
+    gx = int(n_devices ** 0.5)
+    while n_devices % gx:
+        gx -= 1
+    shapes = [(gx, n_devices // gx)]
+    if gx != 1:
+        shapes.append((n_devices, 1))
+    return shapes
+
+
+def suite_chip(steps, quick):
+    sizes = REF_SIZES[:2] if quick else REF_SIZES + [NORTH_STAR]
+    for nx, ny in sizes:
+        for mode in ("serial", "pallas"):
+            yield dict(mode=mode, nx=nx, ny=ny, steps=steps)
+
+
+def suite_mesh(steps, quick, n_devices):
+    sizes = REF_SIZES[:2] if quick else REF_SIZES
+    for nx, ny in sizes:
+        for gx, gy in mesh_shapes(n_devices):
+            mode = "dist1d" if gy == 1 and gx != 1 else "dist2d"
+            if nx % gx or ny % gy:  # the reference's divisibility rule
+                continue
+            yield dict(mode=mode, nx=nx, ny=ny, steps=steps,
+                       gridx=gx, gridy=gy)
+    # hybrid (mesh x per-chip kernel) at the largest size that divides
+    gx, gy = mesh_shapes(n_devices)[0]
+    for nx, ny in reversed(sizes):
+        if nx % gx == 0 and ny % gy == 0:
+            yield dict(mode="hybrid", nx=nx, ny=ny, steps=steps,
+                       gridx=gx, gridy=gy)
+            break
+
+
+def to_markdown(records, platform):
+    lines = [
+        f"# heat2d-tpu sweep ({platform})", "",
+        "Reference columns from Report.pdf via BASELINE.md; all runs "
+        "100 steps unless noted. Reference hardware: HellasGrid cluster "
+        "(up to 160 MPI tasks) and a 2 GB GPU; ours: "
+        f"{platform}.", "",
+        "| mode | grid | mesh | steps | elapsed (s) | Mcells/s | "
+        "ref serial (s) | speedup vs ref serial | vs ref best (160 tasks) | "
+        "vs ref CUDA |",
+        "|---|---|---|---|---|---|---|---|---|---|",
+    ]
+    for r in records:
+        lines.append(
+            f"| {r['mode']} | {r['grid']} | {r['mesh']} | {r['steps']} "
+            f"| {r['elapsed_s']:.4g} | {r['mcells_per_s']:.4g} "
+            f"| {r.get('ref_serial_s', '—')} "
+            f"| {r.get('speedup_vs_ref_serial', '—')} "
+            f"| {r.get('speedup_vs_ref_best', '—')} "
+            f"| {r.get('vs_ref_cuda', '—')} |")
+    return "\n".join(lines) + "\n"
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    p.add_argument("--suite", default="chip", choices=["chip", "mesh"])
+    p.add_argument("--steps", type=int, default=100,
+                   help="reference default (grad1612_mpi_heat.c:7)")
+    p.add_argument("--quick", action="store_true")
+    p.add_argument("--outdir", default="benchmarks/results")
+    p.add_argument("--platform", default=None, choices=["cpu", "tpu"])
+    p.add_argument("--host-device-count", type=int, default=None)
+    args = p.parse_args(argv)
+
+    if args.platform == "cpu":
+        from heat2d_tpu.utils.platform import force_host_devices
+        force_host_devices(args.host_device_count or 8, platform="cpu")
+
+    import jax
+    devs = jax.devices()
+    platform = f"{devs[0].device_kind} x{len(devs)}"
+    print(f"# sweep on {platform}", file=sys.stderr)
+
+    if args.suite == "chip":
+        points = list(suite_chip(args.steps, args.quick))
+    else:
+        points = list(suite_mesh(args.steps, args.quick, len(devs)))
+
+    records = []
+    for pt in points:
+        t0 = time.perf_counter()
+        rec = run_point(**pt)
+        rec["suite"] = args.suite
+        rec["platform"] = platform
+        records.append(rec)
+        print(json.dumps(rec))
+        print(f"  [{time.perf_counter() - t0:.1f}s incl. compile]",
+              file=sys.stderr)
+
+    os.makedirs(args.outdir, exist_ok=True)
+    tag = f"{args.suite}{'_quick' if args.quick else ''}"
+    with open(os.path.join(args.outdir, f"sweep_{tag}.jsonl"), "w") as f:
+        f.writelines(json.dumps(r) + "\n" for r in records)
+    with open(os.path.join(args.outdir, f"sweep_{tag}.md"), "w") as f:
+        f.write(to_markdown(records, platform))
+    print(f"# wrote {args.outdir}/sweep_{tag}.jsonl and .md", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,44 @@
+"""Benchmark sweep harness units (SURVEY.md C23)."""
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from benchmarks import sweep  # noqa: E402
+
+
+def test_mesh_shapes():
+    assert sweep.mesh_shapes(8) == [(2, 4), (8, 1)]
+    assert sweep.mesh_shapes(1) == [(1, 1)]
+    assert sweep.mesh_shapes(16) == [(4, 4), (16, 1)]
+
+
+def test_run_point_has_reference_columns():
+    rec = sweep.run_point("serial", 80, 64, 100)
+    assert rec["steps"] == 100
+    assert rec["mcells_per_s"] > 0
+    # 80x64 at 100 steps matches a published Table 1 cell.
+    assert rec["ref_serial_s"] == 2.53e-2
+    assert rec["speedup_vs_ref_serial"] > 0
+
+
+def test_sweep_quick_end_to_end(tmp_path):
+    rc = sweep.main(["--suite", "chip", "--quick", "--steps", "10",
+                     "--outdir", str(tmp_path)])
+    assert rc == 0
+    jsonl = tmp_path / "sweep_chip_quick.jsonl"
+    recs = [json.loads(line) for line in jsonl.read_text().splitlines()]
+    assert len(recs) == 4  # 2 quick sizes x (serial, pallas)
+    assert (tmp_path / "sweep_chip_quick.md").read_text().startswith("#")
+
+
+def test_suite_mesh_respects_divisibility():
+    pts = list(sweep.suite_mesh(10, quick=False, n_devices=8))
+    for pt in pts:
+        assert pt["nx"] % pt["gridx"] == 0
+        assert pt["ny"] % pt["gridy"] == 0
+    assert any(pt["mode"] == "hybrid" for pt in pts)
+    assert any(pt["mode"] == "dist1d" for pt in pts)
+    assert any(pt["mode"] == "dist2d" for pt in pts)
