@@ -171,9 +171,10 @@ def main(argv=None) -> int:
             print(msg)
 
     def to_host(u):
-        """Assemble the full grid on this host (cross-host gather when the
-        array spans non-addressable devices — the MPI result-gather)."""
-        if multihost and jax.process_count() > 1:
+        """Assemble the full grid on this host (cross-host gather only when
+        the array actually spans non-addressable devices — the MPI
+        result-gather; host arrays and replicated outputs pass through)."""
+        if not getattr(u, "is_fully_addressable", True):
             from jax.experimental import multihost_utils
             u = multihost_utils.process_allgather(u, tiled=True)
         return np.asarray(u)
@@ -250,7 +251,7 @@ def main(argv=None) -> int:
         if args.run_record and primary:
             with open(args.run_record, "w") as f:
                 json.dump(record, f, indent=2)
-        if cfg.debug:
+        if cfg.debug and primary:
             print(json.dumps(record, indent=2))
         return 0
     finally:

@@ -1,0 +1,59 @@
+"""Ensemble runs — batch data-parallelism over problem instances.
+
+The reference solves exactly one problem instance per launch (SURVEY.md
+§2.3: "DP over batch / replicas — ABSENT"); parameter sweeps in Report.pdf
+were separate compiles/runs per configuration. This module adds the
+capability the survey flags as the natural TPU extension: ``vmap`` the
+whole time loop over a batch of (cx, cy) diffusivity pairs (or a batch of
+initial grids), so one compiled program advances every ensemble member in
+lockstep — on one chip via vectorization, or sharded over a mesh axis with
+the spatial modes unchanged.
+
+This is how the reference's Table-4-style parameter studies collapse into
+a single launch.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from heat2d_tpu.models import engine
+from heat2d_tpu.ops.init import inidat
+from heat2d_tpu.ops.stencil import stencil_step
+
+
+def run_ensemble(nx: int, ny: int, steps: int, cxs, cys, u0=None):
+    """Advance an ensemble of diffusivity pairs ``steps`` steps.
+
+    ``cxs``/``cys``: 1D arrays of equal length B. ``u0``: optional (B, nx,
+    ny) batch of initial grids; defaults to B copies of the reference
+    initial condition (mpi_heat2Dn.c:242-248). Returns (B, nx, ny).
+    """
+    cxs = jnp.asarray(cxs, jnp.float32)
+    cys = jnp.asarray(cys, jnp.float32)
+    if cxs.shape != cys.shape or cxs.ndim != 1:
+        raise ValueError("cxs and cys must be equal-length 1D arrays")
+    if u0 is None:
+        u0 = jnp.broadcast_to(inidat(nx, ny), (cxs.shape[0], nx, ny))
+    u0 = jnp.asarray(u0)
+    if u0.shape != (cxs.shape[0], nx, ny):
+        raise ValueError(
+            f"u0 must be ({cxs.shape[0]}, {nx}, {ny}), got {u0.shape}")
+
+    def solve_one(u, cx, cy):
+        u, _ = engine.run_fixed(lambda v: stencil_step(v, cx, cy), u, steps)
+        return u
+
+    return jax.jit(jax.vmap(solve_one))(u0, cxs, cys)
+
+
+def ensemble_summary(batch) -> dict:
+    """Per-member residual-free diagnostics (max temp, total heat)."""
+    batch = np.asarray(batch)
+    return {
+        "members": int(batch.shape[0]),
+        "max_temperature": [float(m) for m in batch.max(axis=(1, 2))],
+        "total_heat": [float(s) for s in batch.sum(axis=(1, 2))],
+    }

@@ -162,5 +162,12 @@ class Heat2DSolver:
         else:
             u, k = jax.block_until_ready(runner(u0))
             elapsed = float("nan")
+        if not getattr(u, "is_fully_addressable", True):
+            # Sharded output spans non-addressable devices; assemble the
+            # global grid on every host (the MPI result gather). Fully-
+            # addressable outputs (single-host, or replicated non-sharded
+            # modes under multihost) convert directly.
+            from jax.experimental import multihost_utils
+            u = multihost_utils.process_allgather(u, tiled=True)
         return RunResult(u=np.asarray(u), steps_done=int(k),
                          elapsed=elapsed, config=self.config)

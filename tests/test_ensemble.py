@@ -1,0 +1,39 @@
+"""Ensemble (vmap-over-instances) tests — the DP-over-batch capability the
+reference lacks (SURVEY.md §2.3)."""
+
+import numpy as np
+import pytest
+
+from heat2d_tpu.models.ensemble import ensemble_summary, run_ensemble
+
+
+def test_ensemble_matches_individual_runs(oracle):
+    cxs = [0.05, 0.1, 0.2]
+    cys = [0.1, 0.1, 0.05]
+    batch = np.asarray(run_ensemble(12, 16, 30, cxs, cys))
+    assert batch.shape == (3, 12, 16)
+    for b, (cx, cy) in enumerate(zip(cxs, cys)):
+        ref = oracle.run(12, 16, 30, cx=cx, cy=cy)
+        np.testing.assert_allclose(batch[b], ref, rtol=1e-5, atol=1e-3)
+
+
+def test_ensemble_custom_initial_states():
+    u0 = np.zeros((2, 8, 8), np.float32)
+    u0[:, 4, 4] = 100.0
+    batch = np.asarray(run_ensemble(8, 8, 10, [0.1, 0.1], [0.1, 0.1], u0=u0))
+    np.testing.assert_allclose(batch[0], batch[1])
+    assert batch[0].max() < 100.0  # heat diffused
+
+
+def test_ensemble_validates_shapes():
+    with pytest.raises(ValueError):
+        run_ensemble(8, 8, 1, [0.1, 0.2], [0.1])
+    with pytest.raises(ValueError):
+        run_ensemble(8, 8, 1, [0.1], [0.1],
+                     u0=np.zeros((2, 8, 8), np.float32))
+
+
+def test_ensemble_summary():
+    s = ensemble_summary(np.ones((2, 4, 4), np.float32))
+    assert s["members"] == 2
+    assert s["total_heat"] == [16.0, 16.0]
