@@ -26,9 +26,22 @@ def main() -> int:
     from heat2d_tpu.models.solver import Heat2DSolver
 
     mode = os.environ.get("BENCH_MODE", "pallas")
-    cfg = HeatConfig(nxprob=NX, nyprob=NY, steps=STEPS, mode=mode)
-    solver = Heat2DSolver(cfg)
-    result = solver.run(timed=True)
+
+    # Two-point measurement: the timing fence (utils/timing._fence — a
+    # host readback that guarantees completion through remote-tunneled
+    # runtimes) costs a fixed ~0.1-0.2 s per timed call. The reference's
+    # headline CUDA figure is *per-step* (cudaEvent pair amortized over
+    # up to 100k launches, Report.pdf p.26 Table 10), so the like-for-like
+    # number is the marginal throughput between two step counts — fixed
+    # overhead cancels.
+    def timed_run(steps):
+        cfg = HeatConfig(nxprob=NX, nyprob=NY, steps=steps, mode=mode)
+        return Heat2DSolver(cfg).run(timed=True)
+
+    lo = max(STEPS // 5, 1)
+    r_lo1 = timed_run(lo)
+    r_lo2 = timed_run(lo)   # repeat: |t1-t2| estimates the fence jitter
+    result = timed_run(STEPS)
 
     # sanity: physics must be non-vacuous (unlike the reference CUDA run —
     # SURVEY.md A.1): interior evolved, boundary clamped at zero.
@@ -36,12 +49,23 @@ def main() -> int:
     assert float(u[1:-1, 1:-1].max()) > 0.0, "interior wiped — vacuous run"
     assert float(abs(u[0]).max()) == 0.0, "boundary not clamped"
 
-    value = result.mcells_per_s
+    jitter = abs(r_lo1.elapsed - r_lo2.elapsed)
+    dt = result.elapsed - min(r_lo1.elapsed, r_lo2.elapsed)
+    if dt > max(5 * jitter, 1e-4):
+        value = NX * NY * (STEPS - lo) / dt / 1e6
+        method = "two-point"   # fixed fence overhead cancelled
+    else:
+        # Difference is within noise — report the distorted-but-honest
+        # end-to-end figure and say so.
+        value = result.mcells_per_s
+        method = "single-run (two-point within noise)"
     print(json.dumps({
         "metric": f"Mcells/s/chip {NX}x{NY}x{STEPS} ({mode})",
         "value": round(value, 1),
         "unit": "Mcells/s",
         "vs_baseline": round(value / BASELINE_MCELLS, 2),
+        "method": method,
+        "end_to_end_s": round(result.elapsed, 4),
     }))
     return 0
 

@@ -12,10 +12,15 @@ reference, e.g. mpi_heat2Dn.c:228-229, guard grad1612_cuda_heat.cu:58) —
 they keep their initial value, which the initial condition makes 0 (the
 clamped/absorbing boundary of readme.md:3-5).
 
-Precision semantics (SURVEY.md Appendix B): storage is float32 everywhere in
-the reference, but C promotes each update through double because CX/CY/2.0
-are double literals. ``accum_dtype=float64`` reproduces that exactly
-(compute in f64, store f32); ``float32`` is the TPU-fast path.
+Precision semantics (SURVEY.md Appendix B, sharpened): storage is float32
+everywhere in the reference. In the C expression
+``u + CX*(uE + uW - 2.0*u) + CY*(uN + uS - 2.0*u)`` the usual arithmetic
+conversions make the *neighbor sums* ``uE + uW`` float32 (both operands are
+float), while every operation touching the double literals CX/CY/2.0 is
+performed in double and truncated to f32 on store. ``accum_dtype=float64``
+reproduces exactly that mixed evaluation — verified bitwise against a
+freshly compiled C oracle (tests/test_c_parity.py). ``float32`` is the
+TPU-fast path (all-f32, identical formula).
 """
 
 from __future__ import annotations
@@ -23,24 +28,29 @@ from __future__ import annotations
 import jax.numpy as jnp
 
 
-def _laplacian_update(v, cx, cy):
+def _laplacian_update(v, cx, cy, accum_dtype=None):
     """Stencil applied to the interior of a (halo-inclusive) array ``v``.
 
-    Returns updated values for v[1:-1, 1:-1] in v's dtype.
+    Returns updated values for v[1:-1, 1:-1] in ``accum_dtype`` (default:
+    v's dtype). Neighbor sums are evaluated in v's dtype before promotion
+    — the C semantics above.
     """
-    c = v[1:-1, 1:-1]
-    return (c
-            + cx * (v[2:, 1:-1] + v[:-2, 1:-1] - 2.0 * c)
-            + cy * (v[1:-1, 2:] + v[1:-1, :-2] - 2.0 * c))
+    accum = v.dtype if accum_dtype is None else accum_dtype
+    c = v[1:-1, 1:-1].astype(accum)
+    # sx: axis-0 (ix±1) neighbor sum — pairs with cx, as in the reference
+    # (CX multiplies the ix neighbors, grad1612_cuda_heat.cu:59-61);
+    # sy: axis-1 (iy±1) sum — pairs with cy.
+    sx = (v[2:, 1:-1] + v[:-2, 1:-1]).astype(accum)
+    sy = (v[1:-1, 2:] + v[1:-1, :-2]).astype(accum)
+    cx = jnp.asarray(cx, accum)
+    cy = jnp.asarray(cy, accum)
+    return c + cx * (sx - 2.0 * c) + cy * (sy - 2.0 * c)
 
 
 def stencil_step(u: jnp.ndarray, cx: float, cy: float,
                  accum_dtype=jnp.float32) -> jnp.ndarray:
     """One global time step. Interior updated, edges held (clamped BC)."""
-    v = u.astype(accum_dtype)
-    cxa = jnp.asarray(cx, accum_dtype)
-    cya = jnp.asarray(cy, accum_dtype)
-    new_interior = _laplacian_update(v, cxa, cya).astype(u.dtype)
+    new_interior = _laplacian_update(u, cx, cy, accum_dtype).astype(u.dtype)
     return u.at[1:-1, 1:-1].set(new_interior)
 
 
@@ -55,10 +65,7 @@ def stencil_step_padded(padded: jnp.ndarray, cx: float, cy: float,
     job (the sharded engine knows the shard's mesh position, this op does
     not).
     """
-    v = padded.astype(accum_dtype)
-    cxa = jnp.asarray(cx, accum_dtype)
-    cya = jnp.asarray(cy, accum_dtype)
-    return _laplacian_update(v, cxa, cya).astype(padded.dtype)
+    return _laplacian_update(padded, cx, cy, accum_dtype).astype(padded.dtype)
 
 
 def residual_sq(u_new: jnp.ndarray, u_old: jnp.ndarray,

@@ -1,0 +1,52 @@
+/* Independent C oracle for parity testing (NOT copied from the reference —
+ * written from the behavioral spec in SURVEY.md §1/Appendix B):
+ *
+ *   - f32 storage, two planes, functional swap
+ *   - init u[ix][iy] = ix*(nx-ix-1)*iy*(ny-iy-1)
+ *   - per step, interior only:
+ *       u' = u + CX*(uE + uW - 2u) + CY*(uN + uS - 2u)
+ *     with CX/CY/2.0 as *double* literals, so C promotes each cell update
+ *     through double and truncates to f32 on store — the exact numeric
+ *     semantics of the reference's CPU variants.
+ *
+ * Usage: c_oracle NX NY STEPS OUT.bin [CX CY]
+ * (raw little-endian f32, row-major; CX/CY default 0.1. As doubles they
+ * reproduce the promotion semantics of the reference's double literals.)
+ */
+#include <stdio.h>
+#include <stdlib.h>
+
+int main(int argc, char **argv) {
+    if (argc != 5 && argc != 7) return 2;
+    int nx = atoi(argv[1]), ny = atoi(argv[2]), steps = atoi(argv[3]);
+    double CX = argc == 7 ? atof(argv[5]) : 0.1;
+    double CY = argc == 7 ? atof(argv[6]) : 0.1;
+    float *a = malloc((size_t)nx * ny * sizeof(float));
+    float *b = malloc((size_t)nx * ny * sizeof(float));
+    if (!a || !b) return 3;
+
+    for (int ix = 0; ix < nx; ix++)
+        for (int iy = 0; iy < ny; iy++)
+            a[ix * ny + iy] =
+                (float)(ix * (nx - ix - 1)) * (float)(iy * (ny - iy - 1));
+    for (int i = 0; i < nx * ny; i++) b[i] = 0.0f;
+    /* boundary rows/cols of b stay 0 == a's boundary (init is 0 there) */
+
+    float *src = a, *dst = b;
+    for (int k = 0; k < steps; k++) {
+        for (int ix = 1; ix < nx - 1; ix++)
+            for (int iy = 1; iy < ny - 1; iy++)
+                dst[ix * ny + iy] = src[ix * ny + iy]
+                    + CX * (src[(ix + 1) * ny + iy] + src[(ix - 1) * ny + iy]
+                            - 2.0 * src[ix * ny + iy])
+                    + CY * (src[ix * ny + iy + 1] + src[ix * ny + iy - 1]
+                            - 2.0 * src[ix * ny + iy]);
+        float *t = src; src = dst; dst = t;
+    }
+
+    FILE *f = fopen(argv[4], "wb");
+    if (!f) return 4;
+    fwrite(src, sizeof(float), (size_t)nx * ny, f);
+    fclose(f);
+    return 0;
+}

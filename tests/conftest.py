@@ -29,15 +29,18 @@ def ref_inidat(nx: int, ny: int) -> np.ndarray:
 
 def ref_step(u: np.ndarray, cx: float = 0.1, cy: float = 0.1) -> np.ndarray:
     """Independent NumPy oracle for one reference time step: f32 storage,
-    per-cell arithmetic promoted through double (C promotion of the
-    double literals CX/CY/2.0 — SURVEY.md Appendix B), edges never
-    updated."""
-    v = u.astype(np.float64)
-    new = v.copy()
-    c = v[1:-1, 1:-1]
-    new[1:-1, 1:-1] = (c
-                       + cx * (v[2:, 1:-1] + v[:-2, 1:-1] - 2.0 * c)
-                       + cy * (v[1:-1, 2:] + v[1:-1, :-2] - 2.0 * c))
+    C usual-arithmetic-conversions semantics (SURVEY.md Appendix B,
+    sharpened by tests/test_c_parity.py): the float neighbor sums uE+uW /
+    uN+uS round in f32, every op touching the double literals CX/CY/2.0
+    runs in double, truncated to f32 on store. Edges never updated."""
+    assert u.dtype == np.float32
+    new = u.astype(np.float64)
+    c = new[1:-1, 1:-1]
+    # sx pairs with cx (axis-0/ix neighbors), sy with cy — reference
+    # convention (CX multiplies the ix neighbors).
+    sx = (u[2:, 1:-1] + u[:-2, 1:-1]).astype(np.float64)  # f32 sum, then up
+    sy = (u[1:-1, 2:] + u[1:-1, :-2]).astype(np.float64)
+    new[1:-1, 1:-1] = c + cx * (sx - 2.0 * c) + cy * (sy - 2.0 * c)
     return new.astype(np.float32)
 
 

@@ -1,0 +1,70 @@
+"""Parity against real C numeric semantics (SURVEY.md Appendix B).
+
+tests/c_oracle.c implements the reference's *behavioral spec* — f32
+storage with each cell update promoted through double (the C promotion of
+the double literals CX/CY/2.0) — compiled fresh with the system compiler.
+The framework's accum_dtype='float64' mode must match it bit-for-bit at
+small grids, proving the promotion mirror is exact and not merely close.
+"""
+
+import shutil
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from heat2d_tpu.config import HeatConfig
+from heat2d_tpu.models.solver import Heat2DSolver
+
+CC = shutil.which("cc") or shutil.which("gcc") or shutil.which("g++")
+
+
+@pytest.fixture(scope="module")
+def c_oracle(tmp_path_factory):
+    if CC is None:
+        pytest.skip("no C compiler")
+    d = tmp_path_factory.mktemp("c_oracle")
+    exe = d / "c_oracle"
+    src = __file__.replace("test_c_parity.py", "c_oracle.c")
+    # -ffp-contract=off: ISO C evaluation (no FMA contraction). gcc's GNU
+    # dialect defaults to contract=fast, which fuses the double multiply-
+    # adds and perturbs results by ~1 f32 ulp vs XLA's uncontracted f64.
+    subprocess.run([CC, "-O2", "-ffp-contract=off", "-o", str(exe), src],
+                   check=True)
+
+    def run(nx, ny, steps, cx=0.1, cy=0.1):
+        out = d / f"out_{nx}x{ny}x{steps}_{cx}_{cy}.bin"
+        subprocess.run([str(exe), str(nx), str(ny), str(steps), str(out),
+                        repr(cx), repr(cy)], check=True)
+        return np.fromfile(out, dtype="<f4").reshape(nx, ny)
+
+    return run
+
+
+@pytest.mark.parametrize("nx,ny,steps", [(10, 10, 100), (16, 24, 57)])
+def test_f64_accum_matches_c_bitwise(c_oracle, nx, ny, steps):
+    ref = c_oracle(nx, ny, steps)
+    cfg = HeatConfig(nxprob=nx, nyprob=ny, steps=steps, mode="serial",
+                     accum_dtype="float64")
+    got = Heat2DSolver(cfg).run(timed=False).u
+    np.testing.assert_array_equal(got, ref)
+
+
+def test_anisotropic_diffusivity_bitwise(c_oracle):
+    # cx != cy: catches any axis/coefficient pairing swap (cx must
+    # multiply the ix-neighbor sum, as in the reference kernels).
+    ref = c_oracle(12, 18, 80, cx=0.15, cy=0.05)
+    cfg = HeatConfig(nxprob=12, nyprob=18, steps=80, cx=0.15, cy=0.05,
+                     mode="serial", accum_dtype="float64")
+    got = Heat2DSolver(cfg).run(timed=False).u
+    np.testing.assert_array_equal(got, ref)
+
+
+def test_f32_close_to_c_at_small_grids(c_oracle):
+    # Appendix B: at small grids (values <= ~2k) the pure-f32 path agrees
+    # with the double-promoted path to tight tolerance.
+    ref = c_oracle(10, 10, 100)
+    cfg = HeatConfig(nxprob=10, nyprob=10, steps=100, mode="serial")
+    got = Heat2DSolver(cfg).run(timed=False).u
+    np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-2)
