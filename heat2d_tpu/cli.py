@@ -47,6 +47,10 @@ def build_parser() -> argparse.ArgumentParser:
                    help="dist1d row-strip count (defaults to --gridx)")
     d.add_argument("--strict-baseline", action="store_true",
                    help="enforce mpi_heat2Dn.c's 3..8 worker range")
+    d.add_argument("--halo-depth", type=int, default=None,
+                   help="wide-halo depth T for distributed modes: one "
+                        "T-deep ghost exchange per T steps (default auto; "
+                        "1 = the reference's per-step exchange)")
     c = p.add_argument_group("convergence")
     c.add_argument("--convergence", action="store_true")
     c.add_argument("--interval", type=int, default=20)
@@ -148,7 +152,8 @@ def main(argv=None) -> int:
             convergence=args.convergence, interval=args.interval,
             sensitivity=args.sensitivity, mode=args.mode,
             accum_dtype=args.accum_dtype, numworkers=args.numworkers,
-            strict_baseline=args.strict_baseline, debug=args.debug)
+            strict_baseline=args.strict_baseline, debug=args.debug,
+            halo_depth=args.halo_depth)
     except ConfigError as e:
         print(f"{e}\nQuitting...", file=sys.stderr)
         return 1

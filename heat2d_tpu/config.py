@@ -60,6 +60,12 @@ class HeatConfig:
 
     # -- execution ----------------------------------------------------------
     mode: str = "serial"
+    # Wide-halo depth T for the distributed modes: each halo exchange
+    # carries a T-deep ghost ring and the shard advances T steps locally
+    # per exchange — 4 ppermutes per T steps instead of 4T (the distributed
+    # analogue of the Pallas temporal blocking). None = auto (8, clamped to
+    # the shard size). 1 reproduces the reference's per-step exchange.
+    halo_depth: Optional[int] = None
     # f64 accumulation mirrors the C reference's promotion of the f32 stencil
     # through double (literals 0.1/2.0 — SURVEY.md Appendix B); f32 is the
     # TPU-fast path. Storage is always float32, as in the reference.
@@ -108,6 +114,8 @@ class HeatConfig:
                     f"({nw} does not divide {self.nxprob})")
         if self.convergence and self.interval < 1:
             raise ConfigError("interval must be >= 1 when convergence is on")
+        if self.halo_depth is not None and self.halo_depth < 1:
+            raise ConfigError("halo_depth must be >= 1 (or None for auto)")
 
     # Convenience views ------------------------------------------------- #
 

@@ -33,25 +33,49 @@ except AttributeError:  # pragma: no cover
 from heat2d_tpu.models import engine
 from heat2d_tpu.ops.init import inidat_block
 from heat2d_tpu.ops.stencil import residual_sq, stencil_step_padded
-from heat2d_tpu.parallel.halo import exchange_halo_2d, pad_with_halo
+from heat2d_tpu.parallel.halo import exchange_halo_2d_wide
+
+#: Default wide-halo depth (config.halo_depth=None): 8 steps per exchange,
+#: clamped to the shard size in make_local_chunk.
+DEFAULT_HALO_DEPTH = 8
 
 
-def _interior_mask(bm, bn, nx, ny, ax, ay):
-    """Boolean (bm, bn): True where this shard's cell is a *global* interior
-    cell (the only cells the reference ever updates — its loop bounds and
-    the CUDA guard grad1612_cuda_heat.cu:58)."""
-    row0 = lax.axis_index(ax) * bm
-    col0 = lax.axis_index(ay) * bn
-    gi = lax.broadcasted_iota(jnp.int32, (bm, bn), 0) + row0
-    gj = lax.broadcasted_iota(jnp.int32, (bm, bn), 1) + col0
-    return ((gi >= 1) & (gi <= nx - 2)) & ((gj >= 1) & (gj <= ny - 2))
+def _keep_mask(shape, nx, ny, row0, col0):
+    """Boolean ``shape`` mask: True where the cell must be KEPT (never
+    updated) — global-boundary cells (the reference's loop bounds / CUDA
+    guard grad1612_cuda_heat.cu:58) and out-of-domain halo cells (gi<0 /
+    gi>nx-1), which stay at their ghost value so edge zeros are firewalled
+    at the boundary. ``row0``/``col0``: global indices of element (0, 0).
+    (Row-only variant lives in ops/pallas_stencil._band_multi_kernel,
+    whose bands span the full grid width.)"""
+    gi = row0 + lax.broadcasted_iota(jnp.int32, shape, 0)
+    gj = col0 + lax.broadcasted_iota(jnp.int32, shape, 1)
+    return (gi <= 0) | (gi >= nx - 1) | (gj <= 0) | (gj >= ny - 1)
 
 
 def make_local_step(config, mesh: Mesh, kernel=None):
-    """Shard-local step: halo exchange -> stencil -> global-boundary mask.
+    """Shard-local single step — the wide-halo chunk at depth 1 (bitwise
+    identical per the depth-parametrized tests; used as the tracked step
+    of the convergence residual pair).
 
-    ``kernel``: optional (padded, cx, cy) -> (bm, bn) stencil implementation
+    ``kernel``: optional (padded, cx, cy) -> (m, n) stencil implementation
     (e.g. the Pallas kernel) replacing the jnp golden model.
+    """
+    chunk = make_local_chunk(config, mesh, kernel=kernel)
+    return lambda u: chunk(u, 1)
+
+
+def make_local_chunk(config, mesh: Mesh, kernel=None):
+    """Shard-local multi-step: ONE wide halo exchange, then T steps in
+    place on the (bm+2T, bn+2T) extended block.
+
+    Halo-depth correctness mirrors the Pallas temporal blocking
+    (ops/pallas_stencil.py): after s local steps the outermost s cells of
+    the extended block are stale; the kept center sits T cells in, and the
+    global clamp mask is applied every internal step so out-of-domain
+    ghost zeros at physical edges are firewalled at the boundary cells
+    (which never update). Returns ``chunk(u, t)`` with static t in
+    [1, min(bm, bn)].
     """
     ax, ay = mesh.axis_names
     gx, gy = (mesh.devices.shape[0], mesh.devices.shape[1])
@@ -60,17 +84,51 @@ def make_local_step(config, mesh: Mesh, kernel=None):
     accum = jnp.dtype(config.accum_dtype)
     cx, cy = config.cx, config.cy
 
-    def local_step(u):
-        halos = exchange_halo_2d(u, ax, ay, gx, gy)
-        padded = pad_with_halo(u, *halos)
-        if kernel is None:
-            new = stencil_step_padded(padded, cx, cy, accum)
-        else:
-            new = kernel(padded, cx, cy)
-        mask = _interior_mask(bm, bn, nx, ny, ax, ay)
-        return jnp.where(mask, new, u)
+    def chunk(u, t):
+        ext = exchange_halo_2d_wide(u, ax, ay, gx, gy, t)
+        keep = _keep_mask((bm + 2 * t, bn + 2 * t), nx, ny,
+                          lax.axis_index(ax) * bm - t,
+                          lax.axis_index(ay) * bn - t)
 
-    return local_step
+        def one(_, v):
+            if kernel is None:
+                newint = stencil_step_padded(v, cx, cy, accum)
+            else:
+                newint = kernel(v, cx, cy)
+            mid = jnp.concatenate([v[1:-1, :1], newint, v[1:-1, -1:]],
+                                  axis=1)
+            full = jnp.concatenate([v[:1, :], mid, v[-1:, :]], axis=0)
+            return jnp.where(keep, v, full)
+
+        ext = lax.fori_loop(0, t, one, ext, unroll=False)
+        return ext[t:-t, t:-t]
+
+    return chunk
+
+
+def effective_halo_depth(config, mesh: Mesh) -> int:
+    gx, gy = (mesh.devices.shape[0], mesh.devices.shape[1])
+    bm, bn = config.nxprob // gx, config.nyprob // gy
+    want = config.halo_depth or DEFAULT_HALO_DEPTH
+    return max(1, min(want, bm, bn))
+
+
+def make_local_multi(config, mesh: Mesh, kernel=None):
+    """``multi(u, n)`` advancing a *static* n steps via wide-halo chunks
+    of depth T plus a remainder chunk."""
+    chunk = make_local_chunk(config, mesh, kernel=kernel)
+    t = effective_halo_depth(config, mesh)
+
+    def multi(u, n):
+        full, rem = divmod(n, t)
+        if full:
+            u = lax.fori_loop(0, full, lambda _, v: chunk(v, t), u,
+                              unroll=False)
+        if rem:
+            u = chunk(u, rem)
+        return u
+
+    return multi
 
 
 def make_sharded_runner(config, mesh: Mesh, kernel=None):
@@ -81,6 +139,7 @@ def make_sharded_runner(config, mesh: Mesh, kernel=None):
     ax, ay = mesh.axis_names
     accum = jnp.dtype(config.accum_dtype)
     local_step = make_local_step(config, mesh, kernel=kernel)
+    local_multi = make_local_multi(config, mesh, kernel=kernel)
     sharding = NamedSharding(mesh, P(ax, ay))
 
     def local_run(u):
@@ -88,11 +147,12 @@ def make_sharded_runner(config, mesh: Mesh, kernel=None):
             def residual(u_new, u_old):
                 return lax.psum(residual_sq(u_new, u_old, accum),
                                 (ax, ay))
-            u, k = engine.run_convergence(
-                local_step, residual, u, config.steps,
+            u, k = engine.run_convergence_chunked(
+                local_multi, local_step, residual, u, config.steps,
                 config.interval, config.sensitivity)
         else:
-            u, k = engine.run_fixed(local_step, u, config.steps)
+            u = local_multi(u, config.steps)
+            k = jnp.asarray(config.steps, jnp.int32)
         return u, k
 
     try:

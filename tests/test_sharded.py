@@ -61,6 +61,37 @@ def test_dist2d_convergence_early_exit_matches_serial():
     np.testing.assert_allclose(result.u, serial.u, rtol=1e-6, atol=1e-4)
 
 
+@pytest.mark.parametrize("depth", [1, 2, 3, 5])
+def test_wide_halo_depths_bitwise(depth):
+    # Odd step count exercises the remainder chunk; depth=1 is the
+    # reference's per-step exchange.
+    nx, ny, steps = 24, 16, 23
+    serial = _serial_result(nx, ny, steps)
+    cfg = HeatConfig(nxprob=nx, nyprob=ny, steps=steps, mode="dist2d",
+                     gridx=4, gridy=2, halo_depth=depth)
+    result = Heat2DSolver(cfg).run(timed=False)
+    np.testing.assert_array_equal(result.u, serial.u)
+
+
+def test_wide_halo_depth_clamped_to_shard():
+    # halo_depth far beyond the shard size must clamp, not crash/corrupt.
+    nx, ny, steps = 16, 16, 12
+    serial = _serial_result(nx, ny, steps)
+    cfg = HeatConfig(nxprob=nx, nyprob=ny, steps=steps, mode="dist2d",
+                     gridx=4, gridy=2, halo_depth=100)
+    result = Heat2DSolver(cfg).run(timed=False)
+    np.testing.assert_array_equal(result.u, serial.u)
+
+
+def test_wide_halo_hybrid_kernel_bitwise():
+    nx, ny, steps = 16, 32, 9
+    serial = _serial_result(nx, ny, steps)
+    cfg = HeatConfig(nxprob=nx, nyprob=ny, steps=steps, mode="hybrid",
+                     gridx=2, gridy=2, halo_depth=3)
+    result = Heat2DSolver(cfg).run(timed=False)
+    np.testing.assert_array_equal(result.u, serial.u)
+
+
 def test_uneven_divisor_rejected():
     with pytest.raises(Exception, match="divide"):
         HeatConfig(nxprob=10, nyprob=10, mode="dist1d", numworkers=3)
