@@ -50,6 +50,15 @@ REF_BEST_S = {(80, 64): 9.30e-3, (160, 128): 2.91e-2, (320, 256): 1.04e-1,
               (640, 512): 2.13e-1, (1280, 1024): 2.52e-1, (2560, 2048): 5.18e-1}
 REF_CUDA_MCELLS = {(1280, 1024): 705.0, (2560, 2048): 669.0}
 
+# Tables 4/6 (convergence-enabled build; note the reference's check fires
+# every iteration at these grids, not every INTERVAL — BASELINE.md caveat):
+REF_CONV_SERIAL_S = {(80, 64): 3.33e-2, (160, 128): 1.24e-1,
+                     (320, 256): 8.51e-1, (640, 512): 3.39,
+                     (1280, 1024): 15.8, (2560, 2048): 62.9}
+REF_CONV_BEST_S = {(80, 64): 2.06e-1, (160, 128): 2.49e-1,
+                   (320, 256): 2.29e-1, (640, 512): 2.42e-1,
+                   (1280, 1024): 2.63e-1, (2560, 2048): 4.80e-1}
+
 
 def run_point(mode, nx, ny, steps, gridx=1, gridy=1, convergence=False):
     from heat2d_tpu.config import HeatConfig
@@ -65,13 +74,17 @@ def run_point(mode, nx, ny, steps, gridx=1, gridy=1, convergence=False):
         "elapsed_s": round(result.elapsed, 6),
         "mcells_per_s": round(result.mcells_per_s, 2),
     }
-    ref_s = REF_SERIAL_S.get((nx, ny))
+    if convergence:
+        rec["convergence"] = True
+    ref_serial = REF_CONV_SERIAL_S if convergence else REF_SERIAL_S
+    ref_best = REF_CONV_BEST_S if convergence else REF_BEST_S
+    ref_s = ref_serial.get((nx, ny))
     if ref_s is not None and steps == 100:
         rec["ref_serial_s"] = ref_s
         rec["speedup_vs_ref_serial"] = round(ref_s / result.elapsed, 2)
-        rec["ref_best_160task_s"] = REF_BEST_S[(nx, ny)]
+        rec["ref_best_160task_s"] = ref_best[(nx, ny)]
         rec["speedup_vs_ref_best"] = round(
-            REF_BEST_S[(nx, ny)] / result.elapsed, 2)
+            ref_best[(nx, ny)] / result.elapsed, 2)
     ref_mc = REF_CUDA_MCELLS.get((nx, ny))
     if ref_mc is not None:
         rec["ref_cuda_mcells_per_s"] = ref_mc
@@ -95,6 +108,17 @@ def suite_chip(steps, quick):
     for nx, ny in sizes:
         for mode in ("serial", "pallas"):
             yield dict(mode=mode, nx=nx, ny=ny, steps=steps)
+
+
+def suite_conv(steps, quick):
+    """Convergence-enabled sweep — the Tables 4-6 analogue, on the
+    *intended* every-INTERVAL schedule (the reference's actual build
+    checked every iteration at its measured grids; BASELINE.md caveat)."""
+    sizes = REF_SIZES[:2] if quick else REF_SIZES
+    for nx, ny in sizes:
+        for mode in ("serial", "pallas"):
+            yield dict(mode=mode, nx=nx, ny=ny, steps=steps,
+                       convergence=True)
 
 
 def suite_mesh(steps, quick, n_devices):
@@ -140,7 +164,8 @@ def to_markdown(records, platform):
 
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(description=__doc__.split("\n")[0])
-    p.add_argument("--suite", default="chip", choices=["chip", "mesh"])
+    p.add_argument("--suite", default="chip",
+                   choices=["chip", "mesh", "conv"])
     p.add_argument("--steps", type=int, default=100,
                    help="reference default (grad1612_mpi_heat.c:7)")
     p.add_argument("--quick", action="store_true")
@@ -160,6 +185,8 @@ def main(argv=None) -> int:
 
     if args.suite == "chip":
         points = list(suite_chip(args.steps, args.quick))
+    elif args.suite == "conv":
+        points = list(suite_conv(args.steps, args.quick))
     else:
         points = list(suite_mesh(args.steps, args.quick, len(devs)))
 

@@ -67,3 +67,65 @@ def test_cli_checkpoint_resume(tmp_path):
                "--steps", "100", "--outdir", str(tmp_path / "c")])
     straight = read_grid_text(tmp_path / "c" / "final.dat", "rowmajor")
     np.testing.assert_array_equal(resumed, straight)
+
+
+def test_cli_periodic_checkpoints(tmp_path):
+    """--checkpoint-every: restart points land every K steps and the final
+    grid is byte-identical to an unsegmented run."""
+    from heat2d_tpu.io import load_checkpoint
+
+    ck = tmp_path / "ck.bin"
+    rc = main(["--mode", "serial", "--steps", "50", "--outdir",
+               str(tmp_path / "a"), "--checkpoint", str(ck),
+               "--checkpoint-every", "20"])
+    assert rc == 0
+    grid, step, _cfg = load_checkpoint(str(ck))
+    assert step == 50  # final segment (20+20+10) checkpointed last
+
+    rc = main(["--mode", "serial", "--steps", "50",
+               "--outdir", str(tmp_path / "b")])
+    assert rc == 0
+    a = (tmp_path / "a" / "final.dat").read_bytes()
+    b = (tmp_path / "b" / "final.dat").read_bytes()
+    assert a == b
+
+
+def test_cli_periodic_checkpoint_resume_roundtrip(tmp_path):
+    """A run resumed from a segmented run's restart point must end
+    byte-identical to a straight unsegmented run."""
+    from heat2d_tpu.io import load_checkpoint
+
+    ck = tmp_path / "ck.bin"
+    main(["--mode", "serial", "--steps", "60", "--outdir",
+          str(tmp_path / "x"), "--checkpoint", str(ck),
+          "--checkpoint-every", "25"])
+    _, step, _ = load_checkpoint(str(ck))
+    assert step == 60  # segments 25+25+10
+    rc = main(["--mode", "serial", "--steps", "100", "--resume", str(ck),
+               "--outdir", str(tmp_path / "y")])
+    assert rc == 0
+    main(["--mode", "serial", "--steps", "100",
+          "--outdir", str(tmp_path / "z")])
+    assert ((tmp_path / "y" / "final.dat").read_bytes()
+            == (tmp_path / "z" / "final.dat").read_bytes())
+
+
+def test_cli_checkpoint_every_requires_aligned_interval(tmp_path, capsys):
+    rc = main(["--mode", "serial", "--steps", "100", "--convergence",
+               "--interval", "20", "--checkpoint-every", "30",
+               "--checkpoint", str(tmp_path / "ck.bin"),
+               "--outdir", str(tmp_path)])
+    assert rc == 1
+    assert "multiple of" in capsys.readouterr().err
+
+
+def test_cli_run_record_has_device_context(tmp_path):
+    rec_path = tmp_path / "rec.json"
+    rc = main(["--mode", "dist2d", "--gridx", "2", "--gridy", "2",
+               "--nxprob", "16", "--nyprob", "16", "--steps", "5",
+               "--outdir", str(tmp_path),
+               "--run-record", str(rec_path)])
+    assert rc == 0
+    rec = json.loads(rec_path.read_text())
+    assert rec["device"]["n_devices"] >= 4
+    assert rec["mesh"]["mesh_shape"] == {"x": 2, "y": 2}
