@@ -121,6 +121,31 @@ def suite_conv(steps, quick):
                        convergence=True)
 
 
+def suite_scaling(steps, quick, n_devices):
+    """Strong scaling at fixed global size — the Tables 2-3 analogue
+    (speedup/efficiency vs the 1-device run), over power-of-two device
+    counts up to what is attached."""
+    nx, ny = (320, 256) if quick else (2560, 2048)
+    n = 1
+    while n <= n_devices:
+        gx, gy = mesh_shapes(n)[0]
+        yield dict(mode="dist2d", nx=nx, ny=ny, steps=steps,
+                   gridx=gx, gridy=gy)
+        n *= 2
+
+
+def add_scaling_columns(records):
+    """Post-pass: speedup vs the 1-device row and parallel efficiency."""
+    base = next((r["elapsed_s"] for r in records if r["mesh"] == "1x1"),
+                None)
+    for r in records:
+        gx, gy = map(int, r["mesh"].split("x"))
+        if base:
+            r["speedup_vs_1dev"] = round(base / r["elapsed_s"], 2)
+            r["efficiency"] = round(base / r["elapsed_s"] / (gx * gy), 3)
+    return records
+
+
 def suite_mesh(steps, quick, n_devices):
     sizes = REF_SIZES[:2] if quick else REF_SIZES
     for nx, ny in sizes:
@@ -140,6 +165,8 @@ def suite_mesh(steps, quick, n_devices):
 
 
 def to_markdown(records, platform):
+    scaling = any("speedup_vs_1dev" in r for r in records)
+    extra_hdr = " speedup vs 1 dev | efficiency |" if scaling else ""
     lines = [
         f"# heat2d-tpu sweep ({platform})", "",
         "Reference columns from Report.pdf via BASELINE.md; all runs "
@@ -148,24 +175,29 @@ def to_markdown(records, platform):
         f"{platform}.", "",
         "| mode | grid | mesh | steps | elapsed (s) | Mcells/s | "
         "ref serial (s) | speedup vs ref serial | vs ref best (160 tasks) | "
-        "vs ref CUDA |",
-        "|---|---|---|---|---|---|---|---|---|---|",
+        f"vs ref CUDA |{extra_hdr}",
+        "|---|---|---|---|---|---|---|---|---|---|"
+        + ("---|---|" if scaling else ""),
     ]
     for r in records:
-        lines.append(
+        row = (
             f"| {r['mode']} | {r['grid']} | {r['mesh']} | {r['steps']} "
             f"| {r['elapsed_s']:.4g} | {r['mcells_per_s']:.4g} "
             f"| {r.get('ref_serial_s', '—')} "
             f"| {r.get('speedup_vs_ref_serial', '—')} "
             f"| {r.get('speedup_vs_ref_best', '—')} "
             f"| {r.get('vs_ref_cuda', '—')} |")
+        if scaling:
+            row += (f" {r.get('speedup_vs_1dev', '—')} "
+                    f"| {r.get('efficiency', '—')} |")
+        lines.append(row)
     return "\n".join(lines) + "\n"
 
 
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(description=__doc__.split("\n")[0])
     p.add_argument("--suite", default="chip",
-                   choices=["chip", "mesh", "conv"])
+                   choices=["chip", "mesh", "conv", "scaling"])
     p.add_argument("--steps", type=int, default=100,
                    help="reference default (grad1612_mpi_heat.c:7)")
     p.add_argument("--quick", action="store_true")
@@ -187,6 +219,8 @@ def main(argv=None) -> int:
         points = list(suite_chip(args.steps, args.quick))
     elif args.suite == "conv":
         points = list(suite_conv(args.steps, args.quick))
+    elif args.suite == "scaling":
+        points = list(suite_scaling(args.steps, args.quick, len(devs)))
     else:
         points = list(suite_mesh(args.steps, args.quick, len(devs)))
 
@@ -200,6 +234,9 @@ def main(argv=None) -> int:
         print(json.dumps(rec))
         print(f"  [{time.perf_counter() - t0:.1f}s incl. compile]",
               file=sys.stderr)
+
+    if args.suite == "scaling":
+        add_scaling_columns(records)
 
     os.makedirs(args.outdir, exist_ok=True)
     tag = f"{args.suite}{'_quick' if args.quick else ''}"

@@ -145,6 +145,8 @@ def _run_with_periodic_checkpoints(solver, u0, cfg, args, start_step,
     from heat2d_tpu.models.solver import Heat2DSolver, RunResult
 
     k = args.checkpoint_every
+    if k < 1:
+        raise ConfigError(f"--checkpoint-every must be >= 1, got {k}")
     if solver.config.convergence and k % solver.config.interval:
         raise ConfigError(
             f"--checkpoint-every ({k}) must be a multiple of --interval "
@@ -166,8 +168,14 @@ def _run_with_periodic_checkpoints(solver, u0, cfg, args, start_step,
             save_checkpoint(r.u, start_step + done, cfg, args.checkpoint)
         if r.steps_done < n:  # converged early inside the segment
             break
-        u = seg.place(r.u)
-    final_u = r.u if r is not None else solver.run(u0=u0, timed=False).u
+        if done < total:  # re-place only while another segment remains
+            u = seg.place(r.u)
+    if r is not None:
+        final_u = r.u
+    else:  # zero remaining steps: still honor --checkpoint
+        final_u = solver.run(u0=u0, timed=False).u
+        if primary:
+            save_checkpoint(final_u, start_step, cfg, args.checkpoint)
     return RunResult(u=final_u, steps_done=done,
                      elapsed=elapsed, config=solver.config)
 
@@ -282,7 +290,11 @@ def main(argv=None) -> int:
         try:
             from heat2d_tpu.utils.profiling import profile_span
             with profile_span(args.profile):
-                if args.checkpoint_every and args.checkpoint:
+                if args.checkpoint_every:
+                    if not args.checkpoint:
+                        raise ConfigError(
+                            "--checkpoint-every requires --checkpoint "
+                            "(the path the restart points are written to)")
                     result = _run_with_periodic_checkpoints(
                         solver, u0, cfg, args, start_step, primary)
                 else:

@@ -105,13 +105,9 @@ class HeatConfig:
                 # mpi_heat2Dn.c:72-78 (MINWORKER=3, MAXWORKER=8)
                 raise ConfigError(
                     "ERROR: the number of tasks must be between 4 and 9.")
-            if self.nxprob % nw:
-                # The reference handles uneven strips (averow/extra,
-                # mpi_heat2Dn.c:89-94); the sharded engine requires equal
-                # shards for now, so reject up front.
-                raise ConfigError(
-                    f"dist1d requires numworkers to divide nxprob "
-                    f"({nw} does not divide {self.nxprob})")
+            # Uneven strips are allowed, as in the reference (averow/extra,
+            # mpi_heat2Dn.c:89-94): the engine pads to equal shards and the
+            # pad rows sit inert outside the boundary mask.
         if self.convergence and self.interval < 1:
             raise ConfigError("interval must be >= 1 when convergence is on")
         if self.halo_depth is not None and self.halo_depth < 1:

@@ -97,9 +97,15 @@ class Heat2DSolver:
     def place(self, u):
         """Device-put a host grid with this solver's sharding (the
         device_put-with-NamedSharding analogue of the reference's work
-        distribution, mpi_heat2Dn.c:107-112)."""
+        distribution, mpi_heat2Dn.c:107-112). Pads to equal shards when
+        the mesh does not divide the grid (the averow/extra analogue)."""
         if self.mesh is not None:
             from jax.sharding import NamedSharding, PartitionSpec as P
+            from heat2d_tpu.parallel.sharded import padded_global_shape
+            pnx, pny = padded_global_shape(self.config, self.mesh)
+            u = np.asarray(u)
+            if (pnx, pny) != u.shape:
+                u = np.pad(u, ((0, pnx - u.shape[0]), (0, pny - u.shape[1])))
             ax, ay = self.mesh.axis_names
             return jax.device_put(u, NamedSharding(self.mesh, P(ax, ay)))
         return jax.device_put(u)
@@ -169,5 +175,9 @@ class Heat2DSolver:
             # modes under multihost) convert directly.
             from jax.experimental import multihost_utils
             u = multihost_utils.process_allgather(u, tiled=True)
-        return RunResult(u=np.asarray(u), steps_done=int(k),
+        u = np.asarray(u)
+        if u.shape != self.config.shape:
+            # Strip the equal-shard padding (uneven decomposition).
+            u = u[:self.config.nxprob, :self.config.nyprob]
+        return RunResult(u=u, steps_done=int(k),
                          elapsed=elapsed, config=self.config)

@@ -40,6 +40,19 @@ from heat2d_tpu.parallel.halo import exchange_halo_2d_wide
 DEFAULT_HALO_DEPTH = 8
 
 
+def padded_global_shape(config, mesh: Mesh) -> tuple[int, int]:
+    """Global shape padded up so every shard is equal-sized — the TPU
+    answer to the reference's uneven averow/extra strips
+    (mpi_heat2Dn.c:89-94): instead of first-k-shards-get-one-extra-row,
+    pad to the next multiple and let the out-of-domain rows sit inert
+    (they are outside the keep-mask's interior, never update, stay 0, and
+    contribute 0 to the convergence residual)."""
+    gx, gy = (mesh.devices.shape[0], mesh.devices.shape[1])
+    pnx = -(-config.nxprob // gx) * gx
+    pny = -(-config.nyprob // gy) * gy
+    return pnx, pny
+
+
 def _keep_mask(shape, nx, ny, row0, col0):
     """Boolean ``shape`` mask: True where the cell must be KEPT (never
     updated) — global-boundary cells (the reference's loop bounds / CUDA
@@ -79,8 +92,9 @@ def make_local_chunk(config, mesh: Mesh, kernel=None):
     """
     ax, ay = mesh.axis_names
     gx, gy = (mesh.devices.shape[0], mesh.devices.shape[1])
-    nx, ny = config.nxprob, config.nyprob
-    bm, bn = nx // gx, ny // gy
+    nx, ny = config.nxprob, config.nyprob   # true domain (masks use these)
+    pnx, pny = padded_global_shape(config, mesh)
+    bm, bn = pnx // gx, pny // gy
     accum = jnp.dtype(config.accum_dtype)
     cx, cy = config.cx, config.cy
 
@@ -108,7 +122,8 @@ def make_local_chunk(config, mesh: Mesh, kernel=None):
 
 def effective_halo_depth(config, mesh: Mesh) -> int:
     gx, gy = (mesh.devices.shape[0], mesh.devices.shape[1])
-    bm, bn = config.nxprob // gx, config.nyprob // gy
+    pnx, pny = padded_global_shape(config, mesh)
+    bm, bn = pnx // gx, pny // gy
     want = config.halo_depth or DEFAULT_HALO_DEPTH
     return max(1, min(want, bm, bn))
 
@@ -178,12 +193,17 @@ def sharded_inidat(config, mesh: Mesh):
     ax, ay = mesh.axis_names
     gx, gy = (mesh.devices.shape[0], mesh.devices.shape[1])
     nx, ny = config.nxprob, config.nyprob
-    bm, bn = nx // gx, ny // gy
+    pnx, pny = padded_global_shape(config, mesh)
+    bm, bn = pnx // gx, pny // gy
 
     def local_init():
         x0 = lax.axis_index(ax) * bm
         y0 = lax.axis_index(ay) * bn
-        return inidat_block((bm, bn), nx, ny, x0, y0)
+        val = inidat_block((bm, bn), nx, ny, x0, y0)
+        # Out-of-domain pad cells (uneven shards) hold 0 forever.
+        gi = x0 + lax.broadcasted_iota(jnp.int32, (bm, bn), 0)
+        gj = y0 + lax.broadcasted_iota(jnp.int32, (bm, bn), 1)
+        return jnp.where((gi < nx) & (gj < ny), val, 0.0)
 
     fn = jax.jit(shard_map(local_init, mesh=mesh, in_specs=(),
                            out_specs=P(ax, ay)))

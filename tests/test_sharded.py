@@ -92,9 +92,25 @@ def test_wide_halo_hybrid_kernel_bitwise():
     np.testing.assert_array_equal(result.u, serial.u)
 
 
-def test_uneven_divisor_rejected():
-    with pytest.raises(Exception, match="divide"):
-        HeatConfig(nxprob=10, nyprob=10, mode="dist1d", numworkers=3)
+@pytest.mark.parametrize("nw", [3, 6, 7])
+def test_uneven_row_strips_bitwise(nw):
+    """The reference's averow/extra uneven strips (mpi_heat2Dn.c:89-94) as
+    pad-to-multiple: 10 rows over 3/6/7 workers, bitwise vs serial —
+    including the reference's own default 10x10 config on 3 workers."""
+    nx, ny, steps = 10, 10, 100
+    serial = _serial_result(nx, ny, steps)
+    cfg = HeatConfig(nxprob=nx, nyprob=ny, steps=steps, mode="dist1d",
+                     numworkers=nw)
+    result = Heat2DSolver(cfg).run(timed=False)
+    assert result.u.shape == (nx, ny)
+    np.testing.assert_array_equal(result.u, serial.u)
+
+
+def test_uneven_2d_still_rejected():
+    # grad1612_mpi_heat.c:60-64 enforces divisibility for the 2D program;
+    # parity keeps that validation for dist2d/hybrid.
+    with pytest.raises(Exception, match="not an integer"):
+        HeatConfig(nxprob=10, nyprob=10, mode="dist2d", gridx=3, gridy=2)
 
 
 def test_halo_exchange_zero_fill_edges():

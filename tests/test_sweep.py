@@ -42,3 +42,14 @@ def test_suite_mesh_respects_divisibility():
     assert any(pt["mode"] == "hybrid" for pt in pts)
     assert any(pt["mode"] == "dist1d" for pt in pts)
     assert any(pt["mode"] == "dist2d" for pt in pts)
+
+
+def test_scaling_suite_and_columns():
+    pts = list(sweep.suite_scaling(10, quick=True, n_devices=8))
+    assert [p["gridx"] * p["gridy"] for p in pts] == [1, 2, 4, 8]
+    recs = [{"mesh": f"{p['gridx']}x{p['gridy']}", "elapsed_s": 1.0 / (i + 1)}
+            for i, p in enumerate(pts)]
+    sweep.add_scaling_columns(recs)
+    assert recs[0]["speedup_vs_1dev"] == 1.0
+    assert recs[3]["speedup_vs_1dev"] == 4.0
+    assert recs[3]["efficiency"] == 0.5
