@@ -121,9 +121,18 @@ def _band_kernel(up_ref, u_ref, dn_ref, out_ref, *, bm, nx, ny, cx, cy):
 
 
 def pick_band_rows(nx: int, ny: int, dtype=jnp.float32,
-                   target_bytes: int = 2 * 1024 * 1024) -> int:
-    """Largest divisor of nx whose (bm, ny) band fits the target size."""
+                   target_bytes: int | None = None) -> int:
+    """Largest divisor of nx whose (bm, ny) band fits the target size.
+
+    The target shrinks for wide grids: the kernel's VMEM working set is
+    several band-sized buffers plus per-step temporaries of the extended
+    block, all proportional to the row size. Empirical envelope on v5e:
+    2 MB bands compile at ny=4096 but not at ny=8192, where 1 MB bands
+    do — hence the halved target once rows exceed 16 KB.
+    """
     row_bytes = ny * jnp.dtype(dtype).itemsize
+    if target_bytes is None:
+        target_bytes = (1 if row_bytes > 16 * 1024 else 2) * 1024 * 1024
     cap = max(1, target_bytes // row_bytes)
     best = 1
     for bm in range(1, nx + 1):

@@ -79,6 +79,9 @@ def test_pick_band_rows():
     assert pick_band_rows(4096, 4096) == 128      # 2MB / 16KB rows
     assert 4096 % pick_band_rows(4096, 4096) == 0
     assert pick_band_rows(10, 10) == 10           # tiny grid: one band
+    # Wide grids (rows > 16KB) halve the target: 1MB / 32KB rows. The
+    # empirical v5e VMEM envelope — 2MB bands fail to compile at ny=8192.
+    assert pick_band_rows(8192, 8192) == 32
 
 
 def test_fits_vmem():
