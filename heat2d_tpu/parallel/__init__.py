@@ -1,9 +1,8 @@
 from heat2d_tpu.parallel.mesh import make_mesh, mesh_devices_summary
 from heat2d_tpu.parallel.halo import (
+    exchange_halo_2d_wide,
     shift_from_lower,
     shift_from_upper,
-    exchange_halo_2d,
-    pad_with_halo,
 )
 from heat2d_tpu.parallel.sharded import (
     make_local_step,
@@ -16,8 +15,7 @@ __all__ = [
     "mesh_devices_summary",
     "shift_from_lower",
     "shift_from_upper",
-    "exchange_halo_2d",
-    "pad_with_halo",
+    "exchange_halo_2d_wide",
     "make_local_step",
     "make_sharded_runner",
     "sharded_inidat",

@@ -47,22 +47,6 @@ def shift_from_upper(x, axis_name: str, axis_size: int):
     return lax.ppermute(x, axis_name, perm)
 
 
-def exchange_halo_2d(u, ax: str, ay: str, gx: int, gy: int):
-    """4-neighbor halo exchange for a (bm, bn) shard.
-
-    Returns (north, south, west, east) ghost strips: ``north`` is the
-    neighbor-above's bottom row (shape (1, bn)), ``west`` the left
-    neighbor's rightmost column (shape (bm, 1)), etc. Edge shards receive
-    zeros (PROC_NULL semantics). The 5-point stencil needs no corner
-    ghosts, matching the reference's 4-message protocol.
-    """
-    north = shift_from_lower(u[-1:, :], ax, gx)   # from row-neighbor above
-    south = shift_from_upper(u[:1, :], ax, gx)    # from row-neighbor below
-    west = shift_from_lower(u[:, -1:], ay, gy)    # from column-neighbor left
-    east = shift_from_upper(u[:, :1], ay, gy)     # from column-neighbor right
-    return north, south, west, east
-
-
 def exchange_halo_2d_wide(u, ax: str, ay: str, gx: int, gy: int, t: int):
     """T-deep halo exchange: returns the (bm+2t, bn+2t) extended block.
 
@@ -86,18 +70,3 @@ def exchange_halo_2d_wide(u, ax: str, ay: str, gx: int, gy: int, t: int):
     west = shift_from_lower(vert[:, -t:], ay, gy)
     east = shift_from_upper(vert[:, :t], ay, gy)
     return jnp.concatenate([west, vert, east], axis=1)
-
-
-def pad_with_halo(u, north, south, west, east):
-    """Assemble the reference's (xcell+2)×(ycell+2) halo'd block
-    (grad1612_mpi_heat.c:50-52) functionally: shard interior surrounded by
-    the four ghost strips, zero corners (never read by a 5-point stencil).
-    """
-    bm, bn = u.shape
-    padded = jnp.zeros((bm + 2, bn + 2), u.dtype)
-    padded = padded.at[1:-1, 1:-1].set(u)
-    padded = padded.at[0:1, 1:-1].set(north)
-    padded = padded.at[-1:, 1:-1].set(south)
-    padded = padded.at[1:-1, 0:1].set(west)
-    padded = padded.at[1:-1, -1:].set(east)
-    return padded

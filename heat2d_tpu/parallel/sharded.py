@@ -18,8 +18,6 @@ never leaves the devices until I/O.
 
 from __future__ import annotations
 
-import functools
-
 import jax
 import jax.numpy as jnp
 from jax import lax
