@@ -158,10 +158,13 @@ def _run_with_periodic_checkpoints(solver, u0, cfg, args, start_step,
     r = None
     while done < total:
         n = min(k, total - done)
-        if n not in seg_solvers:
+        # Warm up (untimed priming run) only the first time each distinct
+        # segment length executes; repeats reuse the compiled runner.
+        fresh = n not in seg_solvers
+        if fresh:
             seg_solvers[n] = Heat2DSolver(solver.config.replace(steps=n))
         seg = seg_solvers[n]
-        r = seg.run(u0=u)  # r.u is host-side (solver.run gathers)
+        r = seg.run(u0=u, warmup=fresh)
         done += r.steps_done
         elapsed += r.elapsed
         if primary:
@@ -281,7 +284,9 @@ def main(argv=None) -> int:
 
     try:
         os.makedirs(args.outdir, exist_ok=True)
-        u0_host = to_host(u0)
+        # Crop equal-shard padding (uneven decompositions / resume re-place)
+        # so initial dumps match the problem domain like final.dat does.
+        u0_host = to_host(u0)[:cfg.nxprob, :cfg.nyprob]
         write_dat(u0_host, "initial.dat")
         if args.binary_dumps and primary:
             write_binary(u0_host,

@@ -37,6 +37,25 @@ def test_cli_dist2d_run(tmp_path):
     assert final.shape == (16, 16)
 
 
+def test_cli_uneven_dist1d_initial_dump_cropped(tmp_path):
+    """Uneven decomposition (10 rows over 3 workers pads to 12): both
+    dumps must still be the problem domain, not the padded shard shape
+    (ADVICE r1 medium: initial.dat used to carry the pad rows)."""
+    rc = main(["--mode", "dist1d", "--numworkers", "3",
+               "--nxprob", "10", "--nyprob", "10", "--steps", "10",
+               "--outdir", str(tmp_path), "--binary-dumps"])
+    assert rc == 0
+    initial = read_grid_text(tmp_path / "initial.dat", "rowmajor")
+    final = read_grid_text(tmp_path / "final.dat", "rowmajor")
+    assert initial.shape == (10, 10)
+    assert final.shape == (10, 10)
+    bi = read_binary(tmp_path / "initial_binary.dat", (10, 10))
+    assert bi.shape == (10, 10)
+    # the binary initial dump must be the true initial condition
+    from heat2d_tpu.ops.init import inidat
+    np.testing.assert_array_equal(bi, np.asarray(inidat(10, 10)))
+
+
 def test_cli_baseline_layout(tmp_path):
     rc = main(["--mode", "serial", "--dat-layout", "baseline",
                "--outdir", str(tmp_path)])
