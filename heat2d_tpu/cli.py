@@ -305,6 +305,8 @@ def main(argv=None) -> int:
                 else:
                     result = solver.run(u0=u0)
         except ConfigError as e:
+            # Includes kernel-level fast-fails (the VMEM working-set
+            # check) — reported actionably instead of a traceback.
             print(f"{e}\nQuitting...", file=sys.stderr)
             return 1
 

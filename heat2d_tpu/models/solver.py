@@ -110,15 +110,16 @@ class Heat2DSolver:
             return jax.device_put(u, NamedSharding(self.mesh, P(ax, ay)))
         return jax.device_put(u)
 
-    def _kernel(self):
-        if self.config.mode in ("pallas", "hybrid"):
+    def _chunk_kernel(self):
+        if self.config.mode == "hybrid":
             try:
-                from heat2d_tpu.ops.pallas_stencil import make_padded_kernel
+                from heat2d_tpu.ops.pallas_stencil import (
+                    make_shard_chunk_kernel)
             except ImportError as e:
                 raise ConfigError(
                     f"mode {self.config.mode!r} needs the Pallas kernel, "
                     f"which failed to import: {e}") from e
-            return make_padded_kernel(self.config)
+            return make_shard_chunk_kernel(self.config)
         return None
 
     def make_runner(self):
@@ -128,7 +129,7 @@ class Heat2DSolver:
         cfg = self.config
         if self.mesh is not None:
             self._runner, self._sharding = make_sharded_runner(
-                cfg, self.mesh, kernel=self._kernel())
+                cfg, self.mesh, chunk_kernel=self._chunk_kernel())
             return self._runner
 
         accum = jnp.dtype(cfg.accum_dtype)

@@ -40,6 +40,7 @@ try:
 except ImportError:  # pragma: no cover
     pltpu = None
 
+from heat2d_tpu.config import ConfigError
 from heat2d_tpu.models import engine
 from heat2d_tpu.ops.stencil import residual_sq
 
@@ -71,6 +72,21 @@ def _step_value(u, cx, cy):
     new = (k0 * c
            + cx * (u[2:, 1:-1] + u[:-2, 1:-1])
            + cy * (u[1:-1, 2:] + u[1:-1, :-2]))
+    mid = jnp.concatenate([u[1:-1, :1], new, u[1:-1, -1:]], axis=1)
+    return jnp.concatenate([u[:1, :], mid, u[-1:, :]], axis=0)
+
+
+def _step_value_literal(u, cx, cy):
+    """One clamped-boundary step, literal reference expression
+    ``c + cx*(N+S-2c) + cy*(E+W-2c)`` (grad1612_cuda_heat.cu:59-61) — the
+    all-f32 evaluation order of ops.stencil._laplacian_update, so shard
+    kernels using it stay BITWISE identical to the golden jnp path (the
+    hybrid-vs-serial tests assert exact equality; mode='pallas' uses the
+    faster FMA factoring in _step_value instead)."""
+    c = u[1:-1, 1:-1]
+    new = (c
+           + cx * ((u[2:, 1:-1] + u[:-2, 1:-1]) - 2.0 * c)
+           + cy * ((u[1:-1, 2:] + u[1:-1, :-2]) - 2.0 * c))
     mid = jnp.concatenate([u[1:-1, :1], new, u[1:-1, -1:]], axis=1)
     return jnp.concatenate([u[:1, :], mid, u[-1:, :]], axis=0)
 
@@ -111,9 +127,7 @@ def multi_step_vmem(u, steps: int, cx: float, cy: float):
 
 def _band_kernel(up_ref, u_ref, dn_ref, out_ref, *, bm, nx, ny, cx, cy):
     i = pl.program_id(0)
-    up = up_ref[:].reshape(1, ny)   # strips ride as (1, 1, ny) blocks
-    dn = dn_ref[:].reshape(1, ny)
-    ext = jnp.concatenate([up, u_ref[:], dn], axis=0)
+    ext = jnp.concatenate([up_ref[0], u_ref[:], dn_ref[0]], axis=0)
     c = ext[1:-1, :]                       # the band itself, (bm, ny)
     north = ext[:-2, :]
     south = ext[2:, :]
@@ -126,67 +140,133 @@ def _band_kernel(up_ref, u_ref, dn_ref, out_ref, *, bm, nx, ny, cx, cy):
     # Global first/last row are boundary: keep (CUDA guard ix>0 && ix<NX-1,
     # grad1612_cuda_heat.cu:58).
     gi = i * bm + lax.broadcasted_iota(jnp.int32, (bm, 1), 0)
-    keep = (gi == 0) | (gi == nx - 1)
+    # >= nx-1 (not ==) also holds plan_bands pad rows inert at zero, the
+    # same invariant kernels C/D keep.
+    keep = (gi == 0) | (gi >= nx - 1)
     out_ref[:] = jnp.where(keep, c, new)
 
 
-def pick_band_rows(nx: int, ny: int, dtype=jnp.float32,
-                   target_bytes: int | None = None) -> int:
-    """Largest divisor of nx whose (bm, ny) band fits the target size.
+def plan_bands(nrows: int, ny: int, dtype=jnp.float32,
+               target_bytes: int | None = None) -> tuple[int, int]:
+    """Band plan for ``nrows`` rows of ``ny`` cells: (bm, padded_nrows).
 
-    The target shrinks for wide grids: the kernel's VMEM working set is
-    several band-sized buffers plus per-step temporaries of the extended
-    block, all proportional to the row size. Empirical envelope on v5e:
-    2 MB bands compile at ny=4096 but not at ny=8192, where 1 MB bands
-    do — hence the halved target once rows exceed 16 KB.
+    ``bm`` is the band height; rows pad up to ``padded_nrows`` (a bm
+    multiple) with inert out-of-domain rows so divisor-poor row counts
+    (prime/odd nx, or a shard's nx+2T extended block) keep a full-depth
+    band instead of collapsing to single-row programs — the same
+    pad-to-multiple answer the sharded path gives uneven decompositions
+    (parallel/sharded.padded_global_shape). bm is 8-aligned (the Mosaic
+    sublane rule: block dims must divide (8, 128) or equal the array's)
+    unless the whole array is one band.
+
+    The byte target shrinks for wide grids: the kernel's VMEM working set
+    is several band-sized buffers plus per-step temporaries, all
+    proportional to the row size. Empirical envelope on v5e: 2 MB bands
+    compile at ny=4096 but not at ny=8192, where 1 MB bands do — hence
+    the halved target once rows exceed 16 KB.
     """
     row_bytes = ny * jnp.dtype(dtype).itemsize
     if target_bytes is None:
         target_bytes = (1 if row_bytes > 16 * 1024 else 2) * 1024 * 1024
     cap = max(1, target_bytes // row_bytes)
-    best = 1
-    for bm in range(1, nx + 1):
-        if nx % bm == 0 and bm <= cap:
-            best = bm
-    return best
+    if cap >= nrows:
+        return nrows, nrows          # whole array is a single band
+    bm = max(8, (cap // 8) * 8)
+    return bm, -(-nrows // bm) * bm
 
 
-def band_step(u, cx: float, cy: float, bm: int | None = None):
-    """One time step of an HBM-resident grid via a row-band program grid."""
-    nx, ny = u.shape
+def _resolve_bands(m: int, n: int, dtype, bm: int | None) -> tuple[int, int]:
+    """(bm, m_pad) from an explicit bm (ceil m to its multiple) or the
+    plan_bands policy — the one place the padding rule lives."""
     if bm is None:
-        bm = pick_band_rows(nx, ny, u.dtype)
-    nblk = nx // bm
-    zero_row = jnp.zeros((1, ny), u.dtype)
-    # Neighbor-row strips: band i needs global rows i*bm-1 and (i+1)*bm.
-    # Strided-slice extraction; edge bands get a zero row (never read into
-    # the result — their first/last row is global boundary and kept).
-    # Shaped (nblk, 1, ny) so each block is (1, 1, ny): Mosaic requires the
-    # last two block dims to divide (8, 128) or equal the array dims.
-    ups = jnp.concatenate([zero_row, u[bm - 1::bm][:nblk - 1]],
-                          axis=0).reshape(nblk, 1, ny)
-    dns = jnp.concatenate([u[bm::bm], zero_row],
-                          axis=0).reshape(nblk, 1, ny)
+        return plan_bands(m, n, dtype)
+    return bm, -(-m // bm) * bm
 
-    kwargs = {}
-    mspace = {}
+
+#: Hard ceiling for the estimated per-program VMEM working set before we
+#: refuse to compile. v5e has 16 MB/core; the largest config proven to
+#: compile (4096-wide rows, bm=128, T=8) estimates ~11.8 MB here.
+VMEM_HARD_LIMIT_BYTES = 14 * 1024 * 1024
+
+
+def _check_band_vmem(bm: int, tsteps: int, ny: int, dtype) -> None:
+    """Fast-fail for configs whose band kernel cannot fit VMEM: without
+    this the TPU compiler surfaces an opaque remote-compile HTTP 500 (or
+    hangs for minutes) instead of an actionable message."""
+    est = 5 * (bm + 2 * tsteps) * ny * jnp.dtype(dtype).itemsize
+    if est > VMEM_HARD_LIMIT_BYTES:
+        raise ConfigError(
+            f"stencil band kernel needs ~{est / 2**20:.0f} MB of VMEM "
+            f"(band of {bm} rows + {2 * tsteps} halo rows x {ny} cells), "
+            f"over the ~16 MB/core budget: rows this wide cannot stream "
+            f"through a single chip's band kernel. Shard the y dimension "
+            f"across devices (--mode dist2d/hybrid --gridy N) or reduce "
+            f"--halo-depth")
+
+
+def _banded_pallas(kernel_body, u, bm, t, scalars=None):
+    """Launch ``kernel_body`` over the row bands of ``u`` with t-deep
+    neighbor-row strips (zeros past the array edges) — the shared
+    machinery of kernels B, C and D.
+
+    ``u``'s row count must already be a bm multiple (callers pad via
+    plan_bands). Band i's strips carry rows [i*bm - t, i*bm) and
+    [(i+1)*bm, (i+1)*bm + t), riding as (1, t, n) blocks: Mosaic requires
+    the last two block dims to divide (8, 128) or equal the array dims.
+    ``scalars``: optional (2,) int32 SMEM operand prepended to the
+    kernel's refs (kernel D's traced shard origin).
+    """
+    m, n = u.shape
+    nblk = m // bm
+    zeros = jnp.zeros((1, t, n), u.dtype)
+    blocks = u.reshape(nblk, bm, n)
+    ups = jnp.concatenate([zeros, blocks[:-1, bm - t:, :]], axis=0)
+    dns = jnp.concatenate([blocks[1:, :t, :], zeros], axis=0)
+
+    mspace, smem = {}, {}
     if pltpu is not None and not _interpret():
         mspace = dict(memory_space=pltpu.VMEM)
+        smem = dict(memory_space=pltpu.SMEM)
+    in_specs = [
+        pl.BlockSpec((1, t, n), lambda i: (i, 0, 0), **mspace),
+        pl.BlockSpec((bm, n), lambda i: (i, 0), **mspace),
+        pl.BlockSpec((1, t, n), lambda i: (i, 0, 0), **mspace),
+    ]
+    operands = [ups, u, dns]
+    if scalars is not None:
+        in_specs.insert(0, pl.BlockSpec((2,), lambda i: (0,), **smem))
+        operands.insert(0, scalars)
     grid_spec = pl.GridSpec(
         grid=(nblk,),
-        in_specs=[
-            pl.BlockSpec((1, 1, ny), lambda i: (i, 0, 0), **mspace),
-            pl.BlockSpec((bm, ny), lambda i: (i, 0), **mspace),
-            pl.BlockSpec((1, 1, ny), lambda i: (i, 0, 0), **mspace),
-        ],
-        out_specs=pl.BlockSpec((bm, ny), lambda i: (i, 0), **mspace),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((bm, n), lambda i: (i, 0), **mspace),
     )
     return pl.pallas_call(
-        functools.partial(_band_kernel, bm=bm, nx=nx, ny=ny, cx=cx, cy=cy),
+        kernel_body,
         out_shape=jax.ShapeDtypeStruct(u.shape, u.dtype),
         grid_spec=grid_spec,
-        interpret=_interpret(),
-        **kwargs)(ups, u, dns)
+        interpret=_interpret())(*operands)
+
+
+def band_step(u, cx: float, cy: float, bm: int | None = None,
+              domain_rows: int | None = None):
+    """One time step of an HBM-resident grid via a row-band program grid.
+
+    Rows pad to a bm multiple (plan_bands); pad rows read garbage but the
+    kept row nx-1 firewalls it from the domain, and the pad is sliced off
+    before returning. ``domain_rows``: true domain row count when ``u``
+    already carries pad rows (band_chunk pads once outside its loop).
+    """
+    m, ny = u.shape
+    nx = m if domain_rows is None else domain_rows
+    bm, m_pad = _resolve_bands(m, ny, u.dtype, bm)
+    _check_band_vmem(bm, 0, ny, u.dtype)
+    if m_pad > m:
+        u = jnp.pad(u, ((0, m_pad - m), (0, 0)))
+    out = _banded_pallas(
+        functools.partial(_band_kernel, bm=bm, nx=nx, ny=ny, cx=cx, cy=cy),
+        u, bm, 1)
+    return out[:m] if m_pad > m else out
 
 
 # --------------------------------------------------------------------- #
@@ -221,47 +301,32 @@ def _band_multi_kernel(up_ref, u_ref, dn_ref, out_ref, *,
 
 
 def band_multi_step(u, tsteps: int, cx: float, cy: float,
-                    bm: int | None = None):
-    """Advance ``tsteps`` time steps in one sweep of row-band programs."""
-    nx, ny = u.shape
-    if bm is None:
-        bm = pick_band_rows(nx, ny, u.dtype)
+                    bm: int | None = None,
+                    domain_rows: int | None = None):
+    """Advance ``tsteps`` time steps in one sweep of row-band programs.
+
+    Rows pad to a bm multiple (plan_bands); pad rows sit past gi >= nx-1
+    so the keep mask holds them at zero — they never corrupt the domain
+    and slice off before returning. ``domain_rows``: true domain row
+    count when ``u`` already carries pad rows.
+    """
+    m, ny = u.shape
+    nx = m if domain_rows is None else domain_rows
+    bm, m_pad = _resolve_bands(m, ny, u.dtype, bm)
     if tsteps < 1 or bm <= 2 * tsteps:
         # Not enough band depth to amortize — fall back to stepwise.
         out = u
         for _ in range(tsteps):
-            out = band_step(out, cx, cy, bm=bm)
+            out = band_step(out, cx, cy, bm=bm, domain_rows=domain_rows)
         return out
-    nblk = nx // bm
-    t = tsteps
-    zeros = jnp.zeros((1, t, ny), u.dtype)
-    blocks = u.reshape(nblk, bm, ny)
-    # Band i's halo strips: global rows [i*bm - t, i*bm) and
-    # [(i+1)*bm, (i+1)*bm + t). Edge bands get zeros — firewalled by the
-    # per-step boundary mask above, never read into the kept result.
-    ups = jnp.concatenate([zeros, blocks[:-1, bm - t:, :]], axis=0)
-    dns = jnp.concatenate([blocks[1:, :t, :], zeros], axis=0)
-
-    kwargs = {}
-    mspace = {}
-    if pltpu is not None and not _interpret():
-        mspace = dict(memory_space=pltpu.VMEM)
-    grid_spec = pl.GridSpec(
-        grid=(nblk,),
-        in_specs=[
-            pl.BlockSpec((1, t, ny), lambda i: (i, 0, 0), **mspace),
-            pl.BlockSpec((bm, ny), lambda i: (i, 0), **mspace),
-            pl.BlockSpec((1, t, ny), lambda i: (i, 0, 0), **mspace),
-        ],
-        out_specs=pl.BlockSpec((bm, ny), lambda i: (i, 0), **mspace),
-    )
-    return pl.pallas_call(
-        functools.partial(_band_multi_kernel, bm=bm, tsteps=t,
+    _check_band_vmem(bm, tsteps, ny, u.dtype)
+    if m_pad > m:
+        u = jnp.pad(u, ((0, m_pad - m), (0, 0)))
+    out = _banded_pallas(
+        functools.partial(_band_multi_kernel, bm=bm, tsteps=tsteps,
                           nx=nx, ny=ny, cx=cx, cy=cy),
-        out_shape=jax.ShapeDtypeStruct(u.shape, u.dtype),
-        grid_spec=grid_spec,
-        interpret=_interpret(),
-        **kwargs)(ups, u, dns)
+        u, bm, tsteps)
+    return out[:m] if m_pad > m else out
 
 
 #: Default temporal depth for HBM-resident grids. Bounded by VMEM (the
@@ -272,16 +337,25 @@ DEFAULT_TSTEPS = 8
 
 def band_chunk(u, n: int, cx: float, cy: float,
                tsteps: int = DEFAULT_TSTEPS, bm: int | None = None):
-    """Advance ``n`` (static) steps: full T-sweeps plus a remainder sweep."""
+    """Advance ``n`` (static) steps: full T-sweeps plus a remainder sweep.
+
+    Divisor-poor row counts pad ONCE here for the whole loop (the padded
+    shape is a fixed point under the keep-masked kernels), not per sweep.
+    """
+    nx, ny = u.shape
+    bm, m_pad = _resolve_bands(nx, ny, u.dtype, bm)
+    if m_pad > nx:
+        u = jnp.pad(u, ((0, m_pad - nx), (0, 0)))
     nsweeps, rem = divmod(n, tsteps)
     if nsweeps:
         u = lax.fori_loop(
             0, nsweeps,
-            lambda _, v: band_multi_step(v, tsteps, cx, cy, bm=bm), u,
+            lambda _, v: band_multi_step(v, tsteps, cx, cy, bm=bm,
+                                         domain_rows=nx), u,
             unroll=False)
     if rem:
-        u = band_multi_step(u, rem, cx, cy, bm=bm)
-    return u
+        u = band_multi_step(u, rem, cx, cy, bm=bm, domain_rows=nx)
+    return u[:nx] if m_pad > nx else u
 
 
 # --------------------------------------------------------------------- #
@@ -328,31 +402,119 @@ def make_single_chip_runner(config):
     return jax.jit(run)
 
 
-def make_padded_kernel(config):
-    """Per-shard kernel for mode='hybrid': one step on a halo-padded
-    (bm+2, bn+2) block, returning the updated (bm, bn) interior — the
-    drop-in replacement for ops.stencil.stencil_step_padded inside the
-    shard_map step (caller masks the global boundary)."""
+# --------------------------------------------------------------------- #
+# Kernel D: per-shard chunk kernels for mode='hybrid'
+# --------------------------------------------------------------------- #
+#
+# The shard-local analogue of kernels A and C: inside shard_map, each
+# device holds a wide-halo extended block (bm+2T, bn+2T) from
+# parallel.halo.exchange_halo_2d_wide and must advance it T steps. The
+# round-1 design ran one whole-block one-step kernel per step, which
+# (a) re-paid HBM traffic every step and (b) OOM'd VMEM for shards
+# >= ~1400^2 — on a real v5e-16 the reference hybrid program's own
+# workload class (grad1612_hybrid_heat.c:243-306 runs 2560x2048) was
+# unreachable. These kernels fix both: T steps per invocation, routed by
+# size — whole block resident in VMEM when it fits, streamed in
+# temporally-blocked row bands (kernel C machinery) when it doesn't.
+#
+# Unlike kernels A-C, the keep mask here depends on the shard's mesh
+# position (lax.axis_index — a *traced* value), so the global coordinates
+# of the block's (0,0) ride in as an SMEM scalar operand.
+
+def _shard_keep_mask(row0, col0, shape, nx, ny, row_shift=0):
+    """(gi<=0)|(gi>=nx-1)|(gj<=0)|(gj>=ny-1) over ``shape``: global
+    boundary cells plus out-of-domain ghost/pad cells — the in-kernel
+    twin of parallel.sharded._keep_mask. row0/col0 may be traced."""
+    gi = (row0 + row_shift
+          + lax.broadcasted_iota(jnp.int32, (shape[0], 1), 0))
+    gj = col0 + lax.broadcasted_iota(jnp.int32, (1, shape[1]), 1)
+    return (gi <= 0) | (gi >= nx - 1) | (gj <= 0) | (gj >= ny - 1)
+
+
+def _shard_vmem_kernel(s_ref, u_ref, out_ref, *, tsteps, nx, ny, cx, cy):
+    u = u_ref[:]
+    keep = _shard_keep_mask(s_ref[0], s_ref[1], u.shape, nx, ny)
+
+    def one(_, v):
+        return jnp.where(keep, v, _step_value_literal(v, cx, cy))
+
+    out_ref[:] = lax.fori_loop(0, tsteps, one, u, unroll=False)
+
+
+def _shard_band_kernel(s_ref, up_ref, u_ref, dn_ref, out_ref, *,
+                       bm, tsteps, nx, ny, cx, cy):
+    i = pl.program_id(0)
+    ext = jnp.concatenate([up_ref[0], u_ref[:], dn_ref[0]], axis=0)
+    # Extended-band row k is block row i*bm - tsteps + k; add the block's
+    # global origin from SMEM.
+    keep = _shard_keep_mask(s_ref[0], s_ref[1], ext.shape, nx, ny,
+                            row_shift=i * bm - tsteps)
+
+    def one(_, v):
+        return jnp.where(keep, v, _step_value_literal(v, cx, cy))
+
+    ext = lax.fori_loop(0, tsteps, one, ext, unroll=False)
+    out_ref[:] = ext[tsteps:-tsteps]
+
+
+def _shard_vmem_chunk(ext, scalars, tsteps, cx, cy, nx, ny):
+    kwargs = {}
+    if pltpu is not None and not _interpret():
+        kwargs = dict(
+            in_specs=[pl.BlockSpec(memory_space=pltpu.SMEM),
+                      pl.BlockSpec(memory_space=pltpu.VMEM)],
+            out_specs=pl.BlockSpec(memory_space=pltpu.VMEM))
+    return pl.pallas_call(
+        functools.partial(_shard_vmem_kernel, tsteps=tsteps,
+                          nx=nx, ny=ny, cx=cx, cy=cy),
+        out_shape=jax.ShapeDtypeStruct(ext.shape, ext.dtype),
+        interpret=_interpret(),
+        **kwargs)(scalars, ext)
+
+
+def _shard_band_chunk(ext, scalars, tsteps, cx, cy, nx, ny, bm=None):
+    """Stream the extended block in temporally-blocked row bands.
+
+    Same staleness schedule as kernel C: in-block band strips are exact
+    neighbor data at sweep start, so after s <= T in-VMEM steps only the
+    outermost s rows of each extended band are stale; the block's kept
+    center (the caller slices [T:-T, T:-T]) is exact. Rows pad to a bm
+    multiple — pad garbage propagates inward at 1 row/step from the block
+    edge, the same cone the wide-halo argument already discards.
+    """
+    m, n = ext.shape
+    bm, m_pad = _resolve_bands(m, n, ext.dtype, bm)
+    if tsteps > 1 and bm < tsteps:
+        # Band too shallow to carry a T-deep strip: advance stepwise.
+        for _ in range(tsteps):
+            ext = _shard_band_chunk(ext, scalars, 1, cx, cy, nx, ny, bm=bm)
+        return ext
+    _check_band_vmem(bm, tsteps, n, ext.dtype)
+    if m_pad > m:
+        ext_p = jnp.pad(ext, ((0, m_pad - m), (0, 0)))
+    else:
+        ext_p = ext
+    out = _banded_pallas(
+        functools.partial(_shard_band_kernel, bm=bm, tsteps=tsteps,
+                          nx=nx, ny=ny, cx=cx, cy=cy),
+        ext_p, bm, tsteps, scalars=scalars)
+    return out[:m] if m_pad > m else out
+
+
+def make_shard_chunk_kernel(config):
+    """``chunk_kernel(ext, t, row0, col0) -> ext`` for mode='hybrid':
+    advances the wide-halo extended block t steps in one (or few) Pallas
+    invocations; only the [t:-t, t:-t] center is exact (the caller —
+    parallel.sharded.make_local_chunk — slices it). row0/col0 are the
+    global coordinates of ext[0, 0] (traced, from lax.axis_index)."""
     cx, cy = config.cx, config.cy
+    nx, ny = config.nxprob, config.nyprob
 
-    def kernel(p_ref, out_ref):
-        p = p_ref[:]
-        c = p[1:-1, 1:-1]
-        out_ref[:] = (c
-                      + cx * (p[2:, 1:-1] + p[:-2, 1:-1] - 2.0 * c)
-                      + cy * (p[1:-1, 2:] + p[1:-1, :-2] - 2.0 * c))
+    def chunk_kernel(ext, t, row0, col0):
+        scalars = jnp.stack([jnp.asarray(row0, jnp.int32),
+                             jnp.asarray(col0, jnp.int32)])
+        if fits_vmem(ext.shape, ext.dtype):
+            return _shard_vmem_chunk(ext, scalars, t, cx, cy, nx, ny)
+        return _shard_band_chunk(ext, scalars, t, cx, cy, nx, ny)
 
-    def padded_step(padded, cx_unused=None, cy_unused=None):
-        bm, bn = padded.shape[0] - 2, padded.shape[1] - 2
-        kwargs = {}
-        if pltpu is not None and not _interpret():
-            kwargs = dict(
-                in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)],
-                out_specs=pl.BlockSpec(memory_space=pltpu.VMEM))
-        return pl.pallas_call(
-            kernel,
-            out_shape=jax.ShapeDtypeStruct((bm, bn), padded.dtype),
-            interpret=_interpret(),
-            **kwargs)(padded)
-
-    return padded_step
+    return chunk_kernel

@@ -64,19 +64,19 @@ def _keep_mask(shape, nx, ny, row0, col0):
     return (gi <= 0) | (gi >= nx - 1) | (gj <= 0) | (gj >= ny - 1)
 
 
-def make_local_step(config, mesh: Mesh, kernel=None):
+def make_local_step(config, mesh: Mesh, chunk_kernel=None):
     """Shard-local single step — the wide-halo chunk at depth 1 (bitwise
     identical per the depth-parametrized tests; used as the tracked step
     of the convergence residual pair).
 
-    ``kernel``: optional (padded, cx, cy) -> (m, n) stencil implementation
-    (e.g. the Pallas kernel) replacing the jnp golden model.
+    ``chunk_kernel``: optional Pallas chunk implementation (see
+    make_local_chunk) replacing the jnp golden loop.
     """
-    chunk = make_local_chunk(config, mesh, kernel=kernel)
+    chunk = make_local_chunk(config, mesh, chunk_kernel=chunk_kernel)
     return lambda u: chunk(u, 1)
 
 
-def make_local_chunk(config, mesh: Mesh, kernel=None):
+def make_local_chunk(config, mesh: Mesh, chunk_kernel=None):
     """Shard-local multi-step: ONE wide halo exchange, then T steps in
     place on the (bm+2T, bn+2T) extended block.
 
@@ -87,6 +87,13 @@ def make_local_chunk(config, mesh: Mesh, kernel=None):
     ghost zeros at physical edges are firewalled at the boundary cells
     (which never update). Returns ``chunk(u, t)`` with static t in
     [1, min(bm, bn)].
+
+    ``chunk_kernel``: optional ``(ext, t, row0, col0) -> ext`` advancing
+    the whole extended block t steps in one Pallas invocation (mode=
+    'hybrid', ops.pallas_stencil.make_shard_chunk_kernel) — VMEM-routed
+    so arbitrarily large shards stream in row bands instead of OOMing.
+    Only the [t:-t, t:-t] center of its result is exact, which is all
+    this function keeps.
     """
     ax, ay = mesh.axis_names
     gx, gy = (mesh.devices.shape[0], mesh.devices.shape[1])
@@ -98,21 +105,21 @@ def make_local_chunk(config, mesh: Mesh, kernel=None):
 
     def chunk(u, t):
         ext = exchange_halo_2d_wide(u, ax, ay, gx, gy, t)
-        keep = _keep_mask((bm + 2 * t, bn + 2 * t), nx, ny,
-                          lax.axis_index(ax) * bm - t,
-                          lax.axis_index(ay) * bn - t)
+        row0 = lax.axis_index(ax) * bm - t
+        col0 = lax.axis_index(ay) * bn - t
+        if chunk_kernel is not None:
+            ext = chunk_kernel(ext, t, row0, col0)
+        else:
+            keep = _keep_mask((bm + 2 * t, bn + 2 * t), nx, ny, row0, col0)
 
-        def one(_, v):
-            if kernel is None:
+            def one(_, v):
                 newint = stencil_step_padded(v, cx, cy, accum)
-            else:
-                newint = kernel(v, cx, cy)
-            mid = jnp.concatenate([v[1:-1, :1], newint, v[1:-1, -1:]],
-                                  axis=1)
-            full = jnp.concatenate([v[:1, :], mid, v[-1:, :]], axis=0)
-            return jnp.where(keep, v, full)
+                mid = jnp.concatenate([v[1:-1, :1], newint, v[1:-1, -1:]],
+                                      axis=1)
+                full = jnp.concatenate([v[:1, :], mid, v[-1:, :]], axis=0)
+                return jnp.where(keep, v, full)
 
-        ext = lax.fori_loop(0, t, one, ext, unroll=False)
+            ext = lax.fori_loop(0, t, one, ext, unroll=False)
         return ext[t:-t, t:-t]
 
     return chunk
@@ -126,10 +133,10 @@ def effective_halo_depth(config, mesh: Mesh) -> int:
     return max(1, min(want, bm, bn))
 
 
-def make_local_multi(config, mesh: Mesh, kernel=None):
+def make_local_multi(config, mesh: Mesh, chunk_kernel=None):
     """``multi(u, n)`` advancing a *static* n steps via wide-halo chunks
     of depth T plus a remainder chunk."""
-    chunk = make_local_chunk(config, mesh, kernel=kernel)
+    chunk = make_local_chunk(config, mesh, chunk_kernel=chunk_kernel)
     t = effective_halo_depth(config, mesh)
 
     def multi(u, n):
@@ -144,15 +151,15 @@ def make_local_multi(config, mesh: Mesh, kernel=None):
     return multi
 
 
-def make_sharded_runner(config, mesh: Mesh, kernel=None):
+def make_sharded_runner(config, mesh: Mesh, chunk_kernel=None):
     """Returns (runner, sharding): ``runner(u_sharded) -> (u, steps_done)``,
     jit-compiled over the mesh. The full loop (and convergence psum over
     both mesh axes — the MPI_Allreduce analogue, grad1612_mpi_heat.c:268)
     runs device-side in one program."""
     ax, ay = mesh.axis_names
     accum = jnp.dtype(config.accum_dtype)
-    local_step = make_local_step(config, mesh, kernel=kernel)
-    local_multi = make_local_multi(config, mesh, kernel=kernel)
+    local_step = make_local_step(config, mesh, chunk_kernel=chunk_kernel)
+    local_multi = make_local_multi(config, mesh, chunk_kernel=chunk_kernel)
     sharding = NamedSharding(mesh, P(ax, ay))
 
     def local_run(u):
@@ -175,7 +182,7 @@ def make_sharded_runner(config, mesh: Mesh, kernel=None):
                            # pallas_call out_shapes carry no vma info; skip
                            # the varying-across-mesh-axes check when a
                            # kernel runs inside the shard (hybrid mode)
-                           check_vma=kernel is None)
+                           check_vma=chunk_kernel is None)
     except TypeError:  # older jax: no check_vma kwarg
         mapped = shard_map(local_run, mesh=mesh,
                            in_specs=P(ax, ay),
