@@ -13,13 +13,21 @@ the TPU framework:
 - mesh axis: the same grid sizes through dist1d/dist2d/hybrid shard_map
   programs over an N-device mesh. On a single-chip machine these run on the
   virtual CPU host platform (--platform cpu), which validates the sharded
-  program at every sweep point; the wall-clock columns are then CPU numbers
-  — flagged in the output — and become real ICI numbers on a pod.
+  program at every sweep point; the wall-clock columns are then CPU
+  correctness-validation numbers — flagged in the output — and become real
+  ICI numbers on a pod.
 
-Outputs: one JSON line per point (jsonl), plus a markdown table with the
-reference's published wall-clock beside ours where a figure exists
-(Report.pdf Table 1 serial column and Table 10 CUDA per-step times,
-transcribed in BASELINE.md).
+Measurement protocol (matches bench.py): the timing fence (a host readback
+that guarantees completion through remote-tunneled runtimes,
+utils/timing._fence) costs a fixed ~0.1-0.2 s per timed call, which at
+small grids dwarfs the compute. Every fixed-step point therefore reports
+the TWO-POINT marginal step time — (t_hi - t_lo) / (hi - lo) with the
+fixed overhead cancelled — growing hi adaptively (x10 up to 100k steps,
+the reference's own amortization span for its CUDA tables) until the
+difference clears the measured fence jitter. Reference comparisons use
+the marginal step time x 100 (their tables are 100-iteration wall-clocks
+without our tunnel fence). Convergence points report end-to-end wall-clock
+(steps_done is data-dependent), like the reference's Tables 4-6.
 
 Usage:
     python benchmarks/sweep.py --suite chip            # real-accelerator perf
@@ -59,36 +67,96 @@ REF_CONV_BEST_S = {(80, 64): 2.06e-1, (160, 128): 2.49e-1,
                    (320, 256): 2.29e-1, (640, 512): 2.42e-1,
                    (1280, 1024): 2.63e-1, (2560, 2048): 4.80e-1}
 
+#: Adaptive two-point hi ceiling — the reference's own CUDA tables amortize
+#: over up to 100k iterations (Report.pdf p.26).
+MAX_HI_STEPS = 100_000
 
-def run_point(mode, nx, ny, steps, gridx=1, gridy=1, convergence=False):
+
+def run_point(mode, nx, ny, steps, gridx=1, gridy=1, convergence=False,
+              max_hi=MAX_HI_STEPS):
     from heat2d_tpu.config import HeatConfig
     from heat2d_tpu.models.solver import Heat2DSolver
 
-    cfg = HeatConfig(nxprob=nx, nyprob=ny, steps=steps, mode=mode,
-                     gridx=gridx, gridy=gridy, convergence=convergence)
-    solver = Heat2DSolver(cfg)
-    result = solver.run(timed=True)
-    rec = {
-        "mode": mode, "grid": f"{nx}x{ny}", "steps": int(result.steps_done),
-        "mesh": f"{gridx}x{gridy}",
-        "elapsed_s": round(result.elapsed, 6),
-        "mcells_per_s": round(result.mcells_per_s, 2),
-    }
+    solvers = {}
+
+    def timed_run(n):
+        # First call per step count compiles + warms up; repeats skip the
+        # untimed priming run (the solver cache keeps the compiled runner).
+        fresh = n not in solvers
+        if fresh:
+            cfg = HeatConfig(nxprob=nx, nyprob=ny, steps=n, mode=mode,
+                             gridx=gridx, gridy=gridy,
+                             convergence=convergence)
+            solvers[n] = Heat2DSolver(cfg)
+        return solvers[n].run(timed=True, warmup=fresh)
+
+    rec = {"mode": mode, "grid": f"{nx}x{ny}", "mesh": f"{gridx}x{gridy}"}
+    step_time = None
     if convergence:
-        rec["convergence"] = True
+        # steps_done is data-dependent — end-to-end is the honest figure
+        # (and what the reference's Tables 4-6 clock).
+        result = timed_run(steps)
+        rec.update(steps=int(result.steps_done),
+                   elapsed_s=round(result.elapsed, 6),
+                   mcells_per_s=round(result.mcells_per_s, 2),
+                   method="end-to-end", convergence=True)
+    else:
+        lo = max(steps // 5, 1)
+        r1, r2 = timed_run(lo), timed_run(lo)
+        t_lo = min(r1.elapsed, r2.elapsed)
+        jitter = abs(r1.elapsed - r2.elapsed)
+        hi = steps
+        while True:
+            ra, rb = timed_run(hi), timed_run(hi)
+            result = ra if ra.elapsed <= rb.elapsed else rb
+            dt = result.elapsed - t_lo
+            # The 50 ms absolute floor guards against a lucky pair of lo
+            # runs under-estimating jitter: fence variance through the
+            # tunnel reaches tens of ms, so a smaller dt can be pure
+            # noise even when it clears 5x the *measured* jitter.
+            if dt > max(5 * jitter, 0.05):
+                step_time = dt / (hi - lo)
+                break
+            if hi >= max_hi:
+                break
+            hi = min(hi * 10, max_hi)
+        if step_time is not None:
+            rec.update(steps=hi,
+                       elapsed_s=round(result.elapsed, 6),
+                       step_time_s=round(step_time, 9),
+                       mcells_per_s=round(nx * ny / step_time / 1e6, 2),
+                       method="two-point")
+        else:
+            rec.update(steps=hi,
+                       elapsed_s=round(result.elapsed, 6),
+                       mcells_per_s=round(result.mcells_per_s, 2),
+                       method="end-to-end (two-point within noise)")
+
     ref_serial = REF_CONV_SERIAL_S if convergence else REF_SERIAL_S
     ref_best = REF_CONV_BEST_S if convergence else REF_BEST_S
     ref_s = ref_serial.get((nx, ny))
-    if ref_s is not None and steps == 100:
-        rec["ref_serial_s"] = ref_s
-        rec["speedup_vs_ref_serial"] = round(ref_s / result.elapsed, 2)
-        rec["ref_best_160task_s"] = ref_best[(nx, ny)]
-        rec["speedup_vs_ref_best"] = round(
-            ref_best[(nx, ny)] / result.elapsed, 2)
+    if ref_s is not None:
+        # Reference tables are 100-iteration wall-clocks (no tunnel
+        # fence); the like-for-like figure is marginal step time x 100.
+        # Convergence rows compare end-to-end wall-clocks (both sides run
+        # the same capped-iteration convergence workload). Noise-fallback
+        # fixed-step rows get NO ref columns: comparing our fence floor
+        # to the reference's real compute would be the exact distortion
+        # this protocol exists to avoid.
+        if convergence:
+            ours_100 = rec["elapsed_s"]
+        else:
+            ours_100 = step_time * 100 if step_time is not None else None
+        if ours_100:
+            rec["ref_serial_100step_s"] = ref_s
+            rec["speedup_vs_ref_serial"] = round(ref_s / ours_100, 2)
+            rec["ref_best_160task_s"] = ref_best[(nx, ny)]
+            rec["speedup_vs_ref_best"] = round(
+                ref_best[(nx, ny)] / ours_100, 2)
     ref_mc = REF_CUDA_MCELLS.get((nx, ny))
     if ref_mc is not None:
         rec["ref_cuda_mcells_per_s"] = ref_mc
-        rec["vs_ref_cuda"] = round(result.mcells_per_s / ref_mc, 2)
+        rec["vs_ref_cuda"] = round(rec["mcells_per_s"] / ref_mc, 2)
     return rec
 
 
@@ -135,14 +203,16 @@ def suite_scaling(steps, quick, n_devices):
 
 
 def add_scaling_columns(records):
-    """Post-pass: speedup vs the 1-device row and parallel efficiency."""
-    base = next((r["elapsed_s"] for r in records if r["mesh"] == "1x1"),
-                None)
+    """Post-pass: speedup vs the 1-device row and parallel efficiency,
+    from marginal step times where available (fence overhead cancelled)."""
+    def cost(r):
+        return r.get("step_time_s") or r["elapsed_s"]
+    base = next((cost(r) for r in records if r["mesh"] == "1x1"), None)
     for r in records:
         gx, gy = map(int, r["mesh"].split("x"))
         if base:
-            r["speedup_vs_1dev"] = round(base / r["elapsed_s"], 2)
-            r["efficiency"] = round(base / r["elapsed_s"] / (gx * gy), 3)
+            r["speedup_vs_1dev"] = round(base / cost(r), 2)
+            r["efficiency"] = round(base / cost(r) / (gx * gy), 3)
     return records
 
 
@@ -164,26 +234,41 @@ def suite_mesh(steps, quick, n_devices):
             break
 
 
-def to_markdown(records, platform):
+def to_markdown(records, platform, is_cpu_host):
     scaling = any("speedup_vs_1dev" in r for r in records)
     extra_hdr = " speedup vs 1 dev | efficiency |" if scaling else ""
-    lines = [
-        f"# heat2d-tpu sweep ({platform})", "",
-        "Reference columns from Report.pdf via BASELINE.md; all runs "
-        "100 steps unless noted. Reference hardware: HellasGrid cluster "
-        "(up to 160 MPI tasks) and a 2 GB GPU; ours: "
-        f"{platform}.", "",
-        "| mode | grid | mesh | steps | elapsed (s) | Mcells/s | "
-        "ref serial (s) | speedup vs ref serial | vs ref best (160 tasks) | "
-        f"vs ref CUDA |{extra_hdr}",
-        "|---|---|---|---|---|---|---|---|---|---|"
+    lines = [f"# heat2d-tpu sweep ({platform})", ""]
+    if is_cpu_host:
+        lines += [
+            "**CPU-host validation run.** These wall-clocks validate the "
+            "sharded SPMD program end-to-end on a virtual device mesh; "
+            "they are NOT accelerator performance and say nothing about "
+            "ICI scaling (that needs a real TPU pod). Use them for "
+            "correctness/plumbing evidence only.", ""]
+    lines += [
+        "Reference columns from Report.pdf via BASELINE.md (100-iteration "
+        "wall-clocks on the HellasGrid cluster, up to 160 MPI tasks, and "
+        "a 2 GB GPU). Our Mcells/s and step time are TWO-POINT marginal "
+        "figures (fixed fence overhead cancelled, amortized over the "
+        "steps shown); 'elapsed' is the raw end-to-end wall-clock of the "
+        "largest timed run including the ~0.1-0.2 s tunnel fence. "
+        "Speedup columns compare the reference's 100-iteration wall-clock "
+        "to our marginal step time x 100.", "",
+        "| mode | grid | mesh | steps | step time (s) | Mcells/s | "
+        "elapsed (s) | method | ref serial 100-step (s) | speedup vs ref "
+        f"serial | vs ref best (160 tasks) | vs ref CUDA |{extra_hdr}",
+        "|---|---|---|---|---|---|---|---|---|---|---|---|"
         + ("---|---|" if scaling else ""),
     ]
     for r in records:
+        st = r.get("step_time_s")
         row = (
             f"| {r['mode']} | {r['grid']} | {r['mesh']} | {r['steps']} "
-            f"| {r['elapsed_s']:.4g} | {r['mcells_per_s']:.4g} "
-            f"| {r.get('ref_serial_s', '—')} "
+            f"| {f'{st:.3g}' if st else '—'} "
+            f"| {r['mcells_per_s']:.4g} "
+            f"| {r['elapsed_s']:.4g} "
+            f"| {r['method']} "
+            f"| {r.get('ref_serial_100step_s', '—')} "
             f"| {r.get('speedup_vs_ref_serial', '—')} "
             f"| {r.get('speedup_vs_ref_best', '—')} "
             f"| {r.get('vs_ref_cuda', '—')} |")
@@ -199,7 +284,9 @@ def main(argv=None) -> int:
     p.add_argument("--suite", default="chip",
                    choices=["chip", "mesh", "conv", "scaling"])
     p.add_argument("--steps", type=int, default=100,
-                   help="reference default (grad1612_mpi_heat.c:7)")
+                   help="reference default (grad1612_mpi_heat.c:7); "
+                        "fixed-step points grow this adaptively until the "
+                        "two-point window clears fence jitter")
     p.add_argument("--quick", action="store_true")
     p.add_argument("--outdir", default="benchmarks/results")
     p.add_argument("--platform", default=None, choices=["cpu", "tpu"])
@@ -213,6 +300,7 @@ def main(argv=None) -> int:
     import jax
     devs = jax.devices()
     platform = f"{devs[0].device_kind} x{len(devs)}"
+    is_cpu_host = devs[0].platform == "cpu"
     print(f"# sweep on {platform}", file=sys.stderr)
 
     if args.suite == "chip":
@@ -224,10 +312,11 @@ def main(argv=None) -> int:
     else:
         points = list(suite_mesh(args.steps, args.quick, len(devs)))
 
+    max_hi = 1000 if args.quick else MAX_HI_STEPS
     records = []
     for pt in points:
         t0 = time.perf_counter()
-        rec = run_point(**pt)
+        rec = run_point(**pt, max_hi=max_hi)
         rec["suite"] = args.suite
         rec["platform"] = platform
         records.append(rec)
@@ -243,7 +332,7 @@ def main(argv=None) -> int:
     with open(os.path.join(args.outdir, f"sweep_{tag}.jsonl"), "w") as f:
         f.writelines(json.dumps(r) + "\n" for r in records)
     with open(os.path.join(args.outdir, f"sweep_{tag}.md"), "w") as f:
-        f.write(to_markdown(records, platform))
+        f.write(to_markdown(records, platform, is_cpu_host))
     print(f"# wrote {args.outdir}/sweep_{tag}.jsonl and .md", file=sys.stderr)
     return 0
 

@@ -51,6 +51,17 @@ def build_parser() -> argparse.ArgumentParser:
                    help="wide-halo depth T for distributed modes: one "
                         "T-deep ghost exchange per T steps (default auto; "
                         "1 = the reference's per-step exchange)")
+    e = p.add_argument_group(
+        "ensemble (batched parameter sweep — one launch advances every "
+        "(cx, cy) member; the reference needed one compile+run per "
+        "configuration)")
+    e.add_argument("--ensemble-cx", default=None, metavar="LIST",
+                   help="comma-separated cx values; with --ensemble-cy "
+                        "runs the whole batch in one compiled program "
+                        "(distributed modes shard members over devices)")
+    e.add_argument("--ensemble-cy", default=None, metavar="LIST",
+                   help="comma-separated cy values (same length as "
+                        "--ensemble-cx)")
     c = p.add_argument_group("convergence")
     c.add_argument("--convergence", action="store_true")
     c.add_argument("--interval", type=int, default=20)
@@ -183,6 +194,73 @@ def _run_with_periodic_checkpoints(solver, u0, cfg, args, start_step,
                      elapsed=elapsed, config=solver.config)
 
 
+def _run_ensemble_cli(args, cfg) -> int:
+    """Batched (cx, cy) parameter sweep in ONE launch — the reference's
+    per-configuration recompile sweeps (Report.pdf Tables 4-6) collapsed
+    into a single compiled program (SURVEY.md §2.3 'DP over batch').
+    Distributed modes shard members across devices on a batch mesh axis;
+    serial/pallas run the whole batch on one chip."""
+    import numpy as np
+    import jax
+    from heat2d_tpu.models.ensemble import ensemble_summary, timed_ensemble
+
+    try:
+        cxs = [float(s) for s in (args.ensemble_cx or "").split(",") if s]
+        cys = [float(s) for s in (args.ensemble_cy or "").split(",") if s]
+    except ValueError as e:
+        print(f"bad ensemble list: {e}\nQuitting...", file=sys.stderr)
+        return 1
+    if not cxs or len(cxs) != len(cys):
+        print("--ensemble-cx and --ensemble-cy must be non-empty, "
+              "equal-length comma-separated lists\nQuitting...",
+              file=sys.stderr)
+        return 1
+    if cfg.convergence:
+        print("ensemble runs are fixed-step (--convergence unsupported)"
+              "\nQuitting...", file=sys.stderr)
+        return 1
+
+    primary = jax.process_index() == 0
+    sharded = cfg.mode in ("dist1d", "dist2d", "hybrid")
+    if primary:
+        print(f"Starting ensemble of {len(cxs)} members"
+              + (f" over {len(jax.devices())} devices" if sharded else ""))
+        print(f"Problem size:{cfg.nxprob}x{cfg.nyprob}")
+        print(f"Amount of iterations: {cfg.steps}")
+    try:
+        batch, elapsed = timed_ensemble(
+            cfg.nxprob, cfg.nyprob, cfg.steps, cxs, cys, sharded=sharded)
+    except (ConfigError, ValueError) as e:
+        print(f"{e}\nQuitting...", file=sys.stderr)
+        return 1
+    batch = np.asarray(batch)
+    if primary:
+        print(f"Elapsed time: {elapsed:e} sec")
+        os.makedirs(args.outdir, exist_ok=True)
+        if args.dat_layout != "none":
+            from heat2d_tpu.io import (write_grid_baseline,
+                                       write_grid_rowmajor)
+            writer = (write_grid_baseline if args.dat_layout == "baseline"
+                      else write_grid_rowmajor)
+            for i, member in enumerate(batch):
+                name = f"final_m{i}.dat"
+                writer(member, os.path.join(args.outdir, name))
+                print(f"Writing {name} ...")
+        record = {
+            "config": cfg.to_dict(),
+            "elapsed_s": float(elapsed),
+            "members": [
+                {"cx": cx, "cy": cy} for cx, cy in zip(cxs, cys)],
+            "summary": ensemble_summary(batch),
+        }
+        if args.run_record:
+            with open(args.run_record, "w") as f:
+                json.dump(record, f, indent=2)
+        if cfg.debug:
+            print(json.dumps(record, indent=2))
+    return 0
+
+
 def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
     _apply_platform(args)
@@ -216,10 +294,20 @@ def main(argv=None) -> int:
         print(f"{e}\nQuitting...", file=sys.stderr)
         return 1
 
+    if args.ensemble_cx or args.ensemble_cy:
+        try:
+            return _run_ensemble_cli(args, cfg)
+        finally:
+            if multihost:
+                from heat2d_tpu.parallel.multihost import (
+                    shutdown_distributed)
+                shutdown_distributed()
+
     # Imports deferred so --help/--device-info don't pay jax startup.
     import numpy as np
     from heat2d_tpu.io import (save_checkpoint, load_checkpoint,
-                               write_binary, write_grid_baseline,
+                               read_binary, write_binary,
+                               write_binary_sharded, write_grid_baseline,
                                write_grid_rowmajor)
     from heat2d_tpu.models.solver import Heat2DSolver
 
@@ -282,15 +370,40 @@ def main(argv=None) -> int:
             write_grid_rowmajor(u_host, path)
         print(f"Writing {name} ...")
 
+    def dump_binary(u, name):
+        """Binary dump: per-shard collective parallel write when the grid
+        spans hosts (the MPI_File_write_all analogue — no process
+        materializes the full grid), rank-0 write otherwise. Returns the
+        path when a complete file exists on this host's filesystem."""
+        path = os.path.join(args.outdir, name)
+        if not getattr(u, "is_fully_addressable", True):
+            write_binary_sharded(u, path, shape=cfg.shape)
+            return path
+        if primary:
+            write_binary(
+                np.asarray(u)[:cfg.nxprob, :cfg.nyprob], path)
+        return path
+
+    def grid_to_host(u, binary_path=None):
+        """Full grid on this host for text output. When a per-shard
+        binary was just written, rank 0 reads it back instead of
+        allgathering — the reference's binary->text conversion flow
+        (grad1612_mpi_heat.c:319-323); other ranks get None (they never
+        write text)."""
+        if (binary_path is not None
+                and not getattr(u, "is_fully_addressable", True)):
+            return read_binary(binary_path, cfg.shape) if primary else None
+        return to_host(u)[:cfg.nxprob, :cfg.nyprob]
+
     try:
         os.makedirs(args.outdir, exist_ok=True)
-        # Crop equal-shard padding (uneven decompositions / resume re-place)
-        # so initial dumps match the problem domain like final.dat does.
-        u0_host = to_host(u0)[:cfg.nxprob, :cfg.nyprob]
-        write_dat(u0_host, "initial.dat")
-        if args.binary_dumps and primary:
-            write_binary(u0_host,
-                         os.path.join(args.outdir, "initial_binary.dat"))
+        init_bin = None
+        if args.binary_dumps:
+            init_bin = dump_binary(u0, "initial_binary.dat")
+        if args.dat_layout != "none":
+            # Cropped to the problem domain (equal-shard padding from
+            # uneven decompositions / resume re-place is stripped).
+            write_dat(grid_to_host(u0, init_bin), "initial.dat")
 
         try:
             from heat2d_tpu.utils.profiling import profile_span
@@ -303,7 +416,11 @@ def main(argv=None) -> int:
                     result = _run_with_periodic_checkpoints(
                         solver, u0, cfg, args, start_step, primary)
                 else:
-                    result = solver.run(u0=u0)
+                    # gather=False: output is written per-shard when it
+                    # spans hosts; the global grid is only assembled (or
+                    # read back from the binary) where text output needs
+                    # it.
+                    result = solver.run(u0=u0, gather=False)
         except ConfigError as e:
             # Includes kernel-level fast-fails (the VMEM working-set
             # check) — reported actionably instead of a traceback.
@@ -313,14 +430,23 @@ def main(argv=None) -> int:
         total_steps = start_step + result.steps_done
         say(f"Exiting after {result.steps_done} iterations")
         say(f"Elapsed time: {result.elapsed:e} sec")
-        u_host = to_host(result.u)
-        write_dat(u_host, "final.dat")
-        if args.binary_dumps and primary:
-            write_binary(u_host,
-                         os.path.join(args.outdir, "final_binary.dat"))
-        if args.checkpoint and primary and not args.checkpoint_every:
+        fin_bin = None
+        if args.binary_dumps:
+            fin_bin = dump_binary(result.u, "final_binary.dat")
+        u_host = None
+        if args.dat_layout != "none":
+            u_host = grid_to_host(result.u, fin_bin)
+            write_dat(u_host, "final.dat")
+        if args.checkpoint and not args.checkpoint_every:
             # (the periodic path already saved the final restart point)
-            save_checkpoint(u_host, total_steps, cfg, args.checkpoint)
+            if not getattr(result.u, "is_fully_addressable", True):
+                # collective per-shard checkpoint write (all ranks)
+                save_checkpoint(result.u, total_steps, cfg,
+                                args.checkpoint, shape=cfg.shape)
+            elif primary:
+                if u_host is None:
+                    u_host = grid_to_host(result.u)
+                save_checkpoint(u_host, total_steps, cfg, args.checkpoint)
 
         record = result.to_record()
         record["total_steps_including_resume"] = total_steps

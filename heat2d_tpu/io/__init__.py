@@ -7,6 +7,7 @@ from heat2d_tpu.io.writers import (
 )
 from heat2d_tpu.io.binary import (
     write_binary,
+    write_binary_sharded,
     read_binary,
     save_checkpoint,
     load_checkpoint,
@@ -19,6 +20,7 @@ __all__ = [
     "write_grid_rowmajor",
     "read_grid_text",
     "write_binary",
+    "write_binary_sharded",
     "read_binary",
     "save_checkpoint",
     "load_checkpoint",

@@ -23,6 +23,52 @@ def write_binary(u, path) -> None:
     a.tofile(path)
 
 
+def write_binary_sharded(u, path, shape=None) -> None:
+    """Per-shard parallel write of a (possibly host-spanning) jax.Array —
+    the MPI_File_write_all analogue (grad1612_mpi_heat.c:182-189, subarray
+    datatype + collective write): every process writes its addressable
+    shards into the one global row-major f32 file at their global offsets.
+    No process ever materializes the full grid.
+
+    COLLECTIVE: every process must call it (process 0 pre-sizes the file;
+    barriers bracket the writes so the file is complete on return —
+    like MPI-IO, a shared filesystem is assumed across hosts).
+
+    ``shape``: true domain (nx, ny) — shard cells past it (the equal-shard
+    padding of uneven decompositions) are cropped, so the file layout is
+    the reference's exactly.
+    """
+    import jax
+
+    nx, ny = shape if shape is not None else u.shape
+    multi = jax.process_count() > 1
+    if multi:
+        from jax.experimental import multihost_utils
+    if jax.process_index() == 0:
+        with open(path, "wb") as f:
+            f.truncate(nx * ny * 4)
+    if multi:
+        multihost_utils.sync_global_devices(f"binary_sharded:create:{path}")
+    mm = np.memmap(path, dtype=np.float32, mode="r+", shape=(nx, ny))
+    try:
+        for sh in u.addressable_shards:
+            if sh.replica_id != 0:
+                continue
+            rs, cs = sh.index
+            r0, c0 = rs.start or 0, cs.start or 0
+            if r0 >= nx or c0 >= ny:
+                continue          # shard lies wholly in the padding
+            blk = np.asarray(sh.data, dtype=np.float32)
+            r1 = min(r0 + blk.shape[0], nx)
+            c1 = min(c0 + blk.shape[1], ny)
+            mm[r0:r1, c0:c1] = blk[:r1 - r0, :c1 - c0]
+        mm.flush()
+    finally:
+        del mm
+    if multi:
+        multihost_utils.sync_global_devices(f"binary_sharded:done:{path}")
+
+
 def read_binary(path, shape) -> np.ndarray:
     a = np.fromfile(path, dtype=np.float32)
     expected = int(np.prod(shape))
@@ -33,13 +79,30 @@ def read_binary(path, shape) -> np.ndarray:
     return a.reshape(shape)
 
 
-def save_checkpoint(u, step: int, config, path) -> None:
+def save_checkpoint(u, step: int, config, path, shape=None) -> None:
     """State dump + sidecar. ``path`` is the binary file; sidecar is
-    ``path + '.meta.json'``."""
-    write_binary(u, path)
+    ``path + '.meta.json'``.
+
+    Host arrays write locally (call on one rank). A host-spanning
+    jax.Array writes via write_binary_sharded — then the call is
+    COLLECTIVE (all processes) and rank 0 writes the sidecar; pass
+    ``shape`` to crop equal-shard padding.
+    """
+    if not getattr(u, "is_fully_addressable", True):
+        write_binary_sharded(u, path, shape=shape)
+        import jax
+        if jax.process_index() != 0:
+            return
+        out_shape = shape if shape is not None else u.shape
+    else:
+        u = np.asarray(u)
+        if shape is not None and tuple(u.shape) != tuple(shape):
+            u = u[:shape[0], :shape[1]]
+        write_binary(u, path)
+        out_shape = u.shape
     meta = {
         "step": int(step),
-        "shape": [int(s) for s in np.asarray(u).shape],
+        "shape": [int(s) for s in out_shape],
         "dtype": "float32",
         "config": config.to_dict() if hasattr(config, "to_dict") else dict(config or {}),
         "format": "heat2d-tpu-checkpoint-v1",

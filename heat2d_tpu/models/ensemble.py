@@ -3,17 +3,28 @@
 The reference solves exactly one problem instance per launch (SURVEY.md
 §2.3: "DP over batch / replicas — ABSENT"); parameter sweeps in Report.pdf
 were separate compiles/runs per configuration. This module adds the
-capability the survey flags as the natural TPU extension: ``vmap`` the
-whole time loop over a batch of (cx, cy) diffusivity pairs (or a batch of
-initial grids), so one compiled program advances every ensemble member in
-lockstep — on one chip via vectorization, or sharded over a mesh axis with
-the spatial modes unchanged.
+capability the survey flags as the natural TPU extension, as a real mode
+of the framework (CLI: ``--ensemble-cx/--ensemble-cy``):
+
+- ``jnp`` method: ``vmap`` the whole time loop over the (cx, cy) batch —
+  one compiled program advances every member in lockstep.
+- ``pallas`` method: one kernel launch for the whole batch — the program
+  grid walks members, each VMEM-resident, with its (cx, cy) pair riding
+  as an SMEM scalar block (the diffusivities are traced per-member
+  values, so they are kernel *operands* here, not the baked constants the
+  single-instance kernels use).
+- ``run_ensemble_sharded``: the batch as a mesh axis — members shard
+  across devices (`shard_map` over a 1D 'b' mesh, batch padded to a
+  device multiple with inert members), each device advancing its members
+  through the same single-chip paths. This is DP over replicas on ICI.
 
 This is how the reference's Table-4-style parameter studies collapse into
 a single launch.
 """
 
 from __future__ import annotations
+
+import functools
 
 import jax
 import jax.numpy as jnp
@@ -24,13 +35,7 @@ from heat2d_tpu.ops.init import inidat
 from heat2d_tpu.ops.stencil import stencil_step
 
 
-def run_ensemble(nx: int, ny: int, steps: int, cxs, cys, u0=None):
-    """Advance an ensemble of diffusivity pairs ``steps`` steps.
-
-    ``cxs``/``cys``: 1D arrays of equal length B. ``u0``: optional (B, nx,
-    ny) batch of initial grids; defaults to B copies of the reference
-    initial condition (mpi_heat2Dn.c:242-248). Returns (B, nx, ny).
-    """
+def _validated_batch(nx, ny, cxs, cys, u0):
     cxs = jnp.asarray(cxs, jnp.float32)
     cys = jnp.asarray(cys, jnp.float32)
     if cxs.shape != cys.shape or cxs.ndim != 1:
@@ -41,12 +46,151 @@ def run_ensemble(nx: int, ny: int, steps: int, cxs, cys, u0=None):
     if u0.shape != (cxs.shape[0], nx, ny):
         raise ValueError(
             f"u0 must be ({cxs.shape[0]}, {nx}, {ny}), got {u0.shape}")
+    return cxs, cys, u0
 
+
+def _run_batch_jnp(u0, cxs, cys, *, steps):
     def solve_one(u, cx, cy):
         u, _ = engine.run_fixed(lambda v: stencil_step(v, cx, cy), u, steps)
         return u
 
-    return jax.jit(jax.vmap(solve_one))(u0, cxs, cys)
+    return jax.vmap(solve_one)(u0, cxs, cys)
+
+
+def _ensemble_kernel(s_ref, u_ref, out_ref, *, steps):
+    from heat2d_tpu.ops.pallas_stencil import _step_value
+    cx = s_ref[0, 0]
+    cy = s_ref[0, 1]
+    u = u_ref[0]
+    u = jax.lax.fori_loop(0, steps,
+                          lambda _, v: _step_value(v, cx, cy), u,
+                          unroll=False)
+    out_ref[0] = u
+
+
+def _run_batch_pallas(u0, cxs, cys, *, steps):
+    """One pallas_call for the whole batch: program grid over members,
+    each member's grid VMEM-resident for all ``steps`` (the
+    multi_step_vmem design batched; members must individually pass
+    fits_vmem — callers route)."""
+    from jax.experimental import pallas as pl
+    from heat2d_tpu.ops.pallas_stencil import _interpret, pltpu
+
+    b, nx, ny = u0.shape
+    scal = jnp.stack([cxs, cys], axis=1)          # (B, 2)
+    mspace, smem = {}, {}
+    if pltpu is not None and not _interpret():
+        mspace = dict(memory_space=pltpu.VMEM)
+        smem = dict(memory_space=pltpu.SMEM)
+    grid_spec = pl.GridSpec(
+        grid=(b,),
+        in_specs=[
+            pl.BlockSpec((1, 2), lambda i: (i, 0), **smem),
+            pl.BlockSpec((1, nx, ny), lambda i: (i, 0, 0), **mspace),
+        ],
+        out_specs=pl.BlockSpec((1, nx, ny), lambda i: (i, 0, 0), **mspace),
+    )
+    return pl.pallas_call(
+        functools.partial(_ensemble_kernel, steps=steps),
+        out_shape=jax.ShapeDtypeStruct(u0.shape, u0.dtype),
+        grid_spec=grid_spec,
+        interpret=_interpret())(scal, u0)
+
+
+def _pick_method(method, nx, ny):
+    if method != "auto":
+        return method
+    from heat2d_tpu.ops.pallas_stencil import fits_vmem
+    return "pallas" if fits_vmem((nx, ny)) else "jnp"
+
+
+def run_ensemble(nx: int, ny: int, steps: int, cxs, cys, u0=None,
+                 method: str = "auto"):
+    """Advance an ensemble of diffusivity pairs ``steps`` steps.
+
+    ``cxs``/``cys``: 1D arrays of equal length B. ``u0``: optional (B, nx,
+    ny) batch of initial grids; defaults to B copies of the reference
+    initial condition (mpi_heat2Dn.c:242-248). Returns (B, nx, ny).
+
+    ``method``: 'jnp' (vmap), 'pallas' (batched kernel, members must be
+    VMEM-resident), or 'auto' (pallas when a member fits VMEM).
+    """
+    cxs, cys, u0 = _validated_batch(nx, ny, cxs, cys, u0)
+    method = _pick_method(method, nx, ny)
+    fn, args, b = _build_single(steps, method, u0, cxs, cys)
+    return fn(*args)
+
+
+def _build_single(steps, method, u0, cxs, cys):
+    run = _run_batch_pallas if method == "pallas" else _run_batch_jnp
+    fn = jax.jit(functools.partial(run, steps=steps))
+    return fn, (u0, cxs, cys), cxs.shape[0]
+
+
+def _build_sharded(steps, method, u0, cxs, cys, devices):
+    """Jitted shard_map program + placed inputs for a batch-axis mesh;
+    pads the batch to a device multiple with inert members (cx=cy=0)."""
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    try:
+        shard_map = jax.shard_map
+    except AttributeError:  # pragma: no cover
+        from jax.experimental.shard_map import shard_map
+
+    devices = list(devices if devices is not None else jax.devices())
+    b, nx, ny = u0.shape
+    nd = min(len(devices), b)
+    devices = devices[:nd]
+    pad = (-b) % nd
+    if pad:
+        cxs = jnp.concatenate([cxs, jnp.zeros((pad,), cxs.dtype)])
+        cys = jnp.concatenate([cys, jnp.zeros((pad,), cys.dtype)])
+        u0 = jnp.concatenate(
+            [u0, jnp.zeros((pad, nx, ny), u0.dtype)], axis=0)
+
+    mesh = Mesh(np.asarray(devices), ("b",))
+    run = _run_batch_pallas if method == "pallas" else _run_batch_jnp
+
+    def local(u, cx, cy):
+        return run(u, cx, cy, steps=steps)
+
+    mapped = shard_map(local, mesh=mesh, in_specs=P("b"), out_specs=P("b"),
+                       check_vma=False)
+    sharding = NamedSharding(mesh, P("b"))
+    u0 = jax.device_put(u0, sharding)
+    cxs = jax.device_put(cxs, sharding)
+    cys = jax.device_put(cys, sharding)
+    return jax.jit(mapped), (u0, cxs, cys), b
+
+
+def run_ensemble_sharded(nx: int, ny: int, steps: int, cxs, cys, u0=None,
+                         method: str = "auto", devices=None):
+    """Ensemble with the batch as a mesh axis: members shard over devices
+    (DP over replicas — SURVEY.md §2.3), each device advancing its local
+    members through the single-chip batch path. Returns (B, nx, ny)."""
+    cxs, cys, u0 = _validated_batch(nx, ny, cxs, cys, u0)
+    method = _pick_method(method, nx, ny)
+    fn, args, b = _build_sharded(steps, method, u0, cxs, cys, devices)
+    return fn(*args)[:b]
+
+
+def timed_ensemble(nx: int, ny: int, steps: int, cxs, cys, u0=None,
+                   method: str = "auto", sharded: bool = False,
+                   devices=None):
+    """(batch, elapsed): one ensemble launch under the reference timing
+    protocol (compile/warmup excluded, scalar-readback fence) — the CLI
+    entry point. ``sharded=True`` spreads members over a device-mesh
+    batch axis."""
+    from heat2d_tpu.utils.timing import timed_call
+
+    cxs, cys, u0 = _validated_batch(nx, ny, cxs, cys, u0)
+    method = _pick_method(method, nx, ny)
+    if sharded:
+        fn, args, b = _build_sharded(steps, method, u0, cxs, cys, devices)
+    else:
+        fn, args, b = _build_single(steps, method, u0, cxs, cys)
+    out, elapsed = timed_call(fn, *args)
+    return out[:b], elapsed
 
 
 def ensemble_summary(batch) -> dict:

@@ -157,12 +157,19 @@ class Heat2DSolver:
         self._runner = jax.jit(run)
         return self._runner
 
-    def run(self, u0=None, timed: bool = True,
-            warmup: bool = True) -> RunResult:
+    def run(self, u0=None, timed: bool = True, warmup: bool = True,
+            gather: bool = True) -> RunResult:
         """Init (unless given), step, gather. Timing follows the reference
         protocol: compile excluded (warmup), barrier-fenced, max over
         processes (SURVEY.md §5.1). Pass ``warmup=False`` on repeat calls
-        of an already-executed runner to skip the untimed priming run."""
+        of an already-executed runner to skip the untimed priming run.
+
+        ``gather=False`` skips the cross-host allgather and padding crop:
+        ``result.u`` stays the (possibly host-spanning, possibly padded)
+        device array, for callers that write output per-shard
+        (io.write_binary_sharded — the MPI-IO path) instead of
+        materializing the global grid on every host.
+        """
         if u0 is None:
             u0 = self.init_state()
         runner = self.make_runner()
@@ -171,16 +178,17 @@ class Heat2DSolver:
         else:
             u, k = jax.block_until_ready(runner(u0))
             elapsed = float("nan")
-        if not getattr(u, "is_fully_addressable", True):
-            # Sharded output spans non-addressable devices; assemble the
-            # global grid on every host (the MPI result gather). Fully-
-            # addressable outputs (single-host, or replicated non-sharded
-            # modes under multihost) convert directly.
-            from jax.experimental import multihost_utils
-            u = multihost_utils.process_allgather(u, tiled=True)
-        u = np.asarray(u)
-        if u.shape != self.config.shape:
-            # Strip the equal-shard padding (uneven decomposition).
-            u = u[:self.config.nxprob, :self.config.nyprob]
+        if gather:
+            if not getattr(u, "is_fully_addressable", True):
+                # Sharded output spans non-addressable devices; assemble
+                # the global grid on every host (the MPI result gather).
+                # Fully-addressable outputs (single-host, or replicated
+                # non-sharded modes under multihost) convert directly.
+                from jax.experimental import multihost_utils
+                u = multihost_utils.process_allgather(u, tiled=True)
+            u = np.asarray(u)
+            if u.shape != self.config.shape:
+                # Strip the equal-shard padding (uneven decomposition).
+                u = u[:self.config.nxprob, :self.config.nyprob]
         return RunResult(u=u, steps_done=int(k),
                          elapsed=elapsed, config=self.config)

@@ -54,3 +54,49 @@ def test_two_process_dist2d_matches_serial(tmp_path, oracle):
     got = read_grid_text(tmp_path / "final.dat", "rowmajor")
     ref = oracle.run(16, 16, 10)
     np.testing.assert_allclose(got, ref, atol=0.05)  # %6.1f resolution
+
+
+def test_two_process_parallel_binary_write(tmp_path):
+    """The MPI_File_write_all analogue across real processes: each rank
+    writes its shards into the one file; result must be byte-identical to
+    a serial run's dump, with text conversion fed by rank-0 read-back
+    (no cross-host allgather in the --dat-layout none path at all)."""
+    port = _free_port()
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("JAX_PLATFORMS", "XLA_FLAGS")}
+    procs = []
+    for i in range(2):
+        procs.append(subprocess.Popen(
+            [sys.executable, "-m", "heat2d_tpu.cli", "--mode", "dist2d",
+             "--gridx", "2", "--gridy", "2",
+             "--nxprob", "16", "--nyprob", "16", "--steps", "10",
+             "--platform", "cpu", "--host-device-count", "2",
+             "--coordinator", f"localhost:{port}",
+             "--num-processes", "2", "--process-id", str(i),
+             "--binary-dumps", "--dat-layout", "none",
+             "--checkpoint", str(tmp_path / "ck.bin"),
+             "--outdir", str(tmp_path)],
+            cwd=REPO, env=env,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True))
+    outs = [p.communicate(timeout=220)[0] for p in procs]
+    assert all(p.returncode == 0 for p in procs), outs
+
+    # Serial single-process run for the byte-identical reference files.
+    sdir = tmp_path / "serial"
+    rc = subprocess.run(
+        [sys.executable, "-m", "heat2d_tpu.cli", "--mode", "serial",
+         "--nxprob", "16", "--nyprob", "16", "--steps", "10",
+         "--platform", "cpu", "--binary-dumps", "--dat-layout", "none",
+         "--outdir", str(sdir)],
+        cwd=REPO, env=env, capture_output=True, text=True)
+    assert rc.returncode == 0, rc.stdout + rc.stderr
+
+    for name in ("initial_binary.dat", "final_binary.dat"):
+        assert ((tmp_path / name).read_bytes()
+                == (sdir / name).read_bytes()), name
+    # Collective per-shard checkpoint: loadable, correct step count.
+    from heat2d_tpu.io import load_checkpoint
+    grid, step, _ = load_checkpoint(str(tmp_path / "ck.bin"))
+    assert step == 10 and grid.shape == (16, 16)
+    np.testing.assert_array_equal(
+        grid.tobytes(), (sdir / "final_binary.dat").read_bytes())

@@ -1,0 +1,39 @@
+"""Per-shard parallel binary output (SURVEY.md C15 write path — the
+MPI_File_write_all analogue, grad1612_mpi_heat.c:182-189): every process
+writes its addressable shards at their global row-major offsets; nobody
+materializes the full grid. Single-host coverage here; the genuinely
+multi-process path is exercised in test_multihost.py."""
+
+import numpy as np
+
+from heat2d_tpu.config import HeatConfig
+from heat2d_tpu.io import read_binary, write_binary_sharded
+from heat2d_tpu.models.solver import Heat2DSolver
+
+
+def test_sharded_write_matches_serial_grid(tmp_path):
+    cfg = HeatConfig(nxprob=16, nyprob=16, steps=12, mode="dist2d",
+                     gridx=2, gridy=4)
+    r = Heat2DSolver(cfg).run(timed=False, gather=False)
+    path = tmp_path / "final_binary.dat"
+    write_binary_sharded(r.u, path, shape=cfg.shape)
+    got = read_binary(path, cfg.shape)
+    want = Heat2DSolver(cfg.replace(mode="serial", gridx=1, gridy=1)
+                        ).run(timed=False).u
+    np.testing.assert_array_equal(got, want)
+
+
+def test_sharded_write_crops_uneven_padding(tmp_path):
+    """10 rows over 3 workers pads shards to 12 rows; the file must be the
+    exact 10x10 reference layout (pad rows cropped at the write)."""
+    cfg = HeatConfig(nxprob=10, nyprob=10, steps=7, mode="dist1d",
+                     numworkers=3)
+    r = Heat2DSolver(cfg).run(timed=False, gather=False)
+    assert np.asarray(r.u).shape[0] == 12   # padded (pre-crop) carrier
+    path = tmp_path / "final_binary.dat"
+    write_binary_sharded(r.u, path, shape=cfg.shape)
+    assert path.stat().st_size == 10 * 10 * 4
+    got = read_binary(path, cfg.shape)
+    want = Heat2DSolver(cfg.replace(mode="serial", numworkers=None)
+                        ).run(timed=False).u
+    np.testing.assert_array_equal(got, want)

@@ -16,12 +16,16 @@ def test_mesh_shapes():
 
 
 def test_run_point_has_reference_columns():
-    rec = sweep.run_point("serial", 80, 64, 100)
-    assert rec["steps"] == 100
+    rec = sweep.run_point("serial", 80, 64, 100, max_hi=1000)
+    assert rec["steps"] >= 100      # adaptive two-point may grow hi
     assert rec["mcells_per_s"] > 0
-    # 80x64 at 100 steps matches a published Table 1 cell.
-    assert rec["ref_serial_s"] == 2.53e-2
-    assert rec["speedup_vs_ref_serial"] > 0
+    assert rec["method"].startswith(("two-point", "end-to-end"))
+    if rec["method"] == "two-point":
+        # 80x64 compares against a published Table 1 cell via marginal
+        # step time x 100.
+        assert rec["ref_serial_100step_s"] == 2.53e-2
+        assert rec["speedup_vs_ref_serial"] > 0
+        assert rec["step_time_s"] > 0
 
 
 def test_sweep_quick_end_to_end(tmp_path):
