@@ -219,6 +219,20 @@ def _run_ensemble_cli(args, cfg) -> int:
         print("ensemble runs are fixed-step (--convergence unsupported)"
               "\nQuitting...", file=sys.stderr)
         return 1
+    # Flags the ensemble path would silently ignore are rejected, the same
+    # way --convergence is: a user combining them must not believe they
+    # took effect.
+    unsupported = [flag for flag, on in [
+        ("--binary-dumps", args.binary_dumps),
+        ("--checkpoint", args.checkpoint is not None),
+        ("--checkpoint-every", args.checkpoint_every is not None),
+        ("--resume", args.resume is not None),
+        ("--profile", args.profile is not None)] if on]
+    if unsupported:
+        print(f"ensemble runs do not support {', '.join(unsupported)} "
+              f"(members are dumped as final_m<i>.dat only)\nQuitting...",
+              file=sys.stderr)
+        return 1
 
     primary = jax.process_index() == 0
     sharded = cfg.mode in ("dist1d", "dist2d", "hybrid")
@@ -233,7 +247,11 @@ def _run_ensemble_cli(args, cfg) -> int:
     except (ConfigError, ValueError) as e:
         print(f"{e}\nQuitting...", file=sys.stderr)
         return 1
-    batch = np.asarray(batch)
+    # Multihost: the sharded batch spans processes — gather before any
+    # host-side conversion (np.asarray on a non-addressable array raises
+    # on every rank).
+    from heat2d_tpu.parallel.multihost import gather_to_host
+    batch = gather_to_host(batch)
     if primary:
         print(f"Elapsed time: {elapsed:e} sec")
         os.makedirs(args.outdir, exist_ok=True)
@@ -321,14 +339,7 @@ def main(argv=None) -> int:
         if primary:
             print(msg)
 
-    def to_host(u):
-        """Assemble the full grid on this host (cross-host gather only when
-        the array actually spans non-addressable devices — the MPI
-        result-gather; host arrays and replicated outputs pass through)."""
-        if not getattr(u, "is_fully_addressable", True):
-            from jax.experimental import multihost_utils
-            u = multihost_utils.process_allgather(u, tiled=True)
-        return np.asarray(u)
+    from heat2d_tpu.parallel.multihost import gather_to_host as to_host
 
     # Startup banner (grad1612_mpi_heat.c:66-69).
     say(f"Starting with {cfg.n_shards} shards")

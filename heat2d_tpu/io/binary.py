@@ -88,27 +88,37 @@ def save_checkpoint(u, step: int, config, path, shape=None) -> None:
     COLLECTIVE (all processes) and rank 0 writes the sidecar; pass
     ``shape`` to crop equal-shard padding.
     """
-    if not getattr(u, "is_fully_addressable", True):
+    collective = not getattr(u, "is_fully_addressable", True)
+    if collective:
         write_binary_sharded(u, path, shape=shape)
         import jax
-        if jax.process_index() != 0:
-            return
+        primary = jax.process_index() == 0
         out_shape = shape if shape is not None else u.shape
     else:
+        primary = True
         u = np.asarray(u)
         if shape is not None and tuple(u.shape) != tuple(shape):
             u = u[:shape[0], :shape[1]]
         write_binary(u, path)
         out_shape = u.shape
-    meta = {
-        "step": int(step),
-        "shape": [int(s) for s in out_shape],
-        "dtype": "float32",
-        "config": config.to_dict() if hasattr(config, "to_dict") else dict(config or {}),
-        "format": "heat2d-tpu-checkpoint-v1",
-    }
-    with open(str(path) + ".meta.json", "w") as f:
-        json.dump(meta, f, indent=2)
+    if primary:
+        meta = {
+            "step": int(step),
+            "shape": [int(s) for s in out_shape],
+            "dtype": "float32",
+            "config": config.to_dict() if hasattr(config, "to_dict") else dict(config or {}),
+            "format": "heat2d-tpu-checkpoint-v1",
+        }
+        with open(str(path) + ".meta.json", "w") as f:
+            json.dump(meta, f, indent=2)
+    if collective:
+        import jax
+        if jax.process_count() > 1:
+            # No rank may return before the sidecar exists: a driver that
+            # proceeds on a non-zero rank (e.g. immediately resumes) must
+            # not race a missing/stale sidecar.
+            from jax.experimental import multihost_utils
+            multihost_utils.sync_global_devices(f"checkpoint:meta:{path}")
 
 
 def load_checkpoint(path, shape=None):

@@ -132,10 +132,7 @@ def _build_sharded(steps, method, u0, cxs, cys, devices):
     pads the batch to a device multiple with inert members (cx=cy=0)."""
     from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-    try:
-        shard_map = jax.shard_map
-    except AttributeError:  # pragma: no cover
-        from jax.experimental.shard_map import shard_map
+    from heat2d_tpu.parallel.mesh import shard_map_compat
 
     devices = list(devices if devices is not None else jax.devices())
     b, nx, ny = u0.shape
@@ -154,8 +151,8 @@ def _build_sharded(steps, method, u0, cxs, cys, devices):
     def local(u, cx, cy):
         return run(u, cx, cy, steps=steps)
 
-    mapped = shard_map(local, mesh=mesh, in_specs=P("b"), out_specs=P("b"),
-                       check_vma=False)
+    mapped = shard_map_compat(local, mesh, in_specs=P("b"),
+                              out_specs=P("b"), check_vma=False)
     sharding = NamedSharding(mesh, P("b"))
     u0 = jax.device_put(u0, sharding)
     cxs = jax.device_put(cxs, sharding)

@@ -179,14 +179,8 @@ class Heat2DSolver:
             u, k = jax.block_until_ready(runner(u0))
             elapsed = float("nan")
         if gather:
-            if not getattr(u, "is_fully_addressable", True):
-                # Sharded output spans non-addressable devices; assemble
-                # the global grid on every host (the MPI result gather).
-                # Fully-addressable outputs (single-host, or replicated
-                # non-sharded modes under multihost) convert directly.
-                from jax.experimental import multihost_utils
-                u = multihost_utils.process_allgather(u, tiled=True)
-            u = np.asarray(u)
+            from heat2d_tpu.parallel.multihost import gather_to_host
+            u = gather_to_host(u)
             if u.shape != self.config.shape:
                 # Strip the equal-shard padding (uneven decomposition).
                 u = u[:self.config.nxprob, :self.config.nyprob]

@@ -40,6 +40,26 @@ def make_mesh(gridx: int, gridy: int = 1, devices=None,
         return Mesh(dev, axis_names)
 
 
+def shard_map_compat(f, mesh, in_specs, out_specs, check_vma=None):
+    """``shard_map`` across jax versions — the ONE place the two version
+    quirks live: jax>=0.6 moved it to the top level, and older versions
+    lack the ``check_vma`` kwarg (needed as False wherever a pallas_call
+    runs inside the shard: kernel out_shapes carry no
+    varying-across-mesh-axes info). Every call site uses this so all have
+    identical version tolerance."""
+    try:
+        shard_map = jax.shard_map
+    except AttributeError:  # pragma: no cover
+        from jax.experimental.shard_map import shard_map
+    if check_vma is not None:
+        try:
+            return shard_map(f, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, check_vma=check_vma)
+        except TypeError:  # older jax: no check_vma kwarg
+            pass
+    return shard_map(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs)
+
+
 def mesh_devices_summary(mesh: Mesh) -> dict:
     """Device/topology introspection — the detailsGPU analogue
     (grad1612_cuda_heat.cu:24-37), as structured data."""

@@ -58,6 +58,19 @@ def world_summary() -> dict:
     }
 
 
+def gather_to_host(u):
+    """Full array on this host as numpy — the MPI result-gather. Arrays
+    spanning non-addressable devices allgather first (tiled: shards
+    concatenate back into the global array); host arrays and replicated
+    outputs convert directly. The one gather idiom every output path
+    (solver.run, CLI text dumps, ensemble batches) shares."""
+    import numpy as np
+    if not getattr(u, "is_fully_addressable", True):
+        from jax.experimental import multihost_utils
+        u = multihost_utils.process_allgather(u, tiled=True)
+    return np.asarray(u)
+
+
 def shutdown_distributed() -> None:
     """MPI_Finalize analogue; no-op when never initialized."""
     global _initialized

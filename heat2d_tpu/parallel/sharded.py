@@ -23,15 +23,11 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-try:  # jax>=0.6 exposes shard_map at top level
-    shard_map = jax.shard_map
-except AttributeError:  # pragma: no cover
-    from jax.experimental.shard_map import shard_map
-
 from heat2d_tpu.models import engine
 from heat2d_tpu.ops.init import inidat_block
 from heat2d_tpu.ops.stencil import residual_sq, stencil_step_padded
 from heat2d_tpu.parallel.halo import exchange_halo_2d_wide
+from heat2d_tpu.parallel.mesh import shard_map_compat
 
 #: Default wide-halo depth (config.halo_depth=None): 8 steps per exchange,
 #: clamped to the shard size in make_local_chunk.
@@ -175,18 +171,12 @@ def make_sharded_runner(config, mesh: Mesh, chunk_kernel=None):
             k = jnp.asarray(config.steps, jnp.int32)
         return u, k
 
-    try:
-        mapped = shard_map(local_run, mesh=mesh,
-                           in_specs=P(ax, ay),
-                           out_specs=(P(ax, ay), P()),
-                           # pallas_call out_shapes carry no vma info; skip
-                           # the varying-across-mesh-axes check when a
-                           # kernel runs inside the shard (hybrid mode)
-                           check_vma=chunk_kernel is None)
-    except TypeError:  # older jax: no check_vma kwarg
-        mapped = shard_map(local_run, mesh=mesh,
-                           in_specs=P(ax, ay),
-                           out_specs=(P(ax, ay), P()))
+    # check_vma off in hybrid mode: pallas_call out_shapes carry no
+    # varying-across-mesh-axes info.
+    mapped = shard_map_compat(local_run, mesh,
+                              in_specs=P(ax, ay),
+                              out_specs=(P(ax, ay), P()),
+                              check_vma=chunk_kernel is None)
     runner = jax.jit(mapped)
     return runner, sharding
 
@@ -210,6 +200,6 @@ def sharded_inidat(config, mesh: Mesh):
         gj = y0 + lax.broadcasted_iota(jnp.int32, (bm, bn), 1)
         return jnp.where((gi < nx) & (gj < ny), val, 0.0)
 
-    fn = jax.jit(shard_map(local_init, mesh=mesh, in_specs=(),
-                           out_specs=P(ax, ay)))
+    fn = jax.jit(shard_map_compat(local_init, mesh, in_specs=(),
+                                  out_specs=P(ax, ay)))
     return fn()
