@@ -95,6 +95,11 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--accum-dtype", default="float32",
                    choices=["float32", "float64"],
                    help="float64 mirrors the C reference's double promotion")
+    p.add_argument("--bitwise-parity", action="store_true",
+                   help="pallas/hybrid modes: use the literal reference "
+                        "stencil expression instead of the faster FMA "
+                        "factoring, making results bitwise identical to "
+                        "--mode serial (serial/dist1d/dist2d already are)")
     p.add_argument("--debug", action="store_true")
     p.add_argument("--device-info", action="store_true",
                    help="print device summary (detailsGPU analogue) and exit")
@@ -307,7 +312,8 @@ def main(argv=None) -> int:
             sensitivity=args.sensitivity, mode=args.mode,
             accum_dtype=args.accum_dtype, numworkers=args.numworkers,
             strict_baseline=args.strict_baseline, debug=args.debug,
-            halo_depth=args.halo_depth)
+            halo_depth=args.halo_depth,
+            bitwise_parity=args.bitwise_parity)
     except ConfigError as e:
         print(f"{e}\nQuitting...", file=sys.stderr)
         return 1

@@ -70,6 +70,14 @@ class HeatConfig:
     # through double (literals 0.1/2.0 — SURVEY.md Appendix B); f32 is the
     # TPU-fast path. Storage is always float32, as in the reference.
     accum_dtype: str = "float32"   # "float32" | "float64"
+    # Kernel step form for the pallas/hybrid modes. Default: the FMA
+    # factoring (1-2cx-2cy)*c + cx*(N+S) + cy*(E+W) — ~24% faster on the
+    # VPU, f32-ulp from the literal form. True: the literal reference
+    # expression c + cx*(N+S-2c) + cy*(E+W-2c)
+    # (grad1612_cuda_heat.cu:59-61), making kernel results BITWISE
+    # identical to serial mode — the verification escape hatch the parity
+    # tests pin. (serial/dist1d/dist2d always use the literal form.)
+    bitwise_parity: bool = False
 
     # -- baseline-mode knobs (mpi_heat2Dn.c:32-33) --------------------------
     # Number of row-strip shards for dist1d. The reference requires 3..8

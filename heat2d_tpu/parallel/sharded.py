@@ -26,7 +26,8 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from heat2d_tpu.models import engine
 from heat2d_tpu.ops.init import inidat_block
 from heat2d_tpu.ops.stencil import residual_sq, stencil_step_padded
-from heat2d_tpu.parallel.halo import exchange_halo_2d_wide
+from heat2d_tpu.parallel.halo import (exchange_halo_2d_wide,
+                                      exchange_halo_strips)
 from heat2d_tpu.parallel.mesh import shard_map_compat
 
 #: Default wide-halo depth (config.halo_depth=None): 8 steps per exchange,
@@ -84,12 +85,14 @@ def make_local_chunk(config, mesh: Mesh, chunk_kernel=None):
     (which never update). Returns ``chunk(u, t)`` with static t in
     [1, min(bm, bn)].
 
-    ``chunk_kernel``: optional ``(ext, t, row0, col0) -> ext`` advancing
-    the whole extended block t steps in one Pallas invocation (mode=
-    'hybrid', ops.pallas_stencil.make_shard_chunk_kernel) — VMEM-routed
-    so arbitrarily large shards stream in row bands instead of OOMing.
-    Only the [t:-t, t:-t] center of its result is exact, which is all
-    this function keeps.
+    ``chunk_kernel``: optional ``(u, strips, t, x0, y0) -> u_new``
+    advancing the shard block t steps in one Pallas invocation (mode=
+    'hybrid', ops.pallas_stencil.make_shard_chunk_kernel) — it takes the
+    four halo strips directly and assembles the extended block in VMEM,
+    so only strip-sized arrays ever move through HBM around the kernel
+    (the round-2 path paid three full-block HBM round-trips per chunk).
+    VMEM-routed so arbitrarily large shards stream in row bands instead
+    of OOMing.
     """
     ax, ay = mesh.axis_names
     gx, gy = (mesh.devices.shape[0], mesh.devices.shape[1])
@@ -100,22 +103,22 @@ def make_local_chunk(config, mesh: Mesh, chunk_kernel=None):
     cx, cy = config.cx, config.cy
 
     def chunk(u, t):
-        ext = exchange_halo_2d_wide(u, ax, ay, gx, gy, t)
-        row0 = lax.axis_index(ax) * bm - t
-        col0 = lax.axis_index(ay) * bn - t
+        x0 = lax.axis_index(ax) * bm
+        y0 = lax.axis_index(ay) * bn
         if chunk_kernel is not None:
-            ext = chunk_kernel(ext, t, row0, col0)
-        else:
-            keep = _keep_mask((bm + 2 * t, bn + 2 * t), nx, ny, row0, col0)
+            strips = exchange_halo_strips(u, ax, ay, gx, gy, t)
+            return chunk_kernel(u, strips, t, x0, y0)
+        ext = exchange_halo_2d_wide(u, ax, ay, gx, gy, t)
+        keep = _keep_mask((bm + 2 * t, bn + 2 * t), nx, ny, x0 - t, y0 - t)
 
-            def one(_, v):
-                newint = stencil_step_padded(v, cx, cy, accum)
-                mid = jnp.concatenate([v[1:-1, :1], newint, v[1:-1, -1:]],
-                                      axis=1)
-                full = jnp.concatenate([v[:1, :], mid, v[-1:, :]], axis=0)
-                return jnp.where(keep, v, full)
+        def one(_, v):
+            newint = stencil_step_padded(v, cx, cy, accum)
+            mid = jnp.concatenate([v[1:-1, :1], newint, v[1:-1, -1:]],
+                                  axis=1)
+            full = jnp.concatenate([v[:1, :], mid, v[-1:, :]], axis=0)
+            return jnp.where(keep, v, full)
 
-            ext = lax.fori_loop(0, t, one, ext, unroll=False)
+        ext = lax.fori_loop(0, t, one, ext, unroll=False)
         return ext[t:-t, t:-t]
 
     return chunk

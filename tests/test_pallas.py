@@ -102,6 +102,36 @@ def test_fits_vmem():
     assert not fits_vmem((4096, 4096))  # headline config streams
 
 
+@pytest.mark.parametrize("shape", [(32, 128),     # VMEM-resident: kernel A
+                                   (96, 20000)])  # HBM-routed: kernels B/C
+def test_pallas_mode_bitwise_parity_flag(shape):
+    """--bitwise-parity must make BOTH pallas routes (VMEM-resident and
+    band-streamed) bitwise identical to serial, not silently no-op."""
+    nx, ny = shape
+    cfg = HeatConfig(nxprob=nx, nyprob=ny, steps=17, mode="pallas",
+                     bitwise_parity=True)
+    got = Heat2DSolver(cfg).run(timed=False)
+    want = Heat2DSolver(cfg.replace(mode="serial")).run(timed=False)
+    np.testing.assert_array_equal(got.u, want.u)
+
+
+def test_shard_band_shallow_fallback_bitwise():
+    """rb < t (deep halo, tiny band) must fall back to depth-1 sweeps on
+    the assembled block and stay bitwise — the config class the round-2
+    stepwise path served."""
+    from heat2d_tpu.ops.pallas_stencil import _shard_band_chunk
+    nx = ny = 48
+    t = 12          # > rb=8: forces the fallback
+    g = np.zeros((nx + 2 * t, ny + 2 * t), np.float32)
+    g[t:-t, t:-t] = np.asarray(inidat(nx, ny))
+    ext = jnp.asarray(g)   # whole grid as one "shard" with zero halos
+    u, strips = _strips_from_ext(ext, t)
+    scalars = jnp.asarray([0, 0], jnp.int32)
+    got = _shard_band_chunk(u, strips, scalars, t, 0.1, 0.1, nx, ny, bm=8)
+    want = _golden_shard_chunk(ext, t, -t, -t, nx, ny)
+    np.testing.assert_array_equal(np.asarray(got), want[t:-t, t:-t])
+
+
 def test_pallas_mode_solver_matches_serial():
     cfg = HeatConfig(nxprob=32, nyprob=128, steps=20, mode="pallas")
     got = Heat2DSolver(cfg).run(timed=False)
@@ -139,13 +169,26 @@ def _golden_shard_chunk(ext, t, row0, col0, nx, ny):
     return np.asarray(v)
 
 
+def _strips_from_ext(ext, t):
+    """Fused-kernel operands from a pre-assembled extended block: the
+    (bm, bn) center plus (north, south, west, east) halo strips in the
+    exchange_halo_strips layout (west/east carry the corners)."""
+    u = ext[t:-t, t:-t]
+    north = ext[:t, t:-t]
+    south = ext[-t:, t:-t]
+    west = ext[:, :t]
+    east = ext[:, -t:]
+    return u, (north, south, west, east)
+
+
 @pytest.mark.parametrize("si,sj", [(0, 0), (0, 1), (1, 0), (1, 1)])
-@pytest.mark.parametrize("variant", ["vmem", "band"])
+@pytest.mark.parametrize("variant", ["vmem", "band", "band-uneven"])
 def test_shard_chunk_kernels_center_bitwise(si, sj, variant):
     """Kernel D (both routes) must reproduce the golden wide-halo loop's
     kept center bitwise, at every shard position of a 2x2 decomposition
     (covers all global-boundary/ghost-corner cases). The band route runs
-    with bm=8 so a 22-row block splits into 3 bands + padding."""
+    with rb=8 (16-row block = 2 bands); 'band-uneven' with rb=12 so the
+    block pads and the south strip embeds below the domain rows."""
     from heat2d_tpu.ops.pallas_stencil import (_shard_band_chunk,
                                                _shard_vmem_chunk)
     nx = ny = 32
@@ -155,15 +198,16 @@ def test_shard_chunk_kernels_center_bitwise(si, sj, variant):
     g[t:-t, t:-t] = np.asarray(inidat(nx, ny))
     r0, c0 = si * bm, sj * bn
     ext = jnp.asarray(g[r0:r0 + bm + 2 * t, c0:c0 + bn + 2 * t])
-    row0, col0 = r0 - t, c0 - t
-    scalars = jnp.asarray([row0, col0], jnp.int32)
+    u, strips = _strips_from_ext(ext, t)
+    scalars = jnp.asarray([r0, c0], jnp.int32)
     if variant == "vmem":
-        got = _shard_vmem_chunk(ext, scalars, t, 0.1, 0.1, nx, ny)
+        got = _shard_vmem_chunk(u, strips, scalars, t, 0.1, 0.1, nx, ny)
     else:
-        got = _shard_band_chunk(ext, scalars, t, 0.1, 0.1, nx, ny, bm=8)
-    want = _golden_shard_chunk(ext, t, row0, col0, nx, ny)
-    np.testing.assert_array_equal(np.asarray(got)[t:-t, t:-t],
-                                  want[t:-t, t:-t])
+        rb = 8 if variant == "band" else 12
+        got = _shard_band_chunk(u, strips, scalars, t, 0.1, 0.1, nx, ny,
+                                bm=rb)
+    want = _golden_shard_chunk(ext, t, r0 - t, c0 - t, nx, ny)
+    np.testing.assert_array_equal(np.asarray(got), want[t:-t, t:-t])
 
 
 def test_hybrid_band_route_bitwise(monkeypatch):
@@ -173,16 +217,30 @@ def test_hybrid_band_route_bitwise(monkeypatch):
     import heat2d_tpu.ops.pallas_stencil as ps
     monkeypatch.setattr(ps, "VMEM_BUDGET_BYTES", 1024)
     cfg = HeatConfig(nxprob=32, nyprob=256, steps=10, mode="hybrid",
-                     gridx=2, gridy=2)
+                     gridx=2, gridy=2, bitwise_parity=True)
     got = Heat2DSolver(cfg).run(timed=False)
     want = Heat2DSolver(cfg.replace(mode="serial", gridx=1, gridy=1)
                         ).run(timed=False)
     np.testing.assert_array_equal(got.u, want.u)
 
 
+def test_hybrid_band_route_fma_default(monkeypatch):
+    """The band route with the default FMA step form: ulp-class agreement
+    with serial (bitwise is opt-in via bitwise_parity)."""
+    import heat2d_tpu.ops.pallas_stencil as ps
+    monkeypatch.setattr(ps, "VMEM_BUDGET_BYTES", 1024)
+    cfg = HeatConfig(nxprob=32, nyprob=256, steps=10, mode="hybrid",
+                     gridx=2, gridy=2)
+    got = Heat2DSolver(cfg).run(timed=False)
+    want = Heat2DSolver(cfg.replace(mode="serial", gridx=1, gridy=1)
+                        ).run(timed=False)
+    np.testing.assert_allclose(got.u, want.u, rtol=1e-6, atol=1e-4)
+
+
 def test_hybrid_mode_matches_serial():
     """hybrid = 2D mesh x per-shard Pallas kernel (the MPI+OpenMP analogue
-    done right — SURVEY.md A.3)."""
+    done right — SURVEY.md A.3). Default step form is the FMA factoring:
+    ulp-class agreement."""
     cfg = HeatConfig(nxprob=32, nyprob=256, steps=10, mode="hybrid",
                      gridx=2, gridy=2)
     got = Heat2DSolver(cfg).run(timed=False)

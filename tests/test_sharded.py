@@ -87,9 +87,20 @@ def test_wide_halo_hybrid_kernel_bitwise():
     nx, ny, steps = 16, 32, 9
     serial = _serial_result(nx, ny, steps)
     cfg = HeatConfig(nxprob=nx, nyprob=ny, steps=steps, mode="hybrid",
-                     gridx=2, gridy=2, halo_depth=3)
+                     gridx=2, gridy=2, halo_depth=3, bitwise_parity=True)
     result = Heat2DSolver(cfg).run(timed=False)
     np.testing.assert_array_equal(result.u, serial.u)
+
+
+def test_wide_halo_hybrid_fma_default_close():
+    """Hybrid's default step form is the FMA factoring — ulp-class
+    agreement with serial; --bitwise-parity restores exactness (above)."""
+    nx, ny, steps = 16, 32, 9
+    serial = _serial_result(nx, ny, steps)
+    cfg = HeatConfig(nxprob=nx, nyprob=ny, steps=steps, mode="hybrid",
+                     gridx=2, gridy=2, halo_depth=3)
+    result = Heat2DSolver(cfg).run(timed=False)
+    np.testing.assert_allclose(result.u, serial.u, rtol=1e-6, atol=1e-4)
 
 
 @pytest.mark.parametrize("nw", [3, 6, 7])
