@@ -48,6 +48,99 @@ def test_suite_mesh_respects_divisibility():
     assert any(pt["mode"] == "dist2d" for pt in pts)
 
 
+class _FakeTimer:
+    """Scripted timed_run: elapsed = overhead + marginal*n, plus scripted
+    per-call noise spikes keyed by (n, call_index)."""
+
+    def __init__(self, marginal, overhead=0.2, spikes=None):
+        self.marginal = marginal
+        self.overhead = overhead
+        self.spikes = dict(spikes or {})
+        self.calls = {}
+
+    def __call__(self, n):
+        i = self.calls.get(n, 0)
+        self.calls[n] = i + 1
+        t = self.overhead + self.marginal * n + self.spikes.get((n, i), 0.0)
+        import types
+        return types.SimpleNamespace(elapsed=t)
+
+
+def test_two_point_rejects_lucky_jitter():
+    """The round-2 bogus-row scenario: a jitter spike at the first hi
+    clears the absolute floor and would have committed a ~600x-inflated
+    marginal; the confirmation rule must ride past it to the true one."""
+    # True marginal 1.2e-6 s/step; BOTH hi=100 runs spike (min() can't
+    # save us), faking dt=0.06 > the 0.05 floor -> bogus cand 7.5e-4.
+    fake = _FakeTimer(1.2e-6, spikes={(100, 0): 0.06, (100, 1): 0.062})
+    st, hi, _ = sweep.two_point_estimate(fake, lo=20, hi0=100,
+                                         max_hi=100_000)
+    assert st is not None
+    assert abs(st - 1.2e-6) / 1.2e-6 < 0.2     # the true marginal
+    assert hi == 100_000                        # rode past the spike
+
+
+def test_two_point_confirms_across_decades():
+    fake = _FakeTimer(1e-4)
+    st, hi, _ = sweep.two_point_estimate(fake, lo=20, hi0=100,
+                                         max_hi=100_000)
+    # First candidate at hi=1000 (dt=0.098); confirmed at hi=10000.
+    assert abs(st - 1e-4) / 1e-4 < 0.05
+    assert hi == 10_000
+
+
+def test_two_point_noise_fallback():
+    # Marginal so small no window ever clears the floor -> honest None.
+    fake = _FakeTimer(1e-9)
+    st, hi, _ = sweep.two_point_estimate(fake, lo=20, hi0=100,
+                                         max_hi=100_000)
+    assert st is None
+    assert hi == 100_000
+
+
+def test_suspect_rows_flags_committed_bogus_row():
+    """The exact round-2 committed rows: pallas 320x256 at 122x slower
+    than serial must be flagged (by BOTH rules); honest rows must not."""
+    recs = [
+        {"mode": "serial", "grid": "320x256", "step_time_s": 2.768e-6},
+        {"mode": "pallas", "grid": "320x256", "step_time_s": 3.38677e-4},
+        {"mode": "serial", "grid": "1280x1024", "step_time_s": 3.5101e-5},
+        {"mode": "pallas", "grid": "1280x1024", "step_time_s": 9.94e-6},
+        {"mode": "pallas", "grid": "80x64",
+         "method": "end-to-end (two-point within noise)"},  # no step_time
+    ]
+    assert sweep.suspect_rows(recs) == [1]
+
+
+def test_suspect_rows_monotonicity():
+    # A smaller grid slower per step than a larger one (same mode), but
+    # not >10x serial: caught by the monotonicity rule alone.
+    recs = [
+        {"mode": "pallas", "grid": "640x512", "step_time_s": 2e-5},
+        {"mode": "pallas", "grid": "1280x1024", "step_time_s": 9.9e-6},
+    ]
+    assert sweep.suspect_rows(recs) == [0]
+    # Monotone costs: clean.
+    recs[0]["step_time_s"] = 5e-6
+    assert sweep.suspect_rows(recs) == []
+
+
+def test_redesign_payoff_pairs():
+    recs = [
+        {"mode": "dist1d", "grid": "2560x2048", "mesh": "8x1",
+         "steps": 100, "step_time_s": 1.2e-2, "elapsed_s": 1.2},
+        {"mode": "dist2d", "grid": "2560x2048", "mesh": "2x4",
+         "steps": 100, "step_time_s": 0.4e-2, "elapsed_s": 0.4},
+        {"mode": "dist2d", "grid": "2560x2048", "mesh": "8x1",
+         "steps": 100, "step_time_s": 1.1e-2, "elapsed_s": 1.1},
+    ]
+    rows = sweep.redesign_payoff(recs)
+    assert len(rows) == 1
+    grid, ndev, m1, c1, m2, c2, ratio = rows[0]
+    assert (grid, ndev, m1, m2) == ("2560x2048", 8, "8x1", "2x4")
+    assert ratio == 3.0
+
+
 def test_scaling_suite_and_columns():
     pts = list(sweep.suite_scaling(10, quick=True, n_devices=8))
     assert [p["gridx"] * p["gridy"] for p in pts] == [1, 2, 4, 8]
