@@ -17,18 +17,20 @@ import sys
 QUICK = os.environ.get("BENCH_QUICK") == "1"
 
 NX = NY = 1024 if QUICK else 4096
-# 5000 steps so device compute (~0.6 s) dominates the ~0.1-0.2 s fence
-# jitter and the two-point estimator stays out of its noise fallback; the
-# metric is steady-state Mcells/s, directly comparable to the 1000-step
-# north-star config (and to the reference's CUDA figures, which amortize
-# over up to 100k iterations).
-STEPS = 100 if QUICK else 5000
+# 8000 steps so device compute (~0.7 s; two-point span ~0.55 s) dominates
+# the ~0.1-0.2 s fence jitter and the two-point estimator stays out of its
+# noise fallback; the metric is steady-state Mcells/s, directly comparable
+# to the 1000-step north-star config (and to the reference's CUDA figures,
+# which amortize over up to 100k iterations).
+STEPS = 100 if QUICK else 8000
 BASELINE_MCELLS = 669.0  # reference CUDA, 2560x2048 (BASELINE.md Table 10)
 
 
 def main() -> int:
     from heat2d_tpu.config import HeatConfig
     from heat2d_tpu.models.solver import Heat2DSolver
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from benchmarks.sweep import two_point_estimate
 
     mode = os.environ.get("BENCH_MODE", "pallas")
 
@@ -38,7 +40,9 @@ def main() -> int:
     # headline CUDA figure is *per-step* (cudaEvent pair amortized over
     # up to 100k launches, Report.pdf p.26 Table 10), so the like-for-like
     # number is the marginal throughput between two step counts — fixed
-    # overhead cancels.
+    # overhead cancels. The estimator (shared with benchmarks/sweep.py)
+    # min-of-3 samples the lo point, min-of-2 the hi point, and applies
+    # the decade-confirmation/noise-floor rules.
     solvers = {}
 
     def timed_run(steps):
@@ -51,12 +55,7 @@ def main() -> int:
         return solvers[steps].run(timed=True, warmup=fresh)
 
     lo = max(STEPS // 5, 1)
-    r_lo1 = timed_run(lo)
-    r_lo2 = timed_run(lo)   # repeat: |t1-t2| estimates the fence jitter
-    result = timed_run(STEPS)
-    r_hi2 = timed_run(STEPS)
-    if r_hi2.elapsed < result.elapsed:  # min-of-2: shave fence outliers
-        result = r_hi2
+    step_time, _hi, result = two_point_estimate(timed_run, lo, STEPS, STEPS)
 
     # sanity: physics must be non-vacuous (unlike the reference CUDA run —
     # SURVEY.md A.1): interior evolved, boundary clamped at zero.
@@ -64,10 +63,8 @@ def main() -> int:
     assert float(u[1:-1, 1:-1].max()) > 0.0, "interior wiped — vacuous run"
     assert float(abs(u[0]).max()) == 0.0, "boundary not clamped"
 
-    jitter = abs(r_lo1.elapsed - r_lo2.elapsed)
-    dt = result.elapsed - min(r_lo1.elapsed, r_lo2.elapsed)
-    if dt > max(5 * jitter, 1e-4):
-        value = NX * NY * (STEPS - lo) / dt / 1e6
+    if step_time is not None:
+        value = NX * NY / step_time / 1e6
         method = "two-point"   # fixed fence overhead cancelled
     else:
         # Difference is within noise — report the distorted-but-honest

@@ -103,9 +103,11 @@ def two_point_estimate(timed_run, lo, hi0, max_hi,
     reference's own 100k-iteration amortization span (Report.pdf p.26)
     noise cannot fake a 100 ms window.
     """
-    r1, r2 = timed_run(lo), timed_run(lo)
-    t_lo = min(r1.elapsed, r2.elapsed)
-    jitter = abs(r1.elapsed - r2.elapsed)
+    lo_ts = sorted(timed_run(lo).elapsed for _ in range(3))
+    t_lo = lo_ts[0]
+    # Spread of the two best of three: one outlier sample can no longer
+    # fake a tiny jitter estimate (or poison t_lo).
+    jitter = lo_ts[1] - lo_ts[0]
     prev = None
     hi = hi0
     while True:
@@ -227,11 +229,19 @@ def suspect_rows(records):
     - an accelerated mode (pallas/hybrid/dist*) reporting >10x SLOWER
       than the same grid's serial marginal (the round-2 bogus row was
       122x slower), or
-    - within one mode, a SMALLER grid reporting a larger per-step time
-      than a bigger grid by >10% (step time is monotone in cell count —
-      a violation means the smaller grid's row is inflated).
+    - within one mode AND mesh shape, a SMALLER grid reporting a larger
+      per-step time than a bigger grid by more than the estimator's own
+      AGREE_FACTOR. Small grids are latency-bound (per-step dispatch
+      dominates, the protocol's own premise), so step times are roughly
+      flat there and a tight threshold would flag healthy rows; only a
+      violation beyond what the confirmation rule itself tolerates marks
+      a row as inflated. Rows from different mesh shapes are never
+      compared — their dispatch/collective floors differ.
     """
-    serial_st = {r["grid"]: r["step_time_s"] for r in records
+    def mesh(r):
+        return r.get("mesh", "1x1")
+
+    serial_st = {(r["grid"], mesh(r)): r["step_time_s"] for r in records
                  if r["mode"] == "serial" and "step_time_s" in r}
 
     def cells(r):
@@ -243,13 +253,14 @@ def suspect_rows(records):
         st = r.get("step_time_s")
         if st is None:
             continue
-        base = serial_st.get(r["grid"])
+        base = serial_st.get((r["grid"], mesh(r)))
         if r["mode"] != "serial" and base and st > 10 * base:
             out.add(i)
-        for j, q in enumerate(records):
+        for q in records:
             qt = q.get("step_time_s")
             if (qt is not None and q["mode"] == r["mode"]
-                    and cells(q) > cells(r) and st > 1.1 * qt):
+                    and mesh(q) == mesh(r)
+                    and cells(q) > cells(r) and st > AGREE_FACTOR * qt):
                 out.add(i)
     return sorted(out)
 
@@ -268,6 +279,11 @@ def sanity_pass(records, points, max_hi):
         rec.update(suite=old.get("suite"), platform=old.get("platform"),
                    rechecked=True)
         records[i] = rec
+        # Supersede the already-streamed row on stdout too — consumers
+        # piping the JSON stream would otherwise keep the bogus row the
+        # recheck exists to eliminate (rechecked=True marks the
+        # replacement; last row per (mode, grid, mesh) wins).
+        print(json.dumps(rec))
     return records
 
 

@@ -100,6 +100,10 @@ def build_parser() -> argparse.ArgumentParser:
                         "stencil expression instead of the faster FMA "
                         "factoring, making results bitwise identical to "
                         "--mode serial (serial/dist1d/dist2d already are)")
+    p.add_argument("--vmem-budget", type=int, default=None, metavar="MiB",
+                   help="per-core VMEM size in MiB to plan kernels against, "
+                        "overriding the value derived from the detected "
+                        "device kind (v5e: 16)")
     p.add_argument("--debug", action="store_true")
     p.add_argument("--device-info", action="store_true",
                    help="print device summary (detailsGPU analogue) and exit")
@@ -303,6 +307,14 @@ def main(argv=None) -> int:
         from heat2d_tpu.utils.device import print_device_summary
         print_device_summary()
         return 0
+
+    if args.vmem_budget is not None:
+        from heat2d_tpu.ops.pallas_stencil import set_vmem_budget
+        try:
+            set_vmem_budget(args.vmem_budget * 1024 * 1024)
+        except ConfigError as e:
+            print(f"{e}\nQuitting...", file=sys.stderr)
+            return 1
 
     try:
         cfg = HeatConfig(

@@ -123,6 +123,23 @@ def test_suspect_rows_monotonicity():
     # Monotone costs: clean.
     recs[0]["step_time_s"] = 5e-6
     assert sweep.suspect_rows(recs) == []
+    # Latency-bound wobble within the estimator's own tolerance
+    # (AGREE_FACTOR) must NOT trigger a re-measure: small grids are
+    # dispatch-dominated and roughly flat in step time.
+    recs = [
+        {"mode": "serial", "grid": "80x64", "step_time_s": 2.0e-6},
+        {"mode": "serial", "grid": "160x128", "step_time_s": 1.8e-6},
+    ]
+    assert sweep.suspect_rows(recs) == []
+    # Different mesh shapes are never compared — their dispatch and
+    # collective floors differ.
+    recs = [
+        {"mode": "dist2d", "grid": "640x512", "mesh": "8x1",
+         "step_time_s": 2e-5},
+        {"mode": "dist2d", "grid": "1280x1024", "mesh": "2x4",
+         "step_time_s": 9.9e-6},
+    ]
+    assert sweep.suspect_rows(recs) == []
 
 
 def test_redesign_payoff_pairs():
