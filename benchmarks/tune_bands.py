@@ -33,13 +33,18 @@ def measure(u, bm, t, lo=400, hi=2800, reps=3):
     """Two-point marginal step time, min-of-reps at each point: the
     tunnel fence jitters tens of ms, so single measurements at this
     scale (~0.3 s of compute) can swing 2x; the minimum is the
-    low-noise estimator for a fixed-work run."""
+    low-noise estimator for a fixed-work run. One warmup per step
+    count covers compile + program load; the reps run warmup-free."""
     fn = jax.jit(
         lambda v, n: ps.band_chunk(v, n, 0.1, 0.1, tsteps=t, bm=bm),
         static_argnums=1)
-    dt_lo = min(timed_call(fn, u, lo)[1] for _ in range(reps))
-    dt_hi = min(timed_call(fn, u, hi)[1] for _ in range(reps))
-    return (dt_hi - dt_lo) / (hi - lo)
+    def min_of(n):
+        ts = [timed_call(fn, u, n)[1]]          # warms up once
+        ts += [timed_call(fn, u, n, warmup=False)[1]
+               for _ in range(reps - 1)]
+        return min(ts)
+
+    return (min_of(hi) - min_of(lo)) / (hi - lo)
 
 
 def main(argv):

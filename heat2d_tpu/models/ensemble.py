@@ -13,10 +13,17 @@ of the framework (CLI: ``--ensemble-cx/--ensemble-cy``):
   as an SMEM scalar block (the diffusivities are traced per-member
   values, so they are kernel *operands* here, not the baked constants the
   single-instance kernels use).
+- ``band`` method: HBM-sized members stream through the temporally-
+  blocked band kernel (pallas_stencil kernel C) over a (member, band)
+  program grid — 'auto' routes here when a member exceeds the VMEM
+  budget, so big members get the same kernel class as mode='pallas'
+  instead of a vmap fallback.
 - ``run_ensemble_sharded``: the batch as a mesh axis — members shard
   across devices (`shard_map` over a 1D 'b' mesh, batch padded to a
   device multiple with inert members), each device advancing its members
   through the same single-chip paths. This is DP over replicas on ICI.
+  There is NO spatial decomposition in ensemble runs: each member must
+  fit one device's HBM, and gridx/gridy play no role.
 
 This is how the reference's Table-4-style parameter studies collapse into
 a single launch.
@@ -29,6 +36,7 @@ import functools
 import jax
 import jax.numpy as jnp
 import numpy as np
+from jax.experimental import pallas as pl
 
 from heat2d_tpu.models import engine
 from heat2d_tpu.ops.init import inidat
@@ -59,8 +67,8 @@ def _run_batch_jnp(u0, cxs, cys, *, steps):
 
 def _ensemble_kernel(s_ref, u_ref, out_ref, *, steps):
     from heat2d_tpu.ops.pallas_stencil import _step_value
-    cx = s_ref[0, 0]
-    cy = s_ref[0, 1]
+    cx = s_ref[0, 0, 0]
+    cy = s_ref[0, 0, 1]
     u = u_ref[0]
     u = jax.lax.fori_loop(0, steps,
                           lambda _, v: _step_value(v, cx, cy), u,
@@ -77,7 +85,10 @@ def _run_batch_pallas(u0, cxs, cys, *, steps):
     from heat2d_tpu.ops.pallas_stencil import _interpret, pltpu
 
     b, nx, ny = u0.shape
-    scal = jnp.stack([cxs, cys], axis=1)          # (B, 2)
+    # (B, 1, 2): a (1, 1, 2) block's last two dims equal the array's —
+    # a (1, 2) block over (B, 2) violates the Mosaic block rule for
+    # B > 1 (caught on real TPU only; interpret mode accepts it).
+    scal = jnp.stack([cxs, cys], axis=1)[:, None, :]
     mspace, smem = {}, {}
     if pltpu is not None and not _interpret():
         mspace = dict(memory_space=pltpu.VMEM)
@@ -85,7 +96,7 @@ def _run_batch_pallas(u0, cxs, cys, *, steps):
     grid_spec = pl.GridSpec(
         grid=(b,),
         in_specs=[
-            pl.BlockSpec((1, 2), lambda i: (i, 0), **smem),
+            pl.BlockSpec((1, 1, 2), lambda i: (i, 0, 0), **smem),
             pl.BlockSpec((1, nx, ny), lambda i: (i, 0, 0), **mspace),
         ],
         out_specs=pl.BlockSpec((1, nx, ny), lambda i: (i, 0, 0), **mspace),
@@ -97,11 +108,99 @@ def _run_batch_pallas(u0, cxs, cys, *, steps):
         interpret=_interpret())(scal, u0)
 
 
+def _ensemble_band_kernel(s_ref, up_ref, u_ref, dn_ref, out_ref, *,
+                          bm, tsteps, nx, ny):
+    """Temporally-blocked band sweep with per-member (cx, cy) scalars —
+    pallas_stencil._band_multi_kernel with the diffusivities as SMEM
+    operands (traced per-member values) instead of baked constants, over
+    a (member, band) program grid."""
+    from heat2d_tpu.ops.pallas_stencil import _step_value, _unrolled_steps
+
+    j = pl.program_id(1)
+    cx = s_ref[0, 0, 0]
+    cy = s_ref[0, 0, 1]
+    ext = jnp.concatenate([up_ref[0, 0], u_ref[0], dn_ref[0, 0]], axis=0)
+    gi = (j * bm - tsteps
+          + jax.lax.broadcasted_iota(jnp.int32, (bm + 2 * tsteps, 1), 0))
+    keep = (gi <= 0) | (gi >= nx - 1)
+    out_ref[0] = _unrolled_steps(
+        tsteps, lambda v: jnp.where(keep, v, _step_value(v, cx, cy)),
+        ext)[tsteps:-tsteps]
+
+
+def _batched_band_sweep(scal, u, bm, tsteps, nx, ny):
+    """One T-step sweep of every member's bands: grid (B, nblk), member
+    blocks aliased in place (each program reads only its own block; the
+    neighbor-row strips ride as separate operands)."""
+    from heat2d_tpu.ops.pallas_stencil import _interpret, pltpu
+
+    b, m, n = u.shape
+    nblk = m // bm
+    t = tsteps
+    zeros = jnp.zeros((b, 1, t, n), u.dtype)
+    blocks = u.reshape(b, nblk, bm, n)
+    ups = jnp.concatenate([zeros, blocks[:, :-1, bm - t:, :]], axis=1)
+    dns = jnp.concatenate([blocks[:, 1:, :t, :], zeros], axis=1)
+    mspace, smem = {}, {}
+    if pltpu is not None and not _interpret():
+        mspace = dict(memory_space=pltpu.VMEM)
+        smem = dict(memory_space=pltpu.SMEM)
+    grid_spec = pl.GridSpec(
+        grid=(b, nblk),
+        in_specs=[
+            pl.BlockSpec((1, 1, 2), lambda i, j: (i, 0, 0), **smem),
+            pl.BlockSpec((1, 1, t, n), lambda i, j: (i, j, 0, 0), **mspace),
+            pl.BlockSpec((1, bm, n), lambda i, j: (i, j, 0), **mspace),
+            pl.BlockSpec((1, 1, t, n), lambda i, j: (i, j, 0, 0), **mspace),
+        ],
+        out_specs=pl.BlockSpec((1, bm, n), lambda i, j: (i, j, 0), **mspace),
+    )
+    return pl.pallas_call(
+        functools.partial(_ensemble_band_kernel, bm=bm, tsteps=tsteps,
+                          nx=nx, ny=ny),
+        out_shape=jax.ShapeDtypeStruct(u.shape, u.dtype),
+        grid_spec=grid_spec,
+        interpret=_interpret(),
+        input_output_aliases={2: 0})(scal, ups, u, dns)
+
+
+def _run_batch_band(u0, cxs, cys, *, steps):
+    """HBM-sized members: every member streamed through the temporally-
+    blocked band kernel in one launch (the band_chunk design with the
+    batch as a leading grid axis). Closes the VERDICT r2 weak-#3 gap
+    where members too big for VMEM fell back to the vmap'd jnp path."""
+    from heat2d_tpu.ops import pallas_stencil as ps
+
+    b, nx, ny = u0.shape
+    bm, m_pad = ps.plan_bands(nx, ny, u0.dtype)
+    t = ps.DEFAULT_TSTEPS
+    if bm <= 2 * t:
+        t = max(1, (bm - 1) // 2)   # shallow bands: reduce sweep depth
+    ps._check_band_vmem(bm, t, ny, u0.dtype)
+    u = u0
+    if m_pad > nx:
+        u = jnp.pad(u, ((0, 0), (0, m_pad - nx), (0, 0)))
+    scal = jnp.stack([cxs, cys], axis=1)[:, None, :]   # (B, 1, 2)
+    nsweeps, rem = divmod(steps, t)
+    if nsweeps:
+        u = jax.lax.fori_loop(
+            0, nsweeps,
+            lambda _, v: _batched_band_sweep(scal, v, bm, t, nx, ny), u,
+            unroll=False)
+    if rem:
+        u = _batched_band_sweep(scal, u, bm, rem, nx, ny)
+    return u[:, :nx] if m_pad > nx else u
+
+
+_BATCH_RUNNERS = {"jnp": _run_batch_jnp, "pallas": _run_batch_pallas,
+                  "band": _run_batch_band}
+
+
 def _pick_method(method, nx, ny):
     if method != "auto":
         return method
     from heat2d_tpu.ops.pallas_stencil import fits_vmem
-    return "pallas" if fits_vmem((nx, ny)) else "jnp"
+    return "pallas" if fits_vmem((nx, ny)) else "band"
 
 
 def run_ensemble(nx: int, ny: int, steps: int, cxs, cys, u0=None,
@@ -113,7 +212,9 @@ def run_ensemble(nx: int, ny: int, steps: int, cxs, cys, u0=None,
     initial condition (mpi_heat2Dn.c:242-248). Returns (B, nx, ny).
 
     ``method``: 'jnp' (vmap), 'pallas' (batched kernel, members must be
-    VMEM-resident), or 'auto' (pallas when a member fits VMEM).
+    VMEM-resident), 'band' (batched temporally-blocked band kernel for
+    HBM-sized members), or 'auto' (pallas when a member fits VMEM, band
+    otherwise).
     """
     cxs, cys, u0 = _validated_batch(nx, ny, cxs, cys, u0)
     method = _pick_method(method, nx, ny)
@@ -122,8 +223,7 @@ def run_ensemble(nx: int, ny: int, steps: int, cxs, cys, u0=None,
 
 
 def _build_single(steps, method, u0, cxs, cys):
-    run = _run_batch_pallas if method == "pallas" else _run_batch_jnp
-    fn = jax.jit(functools.partial(run, steps=steps))
+    fn = jax.jit(functools.partial(_BATCH_RUNNERS[method], steps=steps))
     return fn, (u0, cxs, cys), cxs.shape[0]
 
 
@@ -146,7 +246,7 @@ def _build_sharded(steps, method, u0, cxs, cys, devices):
             [u0, jnp.zeros((pad, nx, ny), u0.dtype)], axis=0)
 
     mesh = Mesh(np.asarray(devices), ("b",))
-    run = _run_batch_pallas if method == "pallas" else _run_batch_jnp
+    run = _BATCH_RUNNERS[method]
 
     def local(u, cx, cy):
         return run(u, cx, cy, steps=steps)

@@ -170,7 +170,12 @@ def _unrolled_steps(steps: int, one, v):
     """``one`` applied ``steps`` (static) times, bodies inlined in groups
     of _STEP_UNROLL. Mosaic's fori lowering accepts only full unroll or
     none, so the partial unroll is done by hand: a rolled outer loop
-    whose body is _STEP_UNROLL inlined steps, plus an inlined remainder.
+    whose body is _STEP_UNROLL inlined steps. The remainder runs as a
+    ROLLED loop, not inlined: bodies inlined outside a loop keep every
+    step's temporaries live at once (a 2-step remainder of the 8192-wide
+    shard kernel allocated 17.7 MB of VMEM stack and failed to compile
+    where the 8-step looped body fit), and remainder sweeps are a
+    once-per-chunk tail where the unroll win is irrelevant anyway.
     """
     full, rem = divmod(steps, _STEP_UNROLL)
     if full:
@@ -179,8 +184,8 @@ def _unrolled_steps(steps: int, one, v):
                 w = one(w)
             return w
         v = lax.fori_loop(0, full, body, v, unroll=False)
-    for _ in range(rem):
-        v = one(v)
+    if rem:
+        v = lax.fori_loop(0, rem, lambda _, w: one(w), v, unroll=False)
     return v
 
 
@@ -245,20 +250,22 @@ def plan_bands(nrows: int, ny: int, dtype=jnp.float32,
     sublane rule: block dims must divide (8, 128) or equal the array's)
     unless the whole array is one band.
 
-    The byte target shrinks for wide grids: the kernel's VMEM working set
-    is several band-sized buffers plus per-step temporaries, all
-    proportional to the row size. Empirical envelope on v5e: 2 MB bands
-    compile at ny=4096 but not at ny=8192, where 1 MB bands do — hence
-    the halved target once rows exceed 16 KB. Both targets scale with
-    the detected per-core VMEM (budget/4 and budget/8; the v5e's 8 MB
-    budget reproduces the measured envelope exactly), so bigger-VMEM
-    parts get proportionally deeper bands.
+    The byte target shrinks for very wide grids: the kernel's VMEM
+    working set is several band-sized buffers plus per-step temporaries,
+    all proportional to the row size. Empirical v5e envelope (round 3):
+    2 MB bands compile and run through ny=8192 (bm=64, T=8 estimates
+    12.8 MB — measured 191 Gcells/s vs 143 with 1 MB bands); beyond
+    32 KB rows the estimate would cross the hard limit, so the target
+    halves there. Both targets scale with the detected per-core VMEM
+    (budget/4 and budget/8; the v5e's 8 MB budget reproduces the
+    measured envelope exactly), so bigger-VMEM parts get proportionally
+    deeper bands.
     """
     row_bytes = ny * jnp.dtype(dtype).itemsize
     if target_bytes is None:
         budget = vmem_budget_bytes()
         target_bytes = max(row_bytes,
-                           budget // (8 if row_bytes > 16 * 1024 else 4))
+                           budget // (8 if row_bytes > 32 * 1024 else 4))
     cap = max(1, target_bytes // row_bytes)
     if cap >= nrows:
         return nrows, nrows          # whole array is a single band
@@ -562,11 +569,12 @@ def _shard_fused_band_kernel(s_ref, w_ref, e_ref, up_ref, u_ref, dn_ref,
     i = pl.program_id(0)
     t = tsteps
     vert = jnp.concatenate([up_ref[0], u_ref[:], dn_ref[0]], axis=0)
-    # The column strips span every band's rows; band i needs the
-    # (rb + 2t)-row window starting at its own first extended row.
-    w = w_ref[pl.ds(i * rb, rb + 2 * t), :]
-    e = e_ref[pl.ds(i * rb, rb + 2 * t), :]
-    ext = jnp.concatenate([w, vert, e], axis=1)
+    # Column strips arrive pre-windowed per band (1, rb+2t, t) — riding
+    # them whole would keep a full-height (m+2t, t) array VMEM-resident
+    # in every program, and Mosaic lane-pads the t-wide minor dim to 128,
+    # a 16x bloat that OOM'd VMEM at 8192-row shards (compiler: 18.8 MB
+    # scoped for a 13 MB estimate).
+    ext = jnp.concatenate([w_ref[0], vert, e_ref[0]], axis=1)
     keep = _shard_keep_mask(s_ref[0], s_ref[1], ext.shape, nx, ny,
                             row_shift=i * rb - t, col_shift=-t)
 
@@ -598,6 +606,19 @@ def _shard_vmem_chunk(u, strips, scalars, tsteps, cx, cy, nx, ny,
         **kwargs)(scalars, west, east, north, u, south)
 
 
+def _strip_windows(strip, nblk, rb, t):
+    """(nblk, rb+2t, t) per-band windows of a (nblk*rb + 2t, t) column
+    strip: band i's window covers its extended rows [i*rb - t,
+    i*rb + rb + t) in strip coordinates [i*rb, i*rb + rb + 2t) — built
+    from non-overlapping blocks plus shifted tails/heads, the same
+    assembly as the ups/dns row strips (no overlapping reads)."""
+    core = strip[t:-t].reshape(nblk, rb, strip.shape[1])
+    tails = jnp.concatenate([strip[:t][None], core[:-1, rb - t:, :]],
+                            axis=0)
+    heads = jnp.concatenate([core[1:, :t, :], strip[-t:][None]], axis=0)
+    return jnp.concatenate([tails, core, heads], axis=1)
+
+
 def _shard_band_chunk(u, strips, scalars, tsteps, cx, cy, nx, ny,
                       step=_step_value_literal, bm=None):
     """Stream the block in temporally-blocked row bands, halo strips as
@@ -607,16 +628,28 @@ def _shard_band_chunk(u, strips, scalars, tsteps, cx, cy, nx, ny,
     2t-deep row strips — exact neighbor data at sweep start, from the
     adjacent bands or the N/S halo) degrade one row per in-VMEM step, so
     after t steps the band's rb-row center is exact. The column strips
-    ride whole (they are only t cells wide) and each band slices its own
-    window in-kernel. Uneven row counts embed the south strip directly
-    below the domain rows before padding, so every band's down-strip
-    reads the right rows; pad garbage lives strictly below the kept
-    output.
+    are pre-gathered into per-band (rb+2t, t) windows (_strip_windows)
+    so each program's VMEM holds only its own window. Uneven row counts
+    embed the south strip directly below the domain rows before padding,
+    so every band's down-strip reads the right rows; pad garbage lives
+    strictly below the kept output.
     """
     t = tsteps
     m, n = u.shape
     north, south, west, east = strips
-    rb, m_pad = _resolve_bands(m, n, u.dtype, bm)
+    if bm is None:
+        # Kernel D's envelope is tighter than kernel C's: the pipelined
+        # u/out blocks and strip operands double-buffer on top of the
+        # extended-block working set. Probed on the v5e (windowed
+        # strips, T=8): ext blocks ~1.25 MB compile everywhere
+        # (rb=128@2048-wide, 64@4096, 32-40@8192); ~1.75 MB is
+        # borderline; 2 MB-class plans OOM the compiler's scoped VMEM.
+        # budget//8 (1 MB at v5e) keeps every width in the probed-safe
+        # region.
+        rb, m_pad = plan_bands(m, n, u.dtype,
+                               target_bytes=vmem_budget_bytes() // 8)
+    else:
+        rb, m_pad = _resolve_bands(m, n, u.dtype, bm)
     if rb < t:
         # A band must source its t-deep row strip from ONE adjacent band,
         # so rb < t cannot stream directly (tiny VMEM budget vs deep
@@ -633,9 +666,9 @@ def _shard_band_chunk(u, strips, scalars, tsteps, cx, cy, nx, ny,
                 ext, (z_row, z_row, z_col, z_col), scalars - t, 1,
                 cx, cy, nx, ny, step=step, bm=bm)
         return ext[t:-t, t:-t]
-    # The full-height column strips are VMEM-resident in every program:
-    # count them toward the working set.
-    strip_bytes = 2 * (m_pad + 2 * t) * t * jnp.dtype(u.dtype).itemsize
+    # Per-program strip windows, lane-padded to 128 by Mosaic.
+    strip_bytes = (2 * (rb + 2 * t) * max(t, 128)
+                   * jnp.dtype(u.dtype).itemsize)
     _check_band_vmem(rb, t, n + 2 * t, u.dtype, extra_bytes=strip_bytes)
     if m_pad == m:
         nblk = m // rb
@@ -659,6 +692,8 @@ def _shard_band_chunk(u, strips, scalars, tsteps, cx, cy, nx, ny,
         # there are discarded; the window arithmetic must not clamp).
         west = jnp.pad(west, ((0, m_pad - m), (0, 0)))
         east = jnp.pad(east, ((0, m_pad - m), (0, 0)))
+    wwin = _strip_windows(west, nblk, rb, t)
+    ewin = _strip_windows(east, nblk, rb, t)
 
     mspace, smem = {}, {}
     if pltpu is not None and not _interpret():
@@ -668,8 +703,8 @@ def _shard_band_chunk(u, strips, scalars, tsteps, cx, cy, nx, ny,
         grid=(nblk,),
         in_specs=[
             pl.BlockSpec((2,), lambda i: (0,), **smem),
-            pl.BlockSpec(west.shape, lambda i: (0, 0), **mspace),
-            pl.BlockSpec(east.shape, lambda i: (0, 0), **mspace),
+            pl.BlockSpec((1, rb + 2 * t, t), lambda i: (i, 0, 0), **mspace),
+            pl.BlockSpec((1, rb + 2 * t, t), lambda i: (i, 0, 0), **mspace),
             pl.BlockSpec((1, t, n), lambda i: (i, 0, 0), **mspace),
             pl.BlockSpec((rb, n), lambda i: (i, 0), **mspace),
             pl.BlockSpec((1, t, n), lambda i: (i, 0, 0), **mspace),
@@ -682,7 +717,7 @@ def _shard_band_chunk(u, strips, scalars, tsteps, cx, cy, nx, ny,
         out_shape=jax.ShapeDtypeStruct((m_pad, n), u.dtype),
         grid_spec=grid_spec,
         interpret=_interpret(),
-        input_output_aliases={4: 0})(scalars, west, east, ups, u_in, dns)
+        input_output_aliases={4: 0})(scalars, wwin, ewin, ups, u_in, dns)
     return out[:m] if m_pad > m else out
 
 

