@@ -82,17 +82,14 @@ def _run_batch_pallas(u0, cxs, cys, *, steps):
     multi_step_vmem design batched; members must individually pass
     fits_vmem — callers route)."""
     from jax.experimental import pallas as pl
-    from heat2d_tpu.ops.pallas_stencil import _interpret, pltpu
+    from heat2d_tpu.ops.pallas_stencil import _interpret, _mem_spaces
 
     b, nx, ny = u0.shape
     # (B, 1, 2): a (1, 1, 2) block's last two dims equal the array's —
     # a (1, 2) block over (B, 2) violates the Mosaic block rule for
     # B > 1 (caught on real TPU only; interpret mode accepts it).
     scal = jnp.stack([cxs, cys], axis=1)[:, None, :]
-    mspace, smem = {}, {}
-    if pltpu is not None and not _interpret():
-        mspace = dict(memory_space=pltpu.VMEM)
-        smem = dict(memory_space=pltpu.SMEM)
+    mspace, smem = _mem_spaces()
     grid_spec = pl.GridSpec(
         grid=(b,),
         in_specs=[
@@ -132,19 +129,15 @@ def _batched_band_sweep(scal, u, bm, tsteps, nx, ny):
     """One T-step sweep of every member's bands: grid (B, nblk), member
     blocks aliased in place (each program reads only its own block; the
     neighbor-row strips ride as separate operands)."""
-    from heat2d_tpu.ops.pallas_stencil import _interpret, pltpu
+    from heat2d_tpu.ops.pallas_stencil import (_interpret, _mem_spaces,
+                                               _row_strips)
 
     b, m, n = u.shape
     nblk = m // bm
     t = tsteps
     zeros = jnp.zeros((b, 1, t, n), u.dtype)
-    blocks = u.reshape(b, nblk, bm, n)
-    ups = jnp.concatenate([zeros, blocks[:, :-1, bm - t:, :]], axis=1)
-    dns = jnp.concatenate([blocks[:, 1:, :t, :], zeros], axis=1)
-    mspace, smem = {}, {}
-    if pltpu is not None and not _interpret():
-        mspace = dict(memory_space=pltpu.VMEM)
-        smem = dict(memory_space=pltpu.SMEM)
+    ups, dns = _row_strips(u.reshape(b, nblk, bm, n), t, zeros, zeros)
+    mspace, smem = _mem_spaces()
     grid_spec = pl.GridSpec(
         grid=(b, nblk),
         in_specs=[

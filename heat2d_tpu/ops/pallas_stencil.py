@@ -308,6 +308,35 @@ def _check_band_vmem(bm: int, tsteps: int, ny: int, dtype,
             f"--gridy N) or reduce --halo-depth")
 
 
+def _mem_spaces():
+    """(vmem kwargs, smem kwargs) for BlockSpecs — empty in interpreter
+    mode, where pltpu memory spaces don't apply."""
+    if pltpu is not None and not _interpret():
+        return (dict(memory_space=pltpu.VMEM),
+                dict(memory_space=pltpu.SMEM))
+    return {}, {}
+
+
+def _row_strips(blocks, t, first, last):
+    """(ups, dns) neighbor-row strip arrays for a band program grid:
+    band i's up-strip is the previous block's t-row tail (``first`` for
+    band 0) and its down-strip the next block's t-row head (``last``
+    for the final band). ``blocks`` is (nblk, bm, n) or batched
+    (b, nblk, bm, n); first/last carry the matching leading axes. The
+    one place the band-neighbor gather lives — kernels B/C, the shard
+    kernel D, and the batched ensemble sweep all assemble through it.
+    """
+    ax = blocks.ndim - 3
+    bm = blocks.shape[-2]
+    head = (slice(None),) * ax
+    ups = jnp.concatenate(
+        [first, blocks[head + (slice(None, -1),)][..., bm - t:, :]],
+        axis=ax)
+    dns = jnp.concatenate(
+        [blocks[head + (slice(1, None),)][..., :t, :], last], axis=ax)
+    return ups, dns
+
+
 def _banded_pallas(kernel_body, u, bm, t):
     """Launch ``kernel_body`` over the row bands of ``u`` with t-deep
     neighbor-row strips (zeros past the array edges) — the shared
@@ -329,13 +358,9 @@ def _banded_pallas(kernel_body, u, bm, t):
     m, n = u.shape
     nblk = m // bm
     zeros = jnp.zeros((1, t, n), u.dtype)
-    blocks = u.reshape(nblk, bm, n)
-    ups = jnp.concatenate([zeros, blocks[:-1, bm - t:, :]], axis=0)
-    dns = jnp.concatenate([blocks[1:, :t, :], zeros], axis=0)
+    ups, dns = _row_strips(u.reshape(nblk, bm, n), t, zeros, zeros)
 
-    mspace = {}
-    if pltpu is not None and not _interpret():
-        mspace = dict(memory_space=pltpu.VMEM)
+    mspace, _ = _mem_spaces()
     grid_spec = pl.GridSpec(
         grid=(nblk,),
         in_specs=[
@@ -609,13 +634,11 @@ def _shard_vmem_chunk(u, strips, scalars, tsteps, cx, cy, nx, ny,
 def _strip_windows(strip, nblk, rb, t):
     """(nblk, rb+2t, t) per-band windows of a (nblk*rb + 2t, t) column
     strip: band i's window covers its extended rows [i*rb - t,
-    i*rb + rb + t) in strip coordinates [i*rb, i*rb + rb + 2t) — built
-    from non-overlapping blocks plus shifted tails/heads, the same
-    assembly as the ups/dns row strips (no overlapping reads)."""
+    i*rb + rb + t) in strip coordinates [i*rb, i*rb + rb + 2t) — the
+    _row_strips band-neighbor gather applied to the strip's own blocks,
+    with the strip's corner rows as the outer tail/head."""
     core = strip[t:-t].reshape(nblk, rb, strip.shape[1])
-    tails = jnp.concatenate([strip[:t][None], core[:-1, rb - t:, :]],
-                            axis=0)
-    heads = jnp.concatenate([core[1:, :t, :], strip[-t:][None]], axis=0)
+    tails, heads = _row_strips(core, t, strip[:t][None], strip[-t:][None])
     return jnp.concatenate([tails, core, heads], axis=1)
 
 
@@ -672,21 +695,16 @@ def _shard_band_chunk(u, strips, scalars, tsteps, cx, cy, nx, ny,
     _check_band_vmem(rb, t, n + 2 * t, u.dtype, extra_bytes=strip_bytes)
     if m_pad == m:
         nblk = m // rb
-        blocks = u.reshape(nblk, rb, n)
-        ups = jnp.concatenate([north[None], blocks[:-1, rb - t:, :]],
-                              axis=0)
-        dns = jnp.concatenate([blocks[1:, :t, :], south[None]], axis=0)
         u_in = u
+        ups, dns = _row_strips(u.reshape(nblk, rb, n), t,
+                               north[None], south[None])
     else:
         m_pad = -(-(m + t) // rb) * rb
         nblk = m_pad // rb
         u_in = jnp.pad(jnp.concatenate([u, south], axis=0),
                        ((0, m_pad - m - t), (0, 0)))
-        blocks = u_in.reshape(nblk, rb, n)
-        ups = jnp.concatenate([north[None], blocks[:-1, rb - t:, :]],
-                              axis=0)
-        dns = jnp.concatenate([blocks[1:, :t, :],
-                               jnp.zeros((1, t, n), u.dtype)], axis=0)
+        ups, dns = _row_strips(u_in.reshape(nblk, rb, n), t, north[None],
+                               jnp.zeros((1, t, n), u.dtype))
     if m_pad > m:
         # Column strips must cover the pad rows' windows too (values
         # there are discarded; the window arithmetic must not clamp).
@@ -695,10 +713,7 @@ def _shard_band_chunk(u, strips, scalars, tsteps, cx, cy, nx, ny,
     wwin = _strip_windows(west, nblk, rb, t)
     ewin = _strip_windows(east, nblk, rb, t)
 
-    mspace, smem = {}, {}
-    if pltpu is not None and not _interpret():
-        mspace = dict(memory_space=pltpu.VMEM)
-        smem = dict(memory_space=pltpu.SMEM)
+    mspace, smem = _mem_spaces()
     grid_spec = pl.GridSpec(
         grid=(nblk,),
         in_specs=[
