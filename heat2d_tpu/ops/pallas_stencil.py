@@ -202,11 +202,9 @@ def fits_vmem(shape, dtype=jnp.float32) -> bool:
 def multi_step_vmem(u, steps: int, cx: float, cy: float,
                     step=_step_value):
     """Run ``steps`` time steps in one kernel, grid resident in VMEM."""
-    kwargs = {}
-    if pltpu is not None and not _interpret():
-        kwargs = dict(
-            in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)],
-            out_specs=pl.BlockSpec(memory_space=pltpu.VMEM))
+    mspace, _ = _mem_spaces()
+    kwargs = dict(in_specs=[pl.BlockSpec(**mspace)],
+                  out_specs=pl.BlockSpec(**mspace))
     return pl.pallas_call(
         functools.partial(_vmem_kernel, steps=steps, cx=cx, cy=cy,
                           step=step),
@@ -616,12 +614,10 @@ def _shard_vmem_chunk(u, strips, scalars, tsteps, cx, cy, nx, ny,
     block in VMEM from the block and its four halo strips, advances it
     ``tsteps`` steps, and writes back only the (bm, bn) center."""
     north, south, west, east = strips
-    kwargs = {}
-    if pltpu is not None and not _interpret():
-        vmem = pl.BlockSpec(memory_space=pltpu.VMEM)
-        kwargs = dict(
-            in_specs=[pl.BlockSpec(memory_space=pltpu.SMEM)] + [vmem] * 5,
-            out_specs=pl.BlockSpec(memory_space=pltpu.VMEM))
+    mspace, smem = _mem_spaces()
+    kwargs = dict(
+        in_specs=[pl.BlockSpec(**smem)] + [pl.BlockSpec(**mspace)] * 5,
+        out_specs=pl.BlockSpec(**mspace))
     return pl.pallas_call(
         functools.partial(_shard_fused_vmem_kernel, tsteps=tsteps,
                           nx=nx, ny=ny, cx=cx, cy=cy, step=step),
