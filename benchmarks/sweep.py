@@ -400,7 +400,13 @@ def to_markdown(records, platform, is_cpu_host):
         "steps shown); 'elapsed' is the raw end-to-end wall-clock of the "
         "largest timed run including the ~0.1-0.2 s tunnel fence. "
         "Speedup columns compare the reference's 100-iteration wall-clock "
-        "to our marginal step time x 100.", "",
+        "to our marginal step time x 100. Per-cell rates are NOT "
+        "monotone in grid size across the VMEM-residency boundary: "
+        "pallas grids small enough to stay resident (<= ~2.6 MB, e.g. "
+        "640x512) run the zero-HBM-traffic resident kernel and can beat "
+        "the streaming band kernel's per-cell rate at larger grids "
+        "(640x512's ~276 Gcells/s row re-confirms at 244-267 under "
+        "600k-step amortization).", "",
         "| mode | grid | mesh | steps | step time (s) | Mcells/s | "
         "elapsed (s) | method | ref serial 100-step (s) | speedup vs ref "
         f"serial | vs ref best (160 tasks) | vs ref CUDA |{extra_hdr}",

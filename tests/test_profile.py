@@ -1,0 +1,95 @@
+"""Phase-share attribution tests for benchmarks/profile_phases.py — the
+mpiP-analogue post-processor — on synthetic trace events shaped like the
+two real layouts (TPU device lanes, CPU backend executor threads)."""
+
+import os
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from benchmarks.profile_phases import classify, phase_shares  # noqa: E402
+
+
+def test_classify_op_families():
+    assert classify("ppermute.43") == "halo exchange (ppermute)"
+    assert classify("collective-permute.2") == "halo exchange (ppermute)"
+    assert classify("psum_invariant.6") == "residual reduction (psum)"
+    assert classify("all-reduce.1") == "residual reduction (psum)"
+    assert classify("Rendezvous") == "synchronization (rendezvous/wait)"
+    assert classify("Wait: pending_threads=3/8") \
+        == "synchronization (rendezvous/wait)"
+    assert classify("closed_call.4") == "stencil kernel (pallas sweep)"
+    assert classify("copy.11") == "carry copies (HBM)"
+    assert classify("fusion.2").startswith("stencil compute")
+    assert classify("while.60") is None          # parent span, not a phase
+    assert classify("unknown_op.9") is None
+
+
+def _meta(pid, pname, tid, tname):
+    return [
+        {"ph": "M", "pid": pid, "name": "process_name",
+         "args": {"name": pname}},
+        {"ph": "M", "pid": pid, "tid": tid, "name": "thread_name",
+         "args": {"name": tname}},
+    ]
+
+
+def _ev(pid, tid, name, dur_us):
+    return {"ph": "X", "pid": pid, "tid": tid, "name": name,
+            "dur": dur_us}
+
+
+def test_phase_shares_tpu_layout():
+    """TPU: total from the 'jit_*' module span, leaves from 'XLA Ops'
+    (with 'while' parents skipped so nothing double-counts)."""
+    events = (
+        _meta(3, "/device:TPU:0", 2, "XLA Modules")
+        + _meta(3, "/device:TPU:0", 3, "XLA Ops")
+        + [
+            _ev(3, 2, "jit__lambda(123)", 1_000_000),
+            _ev(3, 3, "while", 990_000),                 # parent: skipped
+            _ev(3, 3, "closed_call.4", 900_000),
+            _ev(3, 3, "copy.11", 50_000),
+            _ev(3, 3, "fusion.2", 20_000),
+        ])
+    shares, total, lanes = phase_shares(events)
+    assert total == pytest.approx(1.0)
+    assert lanes == 1
+    assert shares["stencil kernel (pallas sweep)"] == pytest.approx(0.9)
+    assert shares["carry copies (HBM)"] == pytest.approx(0.05)
+    # remainder (loop control) is total - attributed
+    assert total - sum(shares.values()) == pytest.approx(0.03)
+
+
+def test_phase_shares_cpu_layout():
+    """CPU backend: total from ThunkExecutor::Execute per device thread;
+    leaf thunks carry HLO names; seconds sum across lanes."""
+    events = []
+    for d in range(2):
+        tid = 10 + d
+        events += _meta(700 + d, "/host:CPU", tid,
+                        f"tf_XLAPjRtCpuClient/{d}")
+        events += [
+            _ev(700 + d, tid, "ThunkExecutor::Execute", 500_000),
+            _ev(700 + d, tid, "while.60", 480_000),      # parent: skipped
+            _ev(700 + d, tid, "ppermute.43", 200_000),
+            _ev(700 + d, tid, "Rendezvous", 100_000),
+            _ev(700 + d, tid, "multiply_add_fusion", 50_000),
+        ]
+    shares, total, lanes = phase_shares(events)
+    assert lanes == 2
+    assert total == pytest.approx(1.0)        # 2 lanes x 0.5 s
+    assert shares["halo exchange (ppermute)"] == pytest.approx(0.4)
+    assert shares["synchronization (rendezvous/wait)"] == pytest.approx(0.2)
+
+
+def test_phase_shares_total_never_below_attributed():
+    """A trace with leaves but no parent span still yields a sane total
+    (max of parents, attributed sum)."""
+    events = (_meta(3, "/device:TPU:0", 3, "XLA Ops")
+              + [_ev(3, 3, "closed_call.1", 100_000)])
+    shares, total, _ = phase_shares(events)
+    assert total == pytest.approx(0.1)
+    assert sum(shares.values()) == pytest.approx(0.1)
