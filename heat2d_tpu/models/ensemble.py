@@ -82,7 +82,8 @@ def _run_batch_pallas(u0, cxs, cys, *, steps):
     multi_step_vmem design batched; members must individually pass
     fits_vmem — callers route)."""
     from jax.experimental import pallas as pl
-    from heat2d_tpu.ops.pallas_stencil import _interpret, _mem_spaces
+    from heat2d_tpu.ops.pallas_stencil import (_interpret, _mem_spaces,
+                                               _parallel_grid)
 
     b, nx, ny = u0.shape
     # (B, 1, 2): a (1, 1, 2) block's last two dims equal the array's —
@@ -102,7 +103,8 @@ def _run_batch_pallas(u0, cxs, cys, *, steps):
         functools.partial(_ensemble_kernel, steps=steps),
         out_shape=jax.ShapeDtypeStruct(u0.shape, u0.dtype),
         grid_spec=grid_spec,
-        interpret=_interpret())(scal, u0)
+        interpret=_interpret(),
+        **_parallel_grid(1))(scal, u0)
 
 
 def _ensemble_band_kernel(s_ref, up_ref, u_ref, dn_ref, out_ref, *,
@@ -130,7 +132,7 @@ def _batched_band_sweep(scal, u, bm, tsteps, nx, ny):
     blocks aliased in place (each program reads only its own block; the
     neighbor-row strips ride as separate operands)."""
     from heat2d_tpu.ops.pallas_stencil import (_interpret, _mem_spaces,
-                                               _row_strips)
+                                               _parallel_grid, _row_strips)
 
     b, m, n = u.shape
     nblk = m // bm
@@ -154,7 +156,8 @@ def _batched_band_sweep(scal, u, bm, tsteps, nx, ny):
         out_shape=jax.ShapeDtypeStruct(u.shape, u.dtype),
         grid_spec=grid_spec,
         interpret=_interpret(),
-        input_output_aliases={2: 0})(scal, ups, u, dns)
+        input_output_aliases={2: 0},
+        **_parallel_grid(2))(scal, ups, u, dns)
 
 
 def _run_batch_band(u0, cxs, cys, *, steps):

@@ -306,13 +306,38 @@ def _check_band_vmem(bm: int, tsteps: int, ny: int, dtype,
             f"--gridy N) or reduce --halo-depth")
 
 
+def _on_tpu() -> bool:
+    """True when kernels lower through Mosaic (pltpu available and not
+    interpreter mode) — the one predicate _mem_spaces/_parallel_grid
+    share."""
+    return pltpu is not None and not _interpret()
+
+
 def _mem_spaces():
     """(vmem kwargs, smem kwargs) for BlockSpecs — empty in interpreter
     mode, where pltpu memory spaces don't apply."""
-    if pltpu is not None and not _interpret():
+    if _on_tpu():
         return (dict(memory_space=pltpu.VMEM),
                 dict(memory_space=pltpu.SMEM))
     return {}, {}
+
+
+def _parallel_grid(ndims: int):
+    """compiler_params marking every grid dimension parallel — band (and
+    member) programs within one sweep are independent: each reads only
+    its own block plus pre-gathered strip operands and writes only its
+    own block, so Mosaic may pipeline them freely. Measured +6-9% on the
+    4096^2 band kernel (interleaved A/B vs the default 'arbitrary').
+    Empty off-TPU or when neither CompilerParams spelling exists (older
+    jax names it TPUCompilerParams)."""
+    if not _on_tpu():
+        return {}
+    params = (getattr(pltpu, "CompilerParams", None)
+              or getattr(pltpu, "TPUCompilerParams", None))
+    if params is None:  # pragma: no cover - very old pallas
+        return {}
+    return dict(compiler_params=params(
+        dimension_semantics=("parallel",) * ndims))
 
 
 def _row_strips(blocks, t, first, last):
@@ -373,7 +398,8 @@ def _banded_pallas(kernel_body, u, bm, t):
         out_shape=jax.ShapeDtypeStruct(u.shape, u.dtype),
         grid_spec=grid_spec,
         interpret=_interpret(),
-        input_output_aliases={1: 0})(ups, u, dns)
+        input_output_aliases={1: 0},
+        **_parallel_grid(1))(ups, u, dns)
 
 
 def band_step(u, cx: float, cy: float, bm: int | None = None,
@@ -728,7 +754,8 @@ def _shard_band_chunk(u, strips, scalars, tsteps, cx, cy, nx, ny,
         out_shape=jax.ShapeDtypeStruct((m_pad, n), u.dtype),
         grid_spec=grid_spec,
         interpret=_interpret(),
-        input_output_aliases={4: 0})(scalars, wwin, ewin, ups, u_in, dns)
+        input_output_aliases={4: 0},
+        **_parallel_grid(1))(scalars, wwin, ewin, ups, u_in, dns)
     return out[:m] if m_pad > m else out
 
 
