@@ -56,14 +56,16 @@ def main(argv):
         print(f"usage: {argv[0]} [nx ny]", file=sys.stderr)
         return 1
     # Probe past the planner's own ceiling: the envelope is what we are
-    # here to measure.
+    # here to measure. Stamp the origin so a fast-fail inside the probe
+    # reports itself as probe-lifted, not as a --vmem-budget override.
     ps.VMEM_HARD_LIMIT_BYTES = 10**9
+    ps.VMEM_LIMIT_ORIGIN = "lifted by the tune_bands probe"
     u = inidat(nx, ny)
     jax.block_until_ready(u)
     cells = (nx - 2) * (ny - 2)
     configs = []
     for t in (4, 8, 12, 16):
-        for bm in (64, 96, 128, 160, 192):
+        for bm in (64, 96, 128, 160, 192, 224, 256):
             if bm > 2 * t:
                 configs.append((bm, t))
     print(f"# {nx}x{ny} on {jax.devices()[0].device_kind}; "

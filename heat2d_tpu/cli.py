@@ -391,6 +391,18 @@ def main(argv=None) -> int:
         print(f"{e}\nQuitting...", file=sys.stderr)
         return 1
 
+    if cfg.debug and solver.mesh is not None:
+        # DEBUG topology dump (grad1612_mpi_heat.c:170-175): one line per
+        # shard with its exchange partners, -1 = no neighbor
+        # (MPI_PROC_NULL at the non-periodic edges). Shape read from the
+        # mesh actually built, not re-derived from the config.
+        from heat2d_tpu.parallel.mesh import neighbor_table
+        gx, gy = solver.mesh.devices.shape
+        for row in neighbor_table(gx, gy):
+            say(f"shard {row['shard']} at ({row['x']},{row['y']}): "
+                f"N={row['north']} S={row['south']} "
+                f"W={row['west']} E={row['east']}")
+
     start_step = 0
     if args.resume:
         grid, start_step, ck_cfg = load_checkpoint(args.resume,

@@ -48,11 +48,19 @@ from heat2d_tpu.ops.stencil import residual_sq
 #: measured v5e envelope. The reference queried its device the same way
 #: (detailsGPU, grad1612_cuda_heat.cu:24-37) instead of baking in one card.
 _KNOWN_VMEM_TOTAL_BYTES = {
-    "TPU v2": 16 * 1024 * 1024,
-    "TPU v3": 16 * 1024 * 1024,
-    "TPU v4": 32 * 1024 * 1024,
+    # MEASURED (tune_bands.py probe on the attached chip): v5e/v5 lite —
+    # the 16 MB figure reproduces the observed compile envelope exactly.
     "TPU v5 lite": 16 * 1024 * 1024,
     "TPU v5e": 16 * 1024 * 1024,
+    # ASSUMED from public specs, NOT probed: held at the conservative
+    # 16 MB even where the part likely has more (v4's 32 MB) — this
+    # number sets the fast-fail hard limit, and an overestimate
+    # re-exposes the opaque Mosaic scoped-VMEM OOM the check exists to
+    # prevent. Probe with benchmarks/tune_bands.py on real hardware and
+    # raise per kind (or per run via --vmem-budget).
+    "TPU v2": 16 * 1024 * 1024,
+    "TPU v3": 16 * 1024 * 1024,
+    "TPU v4": 16 * 1024 * 1024,
 }
 _FALLBACK_VMEM_TOTAL_BYTES = 16 * 1024 * 1024
 
@@ -61,6 +69,10 @@ _FALLBACK_VMEM_TOTAL_BYTES = 16 * 1024 * 1024
 #: directly to force routing decisions.
 VMEM_BUDGET_BYTES: int | None = None
 VMEM_HARD_LIMIT_BYTES: int | None = None
+#: Human-readable origin of an explicit hard limit, for the fast-fail
+#: message (set_vmem_budget and the tune_bands probe each stamp their
+#: own — so a probe failure doesn't misreport as a --vmem-budget issue).
+VMEM_LIMIT_ORIGIN: str | None = None
 
 _detected: tuple[int, str] | None = None
 
@@ -104,12 +116,13 @@ def vmem_hard_limit_bytes() -> int:
 def set_vmem_budget(total_bytes: int) -> None:
     """Override the detected per-core VMEM size (the --vmem-budget flag):
     budget and hard limit re-derive from the given total."""
-    global VMEM_BUDGET_BYTES, VMEM_HARD_LIMIT_BYTES
+    global VMEM_BUDGET_BYTES, VMEM_HARD_LIMIT_BYTES, VMEM_LIMIT_ORIGIN
     if total_bytes < 4 * 1024 * 1024:
         raise ConfigError(
             f"--vmem-budget must be at least 4 MiB, got {total_bytes} bytes")
     VMEM_BUDGET_BYTES = total_bytes // 2
     VMEM_HARD_LIMIT_BYTES = total_bytes - 2 * 1024 * 1024
+    VMEM_LIMIT_ORIGIN = "set by the --vmem-budget override"
 
 
 def _interpret() -> bool:
@@ -219,20 +232,35 @@ def multi_step_vmem(u, steps: int, cx: float, cy: float,
 # --------------------------------------------------------------------- #
 
 def _band_kernel(up_ref, u_ref, dn_ref, out_ref, *, bm, nx, ny, cx, cy,
-                 step):
+                 step, hi_start=None):
     i = pl.program_id(0)
     ext = jnp.concatenate([up_ref[0], u_ref[:], dn_ref[0]], axis=0)
     # The step form handles the column boundary (first/last col kept);
     # its kept first/last *rows* here are strip rows, discarded by the
     # [1:-1] slice — the band's own rows all come out updated.
     new = step(ext, cx, cy)[1:-1, :]
-    # Global first/last row are boundary: keep (CUDA guard ix>0 && ix<NX-1,
-    # grad1612_cuda_heat.cu:58).
-    gi = i * bm + lax.broadcasted_iota(jnp.int32, (bm, 1), 0)
-    # >= nx-1 (not ==) also holds plan_bands pad rows inert at zero, the
-    # same invariant kernels C/D keep.
-    keep = (gi == 0) | (gi >= nx - 1)
-    out_ref[:] = jnp.where(keep, ext[1:-1, :], new)
+
+    def write_masked():
+        # Global first/last row are boundary: keep (CUDA guard
+        # ix>0 && ix<NX-1, grad1612_cuda_heat.cu:58).
+        gi = i * bm + lax.broadcasted_iota(jnp.int32, (bm, 1), 0)
+        # >= nx-1 (not ==) also holds plan_bands pad rows inert at zero,
+        # the same invariant kernels C/D keep.
+        keep = (gi == 0) | (gi >= nx - 1)
+        out_ref[:] = jnp.where(keep, ext[1:-1, :], new)
+
+    if hi_start is None:
+        write_masked()
+        return
+    # Interior fast path — same static-band-range reasoning as kernel C
+    # (_mask_hi_start with t=0: band i holds a boundary/pad row only for
+    # i == 0 or i >= hi_start).
+    needs_mask = (i == 0) | (i >= hi_start)
+    pl.when(needs_mask)(write_masked)
+
+    @pl.when(jnp.logical_not(needs_mask))
+    def _():
+        out_ref[:] = new
 
 
 def plan_bands(nrows: int, ny: int, dtype=jnp.float32,
@@ -291,7 +319,7 @@ def _check_band_vmem(bm: int, tsteps: int, ny: int, dtype,
     limit = vmem_hard_limit_bytes()
     if est > limit:
         if VMEM_HARD_LIMIT_BYTES is not None:
-            origin = "set by the --vmem-budget override"
+            origin = VMEM_LIMIT_ORIGIN or "set by the --vmem-budget override"
         else:
             total, kind = _vmem_total()
             origin = (f"derived from the detected {kind} "
@@ -417,9 +445,11 @@ def band_step(u, cx: float, cy: float, bm: int | None = None,
     _check_band_vmem(bm, 0, ny, u.dtype)
     if m_pad > m:
         u = jnp.pad(u, ((0, m_pad - m), (0, 0)))
+    hi_start = _mask_hi_start(nx, bm, 0)
     out = _banded_pallas(
         functools.partial(_band_kernel, bm=bm, nx=nx, ny=ny, cx=cx, cy=cy,
-                          step=step),
+                          step=step,
+                          hi_start=hi_start if hi_start > 1 else None),
         u, bm, 1)
     return out[:m] if m_pad > m else out
 
@@ -439,8 +469,18 @@ def band_step(u, cx: float, cy: float, bm: int | None = None,
 # (the CUDA guard, grad1612_cuda_heat.cu:58), so garbage in the
 # out-of-domain strip rows of edge bands is firewalled at the boundary.
 
+def _mask_hi_start(nx: int, bm: int, tsteps: int) -> int:
+    """First band index whose extended rows reach the high boundary:
+    band i's ext covers global rows [i*bm - t, (i+1)*bm + t), so it
+    contains a clamped/pad row (gi >= nx-1) iff (i+1)*bm + t - 1 >= nx-1,
+    i.e. i >= (nx - t) / bm - 1. Bands below this (and above 0) carry an
+    all-false keep mask — the static fact behind the interior fast path.
+    """
+    return max(0, -(-(nx - tsteps) // bm) - 1)
+
+
 def _band_multi_kernel(up_ref, u_ref, dn_ref, out_ref, *,
-                       bm, tsteps, nx, ny, cx, cy, step):
+                       bm, tsteps, nx, ny, cx, cy, step, hi_start=None):
     i = pl.program_id(0)
     ext = jnp.concatenate([up_ref[0], u_ref[:], dn_ref[0]], axis=0)
     # Global row ids of ext rows; <=0 also covers out-of-domain strip rows.
@@ -448,11 +488,31 @@ def _band_multi_kernel(up_ref, u_ref, dn_ref, out_ref, *,
           + lax.broadcasted_iota(jnp.int32, (bm + 2 * tsteps, 1), 0))
     keep = (gi <= 0) | (gi >= nx - 1)
 
-    def one(v):
+    def masked(v):
         return jnp.where(keep, v, step(v, cx, cy))
 
-    ext = _unrolled_steps(tsteps, one, ext)
-    out_ref[:] = ext[tsteps:-tsteps]
+    if hi_start is None:
+        # No interior band exists — one uniform masked body.
+        out_ref[:] = _unrolled_steps(tsteps, masked, ext)[tsteps:-tsteps]
+        return
+
+    # Interior fast path: bands in (0, hi_start) have an all-false keep
+    # mask (no boundary or pad row in their ext block — _mask_hi_start),
+    # so the per-cell select every step is pure overhead there. The
+    # boundary select is 1 of the step's ~7 effective VPU ops/cell;
+    # dropping it for the (nblk - 2ish) interior bands bought +9% at
+    # 4096^2 (measured round 4). pl.when lowers to real control flow, so
+    # only one body executes per program.
+    needs_mask = (i == 0) | (i >= hi_start)
+
+    @pl.when(needs_mask)
+    def _():
+        out_ref[:] = _unrolled_steps(tsteps, masked, ext)[tsteps:-tsteps]
+
+    @pl.when(jnp.logical_not(needs_mask))
+    def _():
+        out_ref[:] = _unrolled_steps(
+            tsteps, lambda v: step(v, cx, cy), ext)[tsteps:-tsteps]
 
 
 def band_multi_step(u, tsteps: int, cx: float, cy: float,
@@ -478,9 +538,13 @@ def band_multi_step(u, tsteps: int, cx: float, cy: float,
     _check_band_vmem(bm, tsteps, ny, u.dtype)
     if m_pad > m:
         u = jnp.pad(u, ((0, m_pad - m), (0, 0)))
+    # hi_start only when an interior (mask-free) band exists; otherwise
+    # the uniform masked body avoids compiling a dead second branch.
+    hi_start = _mask_hi_start(nx, bm, tsteps)
     out = _banded_pallas(
         functools.partial(_band_multi_kernel, bm=bm, tsteps=tsteps,
-                          nx=nx, ny=ny, cx=cx, cy=cy, step=step),
+                          nx=nx, ny=ny, cx=cx, cy=cy, step=step,
+                          hi_start=hi_start if hi_start > 1 else None),
         u, bm, tsteps)
     return out[:m] if m_pad > m else out
 

@@ -60,6 +60,28 @@ def shard_map_compat(f, mesh, in_specs, out_specs, check_vma=None):
     return shard_map(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs)
 
 
+def neighbor_table(gridx: int, gridy: int = 1) -> list[dict]:
+    """Per-shard N/S/E/W neighbor map — the reference's DEBUG topology
+    dump (grad1612_mpi_heat.c:170-175: under DEBUG each rank prints the
+    neighbor ranks MPI_Cart_shift returned, with MPI_PROC_NULL = -1 at
+    the non-periodic edges). Shard id is the row-major (x, y) mesh
+    position — the same order ``mesh.devices.flat`` and the halo
+    ppermute permutations use, so the printed ids are the actual
+    exchange partners."""
+    table = []
+    for i in range(gridx):
+        for j in range(gridy):
+            rank = i * gridy + j
+            table.append({
+                "shard": rank, "x": i, "y": j,
+                "north": rank - gridy if i > 0 else -1,
+                "south": rank + gridy if i < gridx - 1 else -1,
+                "west": rank - 1 if j > 0 else -1,
+                "east": rank + 1 if j < gridy - 1 else -1,
+            })
+    return table
+
+
 def mesh_devices_summary(mesh: Mesh) -> dict:
     """Device/topology introspection — the detailsGPU analogue
     (grad1612_cuda_heat.cu:24-37), as structured data."""

@@ -37,6 +37,28 @@ def test_cli_dist2d_run(tmp_path):
     assert final.shape == (16, 16)
 
 
+def test_cli_debug_neighbor_map(tmp_path, capsys):
+    """--debug on dist modes dumps the per-shard N/S/E/W topology
+    (grad1612_mpi_heat.c:170-175 parity; -1 = MPI_PROC_NULL edge)."""
+    rc = main(["--mode", "dist2d", "--gridx", "2", "--gridy", "2",
+               "--nxprob", "16", "--nyprob", "16", "--steps", "4",
+               "--debug", "--outdir", str(tmp_path)])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "shard 0 at (0,0): N=-1 S=2 W=-1 E=1" in out
+    assert "shard 3 at (1,1): N=1 S=-1 W=2 E=-1" in out
+
+
+def test_neighbor_table_row_strip():
+    """dist1d (N,1) topology: chain over rows, no E/W neighbors —
+    mpi_heat2Dn.c's up/down exchange partners."""
+    from heat2d_tpu.parallel.mesh import neighbor_table
+    t = neighbor_table(3, 1)
+    assert [r["north"] for r in t] == [-1, 0, 1]
+    assert [r["south"] for r in t] == [1, 2, -1]
+    assert all(r["west"] == -1 and r["east"] == -1 for r in t)
+
+
 def test_cli_uneven_dist1d_initial_dump_cropped(tmp_path):
     """Uneven decomposition (10 rows over 3 workers pads to 12): both
     dumps must still be the problem domain, not the padded shard shape

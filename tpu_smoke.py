@@ -63,6 +63,20 @@ def main() -> int:
     np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
     print("PASS kernel C bitwise-parity (bit-identical to serial)")
 
+    # Kernel B (single-step band) via the convergence path on an
+    # HBM-sized grid: run_convergence_chunked's tracked step is a
+    # band_step call, exercising the interior-fast-path pl.when branch
+    # (round 4) on real Mosaic.
+    def run_conv(mode):
+        cfg = HeatConfig(nxprob=2048, nyprob=2048, steps=48, mode=mode,
+                         convergence=True, interval=12, sensitivity=0.0)
+        r = Heat2DSolver(cfg).run(timed=False)
+        assert int(r.steps_done) == 48, r.steps_done
+        return r.u
+
+    check("kernel B (band single-step, convergence 2048^2)",
+          run_conv("pallas"), run_conv("serial"))
+
     # Kernel D (hybrid shard kernels) on a 1x1 mesh: VMEM route at a
     # small shard, band route at the round-1 OOM config, and a
     # divisor-poor height (pad rows + windowed column strips).
