@@ -29,15 +29,40 @@ from heat2d_tpu.ops import inidat
 from heat2d_tpu.utils.timing import timed_call
 
 
-def measure(u, bm, t, lo=400, hi=2800, reps=3):
-    """Two-point marginal step time, min-of-reps at each point: the
-    tunnel fence jitters tens of ms, so single measurements at this
-    scale (~0.3 s of compute) can swing 2x; the minimum is the
-    low-noise estimator for a fixed-work run. One warmup per step
-    count covers compile + program load; the reps run warmup-free."""
-    fn = jax.jit(
-        lambda v, n: ps.band_chunk(v, n, 0.1, 0.1, tsteps=t, bm=bm),
-        static_argnums=1)
+def route_for(ny, bm, t, force_legacy):
+    """Which kernel a (bm, T) point measures — band_chunk routes T=8
+    lane-aligned configs to the C2 window kernel, the rest to legacy C,
+    and a mixed table without labels would let C2 numbers masquerade as
+    legacy-C measurements (advisor r4)."""
+    if not force_legacy and ps.window_band_viable(ny, bm, t):
+        return "C2"
+    return "C"
+
+
+def measure(u, bm, t, lo=4000, hi=20000, reps=4, force_legacy=False):
+    """Two-point marginal step time, min-of-reps at each point. Spans
+    follow the round-4 noise study: ~0.5 s marginal spans swing +-15%
+    through the tunnel fence's heavy tails; >=1.2 s spans repeat within
+    ~1-3%. One warmup per step count covers compile + program load; the
+    reps run warmup-free. ``force_legacy`` measures kernel C even where
+    band_chunk would route to C2."""
+    if force_legacy:
+        def chunk(v, n):
+            full, rem = divmod(n, t)
+            if full:
+                v = jax.lax.fori_loop(
+                    0, full,
+                    lambda _, w: ps.band_multi_step(w, t, 0.1, 0.1, bm=bm),
+                    v, unroll=False)
+            if rem:
+                v = ps.band_multi_step(v, rem, 0.1, 0.1, bm=bm)
+            return v
+        fn = jax.jit(chunk, static_argnums=1)
+    else:
+        fn = jax.jit(
+            lambda v, n: ps.band_chunk(v, n, 0.1, 0.1, tsteps=t, bm=bm),
+            static_argnums=1)
+
     def min_of(n):
         ts = [timed_call(fn, u, n)[1]]          # warms up once
         ts += [timed_call(fn, u, n, warmup=False)[1]
@@ -48,12 +73,14 @@ def measure(u, bm, t, lo=400, hi=2800, reps=3):
 
 
 def main(argv):
+    force_legacy = "--legacy" in argv
+    argv = [a for a in argv if a != "--legacy"]
     if len(argv) == 3:
         nx, ny = int(argv[1]), int(argv[2])
     elif len(argv) == 1:
         nx, ny = 4096, 4096
     else:
-        print(f"usage: {argv[0]} [nx ny]", file=sys.stderr)
+        print(f"usage: {argv[0]} [nx ny] [--legacy]", file=sys.stderr)
         return 1
     # Probe past the planner's own ceiling: the envelope is what we are
     # here to measure. Stamp the origin so a fast-fail inside the probe
@@ -69,25 +96,28 @@ def main(argv):
             if bm > 2 * t:
                 configs.append((bm, t))
     print(f"# {nx}x{ny} on {jax.devices()[0].device_kind}; "
-          f"two-point 400->2800 steps, min of 3 per point")
+          f"two-point 4000->20000 steps, min of 4 per point"
+          + (" (forced legacy route)" if force_legacy else ""))
     best = None
     for bm, t in configs:
         est = 5 * (bm + 2 * t) * ny * 4 / 2**20
+        route = route_for(ny, bm, t, force_legacy)
         try:
-            step = measure(u, bm, t)
+            step = measure(u, bm, t, force_legacy=force_legacy)
         except Exception as e:  # noqa: BLE001 - report and move on
-            print(f"bm={bm:4d} T={t:2d} est={est:6.1f}MB  FAILED "
-                  f"{type(e).__name__}: {str(e)[:90]}")
+            print(f"bm={bm:4d} T={t:2d} {route:2s} est={est:6.1f}MB  "
+                  f"FAILED {type(e).__name__}: {str(e)[:90]}")
             continue
         mcells = cells / step / 1e6
         tag = ""
         if best is None or mcells > best[0]:
-            best = (mcells, bm, t)
+            best = (mcells, bm, t, route)
             tag = "  <-- best"
-        print(f"bm={bm:4d} T={t:2d} est={est:6.1f}MB  "
+        print(f"bm={bm:4d} T={t:2d} {route:2s} est={est:6.1f}MB  "
               f"step={step:.3e}s  {mcells:10.1f} Mcells/s{tag}")
     if best:
-        print(f"# best: bm={best[1]} T={best[2]} {best[0]:.1f} Mcells/s")
+        print(f"# best: bm={best[1]} T={best[2]} ({best[3]}) "
+              f"{best[0]:.1f} Mcells/s")
     return 0
 
 

@@ -228,10 +228,6 @@ def _run_ensemble_cli(args, cfg) -> int:
               "equal-length comma-separated lists\nQuitting...",
               file=sys.stderr)
         return 1
-    if cfg.convergence:
-        print("ensemble runs are fixed-step (--convergence unsupported)"
-              "\nQuitting...", file=sys.stderr)
-        return 1
     if cfg.gridx != 1 or cfg.gridy != 1 or cfg.numworkers is not None:
         # Ensemble sharding is over MEMBERS (a batch mesh axis), never
         # space: a gridx/gridy/numworkers the user passed would be
@@ -245,9 +241,9 @@ def _run_ensemble_cli(args, cfg) -> int:
               f"device). Drop the spatial decomposition flags."
               f"\nQuitting...", file=sys.stderr)
         return 1
-    # Flags the ensemble path would silently ignore are rejected, the same
-    # way --convergence is: a user combining them must not believe they
-    # took effect.
+    # Flags the ensemble path would silently ignore are rejected: a user
+    # combining them must not believe they took effect. (--convergence IS
+    # supported: per-member early-exit, models/ensemble.py.)
     unsupported = [flag for flag, on in [
         ("--binary-dumps", args.binary_dumps),
         ("--checkpoint", args.checkpoint is not None),
@@ -267,9 +263,13 @@ def _run_ensemble_cli(args, cfg) -> int:
               + (f" over {len(jax.devices())} devices" if sharded else ""))
         print(f"Problem size:{cfg.nxprob}x{cfg.nyprob}")
         print(f"Amount of iterations: {cfg.steps}")
+        if cfg.convergence:
+            print(f"Check for convergence every {cfg.interval} iterations")
     try:
-        batch, elapsed = timed_ensemble(
-            cfg.nxprob, cfg.nyprob, cfg.steps, cxs, cys, sharded=sharded)
+        batch, steps_done, elapsed = timed_ensemble(
+            cfg.nxprob, cfg.nyprob, cfg.steps, cxs, cys, sharded=sharded,
+            convergence=cfg.convergence, interval=cfg.interval,
+            sensitivity=cfg.sensitivity)
     except (ConfigError, ValueError) as e:
         print(f"{e}\nQuitting...", file=sys.stderr)
         return 1
@@ -278,7 +278,13 @@ def _run_ensemble_cli(args, cfg) -> int:
     # on every rank).
     from heat2d_tpu.parallel.multihost import gather_to_host
     batch = gather_to_host(batch)
+    if steps_done is not None:
+        steps_done = [int(s) for s in gather_to_host(steps_done)]
     if primary:
+        if steps_done is not None:
+            # Per-member exit report — the "Exiting after N iterations"
+            # line (grad1612_mpi_heat.c:287) member-wise.
+            print(f"Members exited after {steps_done} iterations")
         print(f"Elapsed time: {elapsed:e} sec")
         os.makedirs(args.outdir, exist_ok=True)
         if args.dat_layout != "none":
@@ -295,7 +301,7 @@ def _run_ensemble_cli(args, cfg) -> int:
             "elapsed_s": float(elapsed),
             "members": [
                 {"cx": cx, "cy": cy} for cx, cy in zip(cxs, cys)],
-            "summary": ensemble_summary(batch),
+            "summary": ensemble_summary(batch, steps_done=steps_done),
         }
         if args.run_record:
             with open(args.run_record, "w") as f:

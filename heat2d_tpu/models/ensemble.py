@@ -40,7 +40,7 @@ from jax.experimental import pallas as pl
 
 from heat2d_tpu.models import engine
 from heat2d_tpu.ops.init import inidat
-from heat2d_tpu.ops.stencil import stencil_step
+from heat2d_tpu.ops.stencil import residual_sq, stencil_step
 
 
 def _validated_batch(nx, ny, cxs, cys, u0):
@@ -192,6 +192,104 @@ _BATCH_RUNNERS = {"jnp": _run_batch_jnp, "pallas": _run_batch_pallas,
                   "band": _run_batch_band}
 
 
+# --------------------------------------------------------------------- #
+# Convergence (early-exit) ensembles
+# --------------------------------------------------------------------- #
+
+def _run_batch_conv_jnp(u0, cxs, cys, *, steps, interval, sensitivity):
+    """vmap of the engine convergence loop: JAX's while_loop batching
+    rule gives masked completion for free — the combined loop runs while
+    ANY member's predicate holds and select-freezes finished lanes, so
+    each member's trajectory (and steps_done) is exactly its individual
+    engine.run_convergence trajectory (the per-member bitwise-parity
+    tests pin this)."""
+    def solve_one(u, cx, cy):
+        return engine.run_convergence(
+            lambda v: stencil_step(v, cx, cy), residual_sq,
+            u, steps, interval, sensitivity)
+
+    return jax.vmap(solve_one)(u0, cxs, cys)
+
+
+def _run_batch_conv_kernel(u0, cxs, cys, *, steps, interval, sensitivity,
+                           runner):
+    """Batched engine.run_convergence_chunked over the kernel runners:
+    each chunk is ``interval-1`` fused steps plus one tracked step; the
+    residual is per-member; converged members freeze (their stored plane
+    stops updating) while the rest continue, and the loop exits when all
+    members converge or the chunk budget is spent. The trailing
+    ``steps % interval`` remainder runs unchecked on unconverged members
+    only — the same schedule as the individual chunked loop, member-wise.
+    """
+    if steps:
+        interval = max(1, min(interval, steps))
+    n_chunks = steps // interval if interval else 0
+    remainder = steps - n_chunks * interval
+    b = u0.shape[0]
+
+    def chunk(u, n):
+        return runner(u, cxs, cys, steps=n)
+
+    def body(carry):
+        u, i, chunks, done = carry
+        u_prev = chunk(u, interval - 1) if interval > 1 else u
+        u_new = chunk(u_prev, 1)
+        # vmap'd residual_sq so the per-member residual is the SAME
+        # definition (cast order included) the individual loops use.
+        res = jax.vmap(lambda a, b: residual_sq(a, b))(u_new, u_prev)
+        # Members already done keep their frozen plane; the member that
+        # converges THIS chunk stores u_new (matching the individual
+        # loop, whose final plane is the one its residual was computed
+        # from) and freezes starting next iteration.
+        u = jnp.where(done[:, None, None], u, u_new)
+        chunks = jnp.where(done, chunks, chunks + 1)
+        done = done | (res < sensitivity)
+        return (u, i + 1, chunks, done)
+
+    def cond(carry):
+        _, i, _, done = carry
+        return jnp.logical_and(i < n_chunks,
+                               jnp.logical_not(jnp.all(done)))
+
+    init = (u0, jnp.asarray(0, jnp.int32),
+            jnp.zeros((b,), jnp.int32), jnp.zeros((b,), bool))
+    u, _, chunks, done = jax.lax.while_loop(cond, body, init)
+    k = (chunks * interval).astype(jnp.int32)
+    if remainder:
+        u_adv = chunk(u, remainder)
+        u = jnp.where(done[:, None, None], u, u_adv)
+        k = jnp.where(done, k, k + remainder).astype(jnp.int32)
+    return u, k
+
+
+def _conv_runner(method, steps, interval, sensitivity):
+    """The jitted (u0, cxs, cys) -> (u, steps_done) convergence runner
+    for a method — vmap'd engine loop for 'jnp', the batched chunked
+    loop over the corresponding kernel runner otherwise."""
+    if method == "jnp":
+        return functools.partial(_run_batch_conv_jnp, steps=steps,
+                                 interval=interval,
+                                 sensitivity=sensitivity)
+    return functools.partial(_run_batch_conv_kernel, steps=steps,
+                             interval=interval, sensitivity=sensitivity,
+                             runner=_BATCH_RUNNERS[method])
+
+
+def run_ensemble_convergence(nx: int, ny: int, steps: int, interval: int,
+                             sensitivity: float, cxs, cys, u0=None,
+                             method: str = "auto"):
+    """Ensemble with per-member convergence early-exit — the intended
+    grad1612_mpi_heat.c:262-271 residual schedule applied member-wise
+    (the reference could only run one instance per launch; SURVEY.md
+    §2.3). Returns (batch, steps_done): converged members froze at
+    their exit plane; ``steps_done[i]`` is member i's iteration count,
+    a multiple of ``interval`` unless the step budget ran out first."""
+    cxs, cys, u0 = _validated_batch(nx, ny, cxs, cys, u0)
+    method = _pick_method(method, nx, ny)
+    fn = jax.jit(_conv_runner(method, steps, interval, sensitivity))
+    return fn(u0, cxs, cys)
+
+
 def _pick_method(method, nx, ny):
     if method != "auto":
         return method
@@ -223,9 +321,13 @@ def _build_single(steps, method, u0, cxs, cys):
     return fn, (u0, cxs, cys), cxs.shape[0]
 
 
-def _build_sharded(steps, method, u0, cxs, cys, devices):
+def _shard_local_fn(local, u0, cxs, cys, devices):
     """Jitted shard_map program + placed inputs for a batch-axis mesh;
-    pads the batch to a device multiple with inert members (cx=cy=0)."""
+    pads the batch to a device multiple with inert members (cx=cy=0).
+    ``local`` is any (u, cxs, cys) -> outputs batch function; each
+    device runs it on its local members (device-local while_loops in the
+    convergence case — no collective inside, so devices may exit their
+    loops at different chunk counts)."""
     from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
     from heat2d_tpu.parallel.mesh import shard_map_compat
@@ -242,11 +344,6 @@ def _build_sharded(steps, method, u0, cxs, cys, devices):
             [u0, jnp.zeros((pad, nx, ny), u0.dtype)], axis=0)
 
     mesh = Mesh(np.asarray(devices), ("b",))
-    run = _BATCH_RUNNERS[method]
-
-    def local(u, cx, cy):
-        return run(u, cx, cy, steps=steps)
-
     mapped = shard_map_compat(local, mesh, in_specs=P("b"),
                               out_specs=P("b"), check_vma=False)
     sharding = NamedSharding(mesh, P("b"))
@@ -254,6 +351,15 @@ def _build_sharded(steps, method, u0, cxs, cys, devices):
     cxs = jax.device_put(cxs, sharding)
     cys = jax.device_put(cys, sharding)
     return jax.jit(mapped), (u0, cxs, cys), b
+
+
+def _build_sharded(steps, method, u0, cxs, cys, devices):
+    run = _BATCH_RUNNERS[method]
+
+    def local(u, cx, cy):
+        return run(u, cx, cy, steps=steps)
+
+    return _shard_local_fn(local, u0, cxs, cys, devices)
 
 
 def run_ensemble_sharded(nx: int, ny: int, steps: int, cxs, cys, u0=None,
@@ -267,30 +373,62 @@ def run_ensemble_sharded(nx: int, ny: int, steps: int, cxs, cys, u0=None,
     return fn(*args)[:b]
 
 
+def run_ensemble_convergence_sharded(nx: int, ny: int, steps: int,
+                                     interval: int, sensitivity: float,
+                                     cxs, cys, u0=None,
+                                     method: str = "auto", devices=None):
+    """Convergence ensemble with the batch as a mesh axis. Inert pad
+    members (cx=cy=0) reach residual 0 after one chunk, so they converge
+    immediately for any sensitivity > 0 and never hold their device's
+    loop open (with sensitivity == 0 every member runs the full budget
+    anyway). Returns (batch, steps_done), both cropped to B."""
+    cxs, cys, u0 = _validated_batch(nx, ny, cxs, cys, u0)
+    method = _pick_method(method, nx, ny)
+    local = _conv_runner(method, steps, interval, sensitivity)
+    fn, args, b = _shard_local_fn(local, u0, cxs, cys, devices)
+    u, k = fn(*args)
+    return u[:b], k[:b]
+
+
 def timed_ensemble(nx: int, ny: int, steps: int, cxs, cys, u0=None,
                    method: str = "auto", sharded: bool = False,
-                   devices=None):
-    """(batch, elapsed): one ensemble launch under the reference timing
-    protocol (compile/warmup excluded, scalar-readback fence) — the CLI
-    entry point. ``sharded=True`` spreads members over a device-mesh
-    batch axis."""
+                   devices=None, convergence: bool = False,
+                   interval: int = 20, sensitivity: float = 0.1):
+    """(batch, steps_done, elapsed): one ensemble launch under the
+    reference timing protocol (compile/warmup excluded, scalar-readback
+    fence) — the CLI entry point. ``sharded=True`` spreads members over
+    a device-mesh batch axis; ``convergence=True`` runs the per-member
+    early-exit schedule (steps_done is None on fixed-step runs, where
+    every member runs exactly ``steps``)."""
     from heat2d_tpu.utils.timing import timed_call
 
     cxs, cys, u0 = _validated_batch(nx, ny, cxs, cys, u0)
     method = _pick_method(method, nx, ny)
+    if convergence:
+        local = _conv_runner(method, steps, interval, sensitivity)
+        if sharded:
+            fn, args, b = _shard_local_fn(local, u0, cxs, cys, devices)
+        else:
+            fn, args, b = jax.jit(local), (u0, cxs, cys), cxs.shape[0]
+        (u, k), elapsed = timed_call(fn, *args)
+        return u[:b], k[:b], elapsed
     if sharded:
         fn, args, b = _build_sharded(steps, method, u0, cxs, cys, devices)
     else:
         fn, args, b = _build_single(steps, method, u0, cxs, cys)
     out, elapsed = timed_call(fn, *args)
-    return out[:b], elapsed
+    return out[:b], None, elapsed
 
 
-def ensemble_summary(batch) -> dict:
-    """Per-member residual-free diagnostics (max temp, total heat)."""
+def ensemble_summary(batch, steps_done=None) -> dict:
+    """Per-member residual-free diagnostics (max temp, total heat), plus
+    per-member iteration counts on convergence runs."""
     batch = np.asarray(batch)
-    return {
+    out = {
         "members": int(batch.shape[0]),
         "max_temperature": [float(m) for m in batch.max(axis=(1, 2))],
         "total_heat": [float(s) for s in batch.sum(axis=(1, 2))],
     }
+    if steps_done is not None:
+        out["steps_done"] = [int(s) for s in steps_done]
+    return out

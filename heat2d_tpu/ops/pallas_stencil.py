@@ -350,18 +350,24 @@ def _mem_spaces():
     return {}, {}
 
 
+def _compiler_params_cls():
+    """The CompilerParams class across jax versions (older jax names it
+    TPUCompilerParams), or None on very old pallas — the ONE lookup the
+    parallel-grid marking and the C2 sequential-grid route share."""
+    return (getattr(pltpu, "CompilerParams", None)
+            or getattr(pltpu, "TPUCompilerParams", None))
+
+
 def _parallel_grid(ndims: int):
     """compiler_params marking every grid dimension parallel — band (and
     member) programs within one sweep are independent: each reads only
     its own block plus pre-gathered strip operands and writes only its
     own block, so Mosaic may pipeline them freely. Measured +6-9% on the
     4096^2 band kernel (interleaved A/B vs the default 'arbitrary').
-    Empty off-TPU or when neither CompilerParams spelling exists (older
-    jax names it TPUCompilerParams)."""
+    Empty off-TPU or when neither CompilerParams spelling exists."""
     if not _on_tpu():
         return {}
-    params = (getattr(pltpu, "CompilerParams", None)
-              or getattr(pltpu, "TPUCompilerParams", None))
+    params = _compiler_params_cls()
     if params is None:  # pragma: no cover - very old pallas
         return {}
     return dict(compiler_params=params(
@@ -555,15 +561,196 @@ def band_multi_step(u, tsteps: int, cx: float, cy: float,
 DEFAULT_TSTEPS = 8
 
 
+# --------------------------------------------------------------------- #
+# Kernel C2: gather-free band sweeps (overlap window + scratch relay)
+# --------------------------------------------------------------------- #
+#
+# Kernel C re-gathers the (nblk, T, ny) neighbor-row strips between every
+# sweep — a separate XLA copy op (~2x 2T/bm of the grid's bytes per sweep)
+# that cannot overlap the kernel. C2 eliminates the gather entirely
+# (measured 187.5k -> 216-223k Mcells/s at 4096^2, bm 128->160):
+#
+# - The grid runs SEQUENTIALLY (dimension_semantics 'arbitrary'), which
+#   turns program order into a dataflow edge:
+# - DOWN-strips ride in the same operand via a row-overlapping pl.Element
+#   window (bm+T rows starting at i*bm): the extra T rows are block i+1's
+#   head, still holding OLD values when program i's window is fetched —
+#   in-flight writes always trail the read frontier by >= bm - T rows, so
+#   the in-place alias stays race-free with any pipeline lookahead.
+# - UP-strips flow through a persistent (T, ny) VMEM scratch: program i
+#   stashes its ORIGINAL tail rows before its output write; program i+1
+#   reads the stash. Program 0 reads uninitialized scratch — those ext
+#   rows sit at gi <= 0, where the keep mask firewalls any garbage
+#   (including NaNs) exactly like out-of-domain pad rows.
+#
+# Mosaic constraints gate the route (window_band_viable): element starts
+# must be sublane-aligned (bm % 8), window rows too ((bm+T) % 8 => T % 8),
+# window width lane-aligned (ny % 128), and pl.Element has no interpreter
+# support worth relying on — off-TPU falls back to kernel C (the TPU smoke
+# runner pins C2 bitwise-equal to C on hardware).
+
+def window_band_viable(ny: int, bm: int, tsteps: int) -> bool:
+    return (_on_tpu() and _compiler_params_cls() is not None
+            and ny % 128 == 0 and bm % 8 == 0
+            and tsteps % 8 == 0 and bm > 2 * tsteps)
+
+
+#: Measured C2 compile envelope on the 16 MB-VMEM v5e (round-4 probe):
+#: max viable ext rows (bm + 2T) per row width — the next 8-row step up
+#: OOMs the compiler's scoped VMEM (168 @ 16 KB rows, 336 @ 8 KB). The
+#: envelope does NOT follow a single bytes cap across widths (2.88 MB
+#: windows compile at 16 KB rows but fail at 8 KB), hence a probed
+#:  table, not a formula. bm at these points is also the measured perf
+#: optimum: 160 -> 223k Mcells/s at 4096^2, 320 -> 237k at 2560x2048.
+_WINDOW_EXT_ROWS = {16 * 1024: 176, 8 * 1024: 336}
+
+
+def plan_window_band(nrows: int, ny: int, tsteps: int,
+                     dtype=jnp.float32) -> tuple[int, int]:
+    """(bm, m_pad) for the C2 route: probed envelope for the widths
+    measured on the default-budget v5e; elsewhere a conservative 2.5 MB
+    window cap (scaled to the VMEM budget), safely inside every probed
+    break point."""
+    row_bytes = ny * jnp.dtype(dtype).itemsize
+    ext = None
+    if VMEM_BUDGET_BYTES is None and _vmem_total()[0] == 16 * 1024 * 1024:
+        ext = _WINDOW_EXT_ROWS.get(row_bytes)
+    if ext is None:
+        cap_bytes = vmem_budget_bytes() * 5 // 16    # 2.5 MB at v5e
+        ext = max(8 + 2 * tsteps, cap_bytes // row_bytes)
+    bm_max = max(8, (ext - 2 * tsteps) // 8 * 8)
+    if bm_max >= nrows:
+        bm = max(8, nrows // 8 * 8)  # keep at least one full band
+        return bm, -(-nrows // bm) * bm
+    # Pad-aware refinement: minimize total ext rows swept,
+    # ceil(nrows/bm) * (bm + 2T) — a band height dividing the row count
+    # more evenly skips recomputing pad rows (4096 rows: bm=152 pads 8
+    # rows -> 223.1k Mcells/s vs bm=160 padding 64 -> 221.3k measured).
+    bm = bm_max
+    for b in range(bm_max, max(2 * tsteps, bm_max - 32) - 1, -8):
+        if b <= 2 * tsteps:
+            break
+        if (-(-nrows // b)) * (b + 2 * tsteps) \
+                < (-(-nrows // bm)) * (bm + 2 * tsteps):
+            bm = b
+    return bm, -(-nrows // bm) * bm
+
+
+def _band_window_kernel(u_ref, out_ref, tail, *, bm, tsteps, nx, cx, cy,
+                        step, hi_start):
+    i = pl.program_id(0)
+    t = tsteps
+    up = tail[:]                   # prev band's original tail (garbage @ i=0)
+    tail[:] = u_ref[bm - t:bm, :]  # stash own original tail for band i+1
+    ext = jnp.concatenate([up, u_ref[:]], axis=0)     # (bm + 2t, ny)
+    gi = (i * bm - t + lax.broadcasted_iota(jnp.int32, (bm + 2 * t, 1), 0))
+    keep = (gi <= 0) | (gi >= nx - 1)
+
+    def masked(v):
+        return jnp.where(keep, v, step(v, cx, cy))
+
+    if hi_start is None:
+        out_ref[:] = _unrolled_steps(tsteps, masked, ext)[t:-t]
+        return
+    needs_mask = (i == 0) | (i >= hi_start)
+
+    @pl.when(needs_mask)
+    def _():
+        out_ref[:] = _unrolled_steps(tsteps, masked, ext)[t:-t]
+
+    @pl.when(jnp.logical_not(needs_mask))
+    def _():
+        out_ref[:] = _unrolled_steps(
+            tsteps, lambda v: step(v, cx, cy), ext)[t:-t]
+
+
+def _band_window_sweep(u, tsteps, cx, cy, bm, nx, step):
+    """One T-step sweep over ``u`` of shape (m_pad + T, ny); the last T
+    rows are inert overrun pad for the last band's element window."""
+    mt, ny = u.shape
+    t = tsteps
+    nblk = (mt - t) // bm
+    hi_start = _mask_hi_start(nx, bm, t)
+    mspace, _ = _mem_spaces()
+    params = _compiler_params_cls()   # non-None: window_band_viable gated
+    return pl.pallas_call(
+        functools.partial(_band_window_kernel, bm=bm, tsteps=t, nx=nx,
+                          cx=cx, cy=cy, step=step,
+                          hi_start=hi_start if hi_start > 1 else None),
+        out_shape=jax.ShapeDtypeStruct(u.shape, u.dtype),
+        grid=(nblk,),
+        in_specs=[
+            pl.BlockSpec((pl.Element(bm + t), pl.Element(ny)),
+                         lambda i: (i * bm, 0), **mspace),
+        ],
+        out_specs=pl.BlockSpec((bm, ny), lambda i: (i, 0), **mspace),
+        scratch_shapes=[pltpu.VMEM((t, ny), u.dtype)],
+        input_output_aliases={0: 0},
+        compiler_params=params(dimension_semantics=("arbitrary",)),
+    )(u)
+
+
+def _window_chunk(u, n, cx, cy, tsteps, bm, step):
+    """``n`` steps via gather-free window sweeps (kernel C2); the
+    ``n % T`` remainder runs through the legacy kernel C machinery (a
+    once-per-chunk tail where the sweep cost is irrelevant)."""
+    nx, ny = u.shape
+    _check_band_vmem(bm, tsteps, ny, u.dtype)
+    # The probed envelope binds explicit bm too: past it the compile
+    # dies in the opaque scoped-VMEM OOM the fast-fail exists to
+    # prevent (the est-based check alone admits e.g. bm=328 at 8 KB
+    # rows, 8 ext rows over the measured break).
+    row_bytes = ny * jnp.dtype(u.dtype).itemsize
+    ext_cap = None
+    if VMEM_BUDGET_BYTES is None and _vmem_total()[0] == 16 * 1024 * 1024:
+        ext_cap = _WINDOW_EXT_ROWS.get(row_bytes)
+    if ext_cap is not None and bm + 2 * tsteps > ext_cap:
+        raise ConfigError(
+            f"band window of {bm + 2 * tsteps} ext rows x {ny} cells is "
+            f"over the probed {ext_cap}-row compile envelope for this "
+            f"row width ({_vmem_total()[1]}): use bm <= "
+            f"{(ext_cap - 2 * tsteps) // 8 * 8} or let plan_window_band "
+            f"choose")
+    m_pad = -(-nx // bm) * bm
+    nsweeps, rem = divmod(n, tsteps)
+    out = u
+    if nsweeps:
+        out = jnp.pad(out, ((0, m_pad - nx + tsteps), (0, 0)))
+        out = lax.fori_loop(
+            0, nsweeps,
+            lambda _, v: _band_window_sweep(v, tsteps, cx, cy, bm, nx,
+                                            step),
+            out, unroll=False)
+        out = out[:nx]
+    if rem:
+        out = band_multi_step(out, rem, cx, cy, step=step)
+    return out
+
+
 def band_chunk(u, n: int, cx: float, cy: float,
                tsteps: int = DEFAULT_TSTEPS, bm: int | None = None,
                step=_step_value):
     """Advance ``n`` (static) steps: full T-sweeps plus a remainder sweep.
 
-    Divisor-poor row counts pad ONCE here for the whole loop (the padded
-    shape is a fixed point under the keep-masked kernels), not per sweep.
+    Routes to the gather-free window kernel (C2) when its Mosaic
+    constraints hold — on TPU, lane-aligned width, 8-aligned bm/T; an
+    explicit ``bm`` is honored on whichever route it is viable for.
+    Legacy route: divisor-poor row counts pad ONCE here for the whole
+    loop (the padded shape is a fixed point under the keep-masked
+    kernels), not per sweep.
     """
     nx, ny = u.shape
+    bm_w = bm
+    if bm_w is None and _on_tpu() and ny % 128 == 0 and tsteps % 8 == 0:
+        bm_w, _ = plan_window_band(nx, ny, tsteps, u.dtype)
+    # The C2 envelope table was probed with the FMA step form; the
+    # literal (bitwise-parity) form carries more live temporaries and
+    # OOMs at the same bm (measured: 18.1 MB vs <16 at bm=320, 8 KB
+    # rows), so parity runs — correctness runs, not perf runs — keep
+    # the legacy route.
+    if (step is _step_value and bm_w is not None
+            and window_band_viable(ny, bm_w, tsteps)):
+        return _window_chunk(u, n, cx, cy, tsteps, bm_w, step)
     bm, m_pad = _resolve_bands(nx, ny, u.dtype, bm)
     if m_pad > nx:
         u = jnp.pad(u, ((0, m_pad - nx), (0, 0)))

@@ -93,9 +93,141 @@ def test_ensemble_sharded_matches_single(members):
 
 def test_timed_ensemble():
     from heat2d_tpu.models.ensemble import timed_ensemble
-    batch, elapsed = timed_ensemble(8, 16, 5, [0.1, 0.2], [0.1, 0.1])
+    batch, steps_done, elapsed = timed_ensemble(
+        8, 16, 5, [0.1, 0.2], [0.1, 0.1])
     assert batch.shape == (2, 8, 16)
+    assert steps_done is None  # fixed-step: every member ran exactly 5
     assert elapsed > 0
+
+
+# ------------------------------------------------------------------ #
+# Convergence (per-member early-exit) ensembles — VERDICT r3 #4
+# ------------------------------------------------------------------ #
+
+def _individual_conv(nx, ny, steps, interval, sens, cx, cy):
+    """One member's reference trajectory: the engine convergence loop on
+    the golden step — what each ensemble member must bitwise-match."""
+    import jax
+    from heat2d_tpu.models import engine
+    from heat2d_tpu.ops.init import inidat
+    from heat2d_tpu.ops.stencil import residual_sq, stencil_step
+
+    fn = jax.jit(lambda u: engine.run_convergence(
+        lambda v: stencil_step(v, cx, cy), residual_sq,
+        u, steps, interval, sens))
+    u, k = fn(inidat(nx, ny))
+    return np.asarray(u), int(k)
+
+
+def test_ensemble_convergence_bitwise_matches_individual_runs():
+    """Members with different diffusivities exit at different chunk
+    counts; each must match its individual convergence run BITWISE, with
+    the same steps_done (converged members froze — masked completion)."""
+    from heat2d_tpu.models.ensemble import run_ensemble_convergence
+
+    cxs, cys = [0.02, 0.1, 0.2], [0.02, 0.1, 0.2]
+    steps, interval, sens = 400, 20, 5.0
+    batch, ks = run_ensemble_convergence(12, 16, steps, interval, sens,
+                                         cxs, cys, method="jnp")
+    ks = [int(k) for k in ks]
+    for b, (cx, cy) in enumerate(zip(cxs, cys)):
+        want, k = _individual_conv(12, 16, steps, interval, sens, cx, cy)
+        assert ks[b] == k, f"member {b}: {ks[b]} != {k}"
+        np.testing.assert_array_equal(np.asarray(batch)[b], want)
+    # the point of the test: the exits actually differ across members
+    assert len(set(ks)) > 1, ks
+
+
+def test_ensemble_convergence_kernel_matches_chunked():
+    """The batched kernel convergence loop must reproduce the individual
+    chunked schedule member-wise (chunks of interval-1 fused + 1 tracked
+    step, remainder unchecked on unconverged members)."""
+    import jax
+    from heat2d_tpu.models import engine
+    from heat2d_tpu.models.ensemble import run_ensemble_convergence
+    from heat2d_tpu.ops import pallas_stencil as ps
+    from heat2d_tpu.ops.init import inidat
+    from heat2d_tpu.ops.stencil import residual_sq
+
+    # Binary-exact diffusivities: the individual path bakes cx as a
+    # Python float (k0 pre-computed in double), the batched kernel
+    # computes it from an f32 SMEM scalar — inexact constants differ by
+    # 1 ulp in k0 and drift apart over 150 steps. 2^-5 and 2^-2 are
+    # exact in both, so the comparison isolates the *schedule*.
+    cxs, cys = [0.03125, 0.25], [0.03125, 0.25]
+    # sens between the members' chunk-1 residuals: the slow-diffusion
+    # member (smaller per-step delta) exits at chunk 1, the fast one
+    # runs the full budget incl. the 150 % 20 = 10 remainder.
+    steps, interval, sens = 150, 20, 1e8
+    batch, ks = run_ensemble_convergence(16, 128, steps, interval, sens,
+                                         cxs, cys, method="pallas")
+    for b, (cx, cy) in enumerate(zip(cxs, cys)):
+        fn = jax.jit(lambda u, cx=cx, cy=cy: engine.run_convergence_chunked(
+            lambda v, n: ps.multi_step_vmem(v, n, cx, cy),
+            lambda v: ps.multi_step_vmem(v, 1, cx, cy),
+            residual_sq, u, steps, interval, sens))
+        want, k = fn(inidat(16, 128))
+        assert int(ks[b]) == int(k), f"member {b}"
+        np.testing.assert_allclose(np.asarray(batch)[b], np.asarray(want),
+                                   rtol=1e-6, atol=1e-4)
+    assert int(ks[0]) != int(ks[1])
+
+
+def test_ensemble_convergence_band_method(monkeypatch):
+    """Early-exit through the batched BAND kernel (HBM-sized members:
+    budget pinned tiny so members stream in multi-band sweeps with pad
+    rows) is BITWISE the batched VMEM kernel's result — same step form,
+    different tiling — with heterogeneous exits (member 0 converges at
+    chunk 1, member 1 runs the full budget)."""
+    import heat2d_tpu.ops.pallas_stencil as ps
+    from heat2d_tpu.models.ensemble import run_ensemble_convergence
+
+    monkeypatch.setattr(ps, "VMEM_BUDGET_BYTES", 8 * 128 * 4 * 4)
+    cxs, cys = [0.03125, 0.25], [0.03125, 0.25]
+    a, ka = run_ensemble_convergence(36, 128, 200, 10, 2e8, cxs, cys,
+                                     method="pallas")
+    b, kb = run_ensemble_convergence(36, 128, 200, 10, 2e8, cxs, cys,
+                                     method="band")
+    assert [int(x) for x in ka] == [int(x) for x in kb]
+    assert int(ka[0]) != int(ka[1])
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_ensemble_convergence_sharded_matches_single():
+    """Convergence ensemble over the batch mesh axis (device-local
+    while_loops, inert pad members) == single-device, members cropped."""
+    from heat2d_tpu.models.ensemble import (run_ensemble_convergence,
+                                            run_ensemble_convergence_sharded)
+    cxs = [0.02 * (i + 1) for i in range(5)]
+    cys = [0.1] * 5
+    want, kw = run_ensemble_convergence(8, 16, 200, 10, 0.5, cxs, cys,
+                                        method="jnp")
+    got, kg = run_ensemble_convergence_sharded(8, 16, 200, 10, 0.5,
+                                               cxs, cys, method="jnp")
+    assert got.shape == (5, 8, 16)
+    assert [int(x) for x in kg] == [int(x) for x in kw]
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+def test_cli_ensemble_convergence_run(tmp_path, capsys):
+    """--convergence + ensemble: per-member exit counts reported in the
+    banner and the run record (no longer rejected — VERDICT r3 #4)."""
+    import json
+    from heat2d_tpu.cli import main
+
+    rec_path = tmp_path / "rec.json"
+    rc = main(["--mode", "serial", "--nxprob", "12", "--nyprob", "16",
+               "--steps", "400", "--convergence", "--interval", "20",
+               "--sensitivity", "5.0",
+               "--ensemble-cx", "0.02,0.2", "--ensemble-cy", "0.02,0.2",
+               "--outdir", str(tmp_path), "--run-record", str(rec_path)])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "Members exited after" in out
+    rec = json.loads(rec_path.read_text())
+    ks = rec["summary"]["steps_done"]
+    assert len(ks) == 2 and ks[0] != ks[1]
+    assert all(k % 20 == 0 or k == 400 for k in ks)
 
 
 def test_cli_ensemble_run(tmp_path):

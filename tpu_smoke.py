@@ -63,6 +63,26 @@ def main() -> int:
     np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
     print("PASS kernel C bitwise-parity (bit-identical to serial)")
 
+    # Kernel C2 (gather-free window sweeps — the production pallas route
+    # on TPU) pinned BITWISE to kernel C's legacy gather route: same
+    # step sequence, different strip dataflow (pl.Element window +
+    # sequential-grid scratch relay). Divisor-poor rows exercise the
+    # m_pad + T overrun pad.
+    import heat2d_tpu.ops.pallas_stencil as ps
+    from heat2d_tpu.ops.init import inidat
+
+    def legacy_chunk(v):          # kernel C sweeps, bypassing the router
+        for _ in range(6):
+            v = ps.band_multi_step(v, 8, 0.1, 0.1)
+        return v
+
+    for shape in ((2048, 2048), (1000, 2048)):
+        u = inidat(*shape)
+        want = jax.jit(legacy_chunk)(u)
+        got = jax.jit(lambda v: ps.band_chunk(v, 48, 0.1, 0.1))(u)
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+        print(f"PASS kernel C2 bitwise vs kernel C ({shape[0]}x{shape[1]})")
+
     # Kernel B (single-step band) via the convergence path on an
     # HBM-sized grid: run_convergence_chunked's tracked step is a
     # band_step call, exercising the interior-fast-path pl.when branch
