@@ -23,6 +23,7 @@ import sys
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import jax
+import jax.numpy as jnp
 
 import heat2d_tpu.ops.pallas_stencil as ps
 from heat2d_tpu.ops import inidat
@@ -47,16 +48,27 @@ def measure(u, bm, t, lo=4000, hi=20000, reps=4, force_legacy=False):
     reps run warmup-free. ``force_legacy`` measures kernel C even where
     band_chunk would route to C2."""
     if force_legacy:
+        # Mirror band_chunk's legacy branch exactly: pad ONCE outside
+        # the sweep loop (domain_rows carries the true row count). A
+        # naive per-call band_multi_step(bm=bm) re-pads and re-slices
+        # every sweep at non-divisor bm, inflating exactly the kernel-C
+        # rows this flag exists to measure fairly.
         def chunk(v, n):
+            nx_dom = v.shape[0]
+            _, m_pad = ps._resolve_bands(nx_dom, v.shape[1], v.dtype, bm)
+            if m_pad > nx_dom:
+                v = jnp.pad(v, ((0, m_pad - nx_dom), (0, 0)))
             full, rem = divmod(n, t)
             if full:
                 v = jax.lax.fori_loop(
                     0, full,
-                    lambda _, w: ps.band_multi_step(w, t, 0.1, 0.1, bm=bm),
+                    lambda _, w: ps.band_multi_step(
+                        w, t, 0.1, 0.1, bm=bm, domain_rows=nx_dom),
                     v, unroll=False)
             if rem:
-                v = ps.band_multi_step(v, rem, 0.1, 0.1, bm=bm)
-            return v
+                v = ps.band_multi_step(v, rem, 0.1, 0.1, bm=bm,
+                                       domain_rows=nx_dom)
+            return v[:nx_dom]
         fn = jax.jit(chunk, static_argnums=1)
     else:
         fn = jax.jit(

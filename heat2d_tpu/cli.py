@@ -249,7 +249,10 @@ def _run_ensemble_cli(args, cfg) -> int:
         ("--checkpoint", args.checkpoint is not None),
         ("--checkpoint-every", args.checkpoint_every is not None),
         ("--resume", args.resume is not None),
-        ("--profile", args.profile is not None)] if on]
+        ("--profile", args.profile is not None),
+        # The batched runners evaluate steps AND residuals in f32; a
+        # float64-accum request must not silently run as f32.
+        ("--accum-dtype float64", cfg.accum_dtype == "float64")] if on]
     if unsupported:
         print(f"ensemble runs do not support {', '.join(unsupported)} "
               f"(members are dumped as final_m<i>.dat only)\nQuitting...",

@@ -605,6 +605,17 @@ def window_band_viable(ny: int, bm: int, tsteps: int) -> bool:
 _WINDOW_EXT_ROWS = {16 * 1024: 176, 8 * 1024: 336}
 
 
+def _probed_ext_rows(row_bytes: int) -> int | None:
+    """Probed max ext rows for this row width, or None when the attached
+    device is not the probed 16 MB-VMEM kind, the budget is overridden,
+    or the width is unprobed — the ONE lookup the C2/D2 planners and the
+    explicit-bm fast-fail share (a site updating the table must not be
+    able to desynchronize them)."""
+    if VMEM_BUDGET_BYTES is None and _vmem_total()[0] == 16 * 1024 * 1024:
+        return _WINDOW_EXT_ROWS.get(row_bytes)
+    return None
+
+
 def plan_window_band(nrows: int, ny: int, tsteps: int,
                      dtype=jnp.float32) -> tuple[int, int]:
     """(bm, m_pad) for the C2 route: probed envelope for the widths
@@ -612,9 +623,7 @@ def plan_window_band(nrows: int, ny: int, tsteps: int,
     window cap (scaled to the VMEM budget), safely inside every probed
     break point."""
     row_bytes = ny * jnp.dtype(dtype).itemsize
-    ext = None
-    if VMEM_BUDGET_BYTES is None and _vmem_total()[0] == 16 * 1024 * 1024:
-        ext = _WINDOW_EXT_ROWS.get(row_bytes)
+    ext = _probed_ext_rows(row_bytes)
     if ext is None:
         cap_bytes = vmem_budget_bytes() * 5 // 16    # 2.5 MB at v5e
         ext = max(8 + 2 * tsteps, cap_bytes // row_bytes)
@@ -700,10 +709,7 @@ def _window_chunk(u, n, cx, cy, tsteps, bm, step):
     # dies in the opaque scoped-VMEM OOM the fast-fail exists to
     # prevent (the est-based check alone admits e.g. bm=328 at 8 KB
     # rows, 8 ext rows over the measured break).
-    row_bytes = ny * jnp.dtype(u.dtype).itemsize
-    ext_cap = None
-    if VMEM_BUDGET_BYTES is None and _vmem_total()[0] == 16 * 1024 * 1024:
-        ext_cap = _WINDOW_EXT_ROWS.get(row_bytes)
+    ext_cap = _probed_ext_rows(ny * jnp.dtype(u.dtype).itemsize)
     if ext_cap is not None and bm + 2 * tsteps > ext_cap:
         raise ConfigError(
             f"band window of {bm + 2 * tsteps} ext rows x {ny} cells is "
@@ -1008,6 +1014,144 @@ def _shard_band_chunk(u, strips, scalars, tsteps, cx, cy, nx, ny,
         input_output_aliases={4: 0},
         **_parallel_grid(1))(scalars, wwin, ewin, ups, u_in, dns)
     return out[:m] if m_pad > m else out
+
+
+# --------------------------------------------------------------------- #
+# Kernel D2: gather-free shard sweeps for mode='hybrid'
+# --------------------------------------------------------------------- #
+#
+# Kernel D's band route re-gathers the (nblk, T, n) row strips and the
+# per-band column windows every chunk — the same non-overlapped XLA copy
+# cost kernel C paid per sweep, plus bands capped at ~1 MB by its probed
+# envelope. D2 is kernel C2's dataflow applied to the shard chunk:
+#
+# - The shard carry rides EXTENDED as (bm + T, bn): rows [0, bm) the
+#   block, rows [bm, bm + T) the current sweep's SOUTH halo, updated in
+#   place per sweep (a T-row dynamic_update_slice, not a block concat).
+# - DOWN-strips ride in the same operand via a row-overlapping pl.Element
+#   window of (rb + T) rows at i*rb: for interior bands those rows are
+#   block i+1's still-old head (sequential grid ⇒ writes trail the read
+#   frontier, the C2 race argument); for the LAST band they are exactly
+#   the south-halo rows — real ppermute data, no overrun pad needed.
+# - UP-strips relay through persistent (T, bn) VMEM scratch; program 0 —
+#   whose up rows are the NORTH halo, not a previous band — selects the
+#   north strip, riding as a small separate operand, over the scratch.
+# - E/W column strips (only when the mesh has a y axis) come pre-windowed
+#   per band exactly as kernel D does (_strip_windows).
+#
+# The keep mask depends on the shard's mesh position (traced x0/y0), so
+# unlike C2 the interior fast path uses a TRACED pl.when predicate: a
+# band branches to the mask-free body when its extended rows provably
+# touch no global boundary, pad row, or (with cols) shard column halo.
+
+def plan_shard_window(m: int, bn: int, tsteps: int, dtype=jnp.float32,
+                      with_cols: bool = False) -> int | None:
+    """Band height rb for the D2 route, or None when the route is not
+    viable: off-TPU (pl.Element has no interpreter support — kernel D
+    covers CPU tests), misaligned shapes (lane rule bn % 128, sublane
+    rules rb % 8 / T % 8), or no 8-aligned divisor of ``m`` inside the
+    probed VMEM envelope (D2 keeps the in-place carry fixed-shape, so
+    bands must tile the block exactly — no pad machinery)."""
+    if not (_on_tpu() and _compiler_params_cls() is not None):
+        return None
+    if bn % 128 or tsteps % 8 or tsteps < 8 or m % 8:
+        return None
+    row_bytes = bn * jnp.dtype(dtype).itemsize
+    ext = _probed_ext_rows(row_bytes)
+    if ext is None:
+        ext = max(8 + 2 * tsteps,
+                  (vmem_budget_bytes() * 5 // 16) // row_bytes)
+    if with_cols:
+        # The two lane-padded (rb+2T, 128) strip windows double-buffer on
+        # top of the C2 working set — probed on the v5e: the 8 KB-row
+        # envelope holds at full 336 ext rows even with cols; one row of
+        # slack covers narrower widths.
+        ext -= 8
+    bm_max = min(ext - 2 * tsteps, m) // 8 * 8
+    for rb in range(bm_max, 2 * tsteps, -8):
+        if m % rb == 0:
+            return rb
+    return None
+
+
+def _shard_window_kernel(with_cols, s_ref, n_ref, *refs, rb, tsteps,
+                         nx, ny, cx, cy, step):
+    if with_cols:
+        w_ref, e_ref, u_ref, out_ref, tail = refs
+    else:
+        u_ref, out_ref, tail = refs
+    i = pl.program_id(0)
+    t = tsteps
+    x0, y0 = s_ref[0], s_ref[1]
+    bn = u_ref.shape[1]
+    up = jnp.where(i == 0, n_ref[:], tail[:])
+    tail[:] = u_ref[rb - t:rb, :]          # original tail, for band i+1
+    ext = jnp.concatenate([up, u_ref[:]], axis=0)     # (rb + 2t, bn)
+    row0 = x0 + i * rb - t
+    gi = row0 + lax.broadcasted_iota(jnp.int32, (rb + 2 * t, 1), 0)
+    keep = (gi <= 0) | (gi >= nx - 1)
+    needs = (row0 <= 0) | (row0 + rb + 2 * t > nx - 1)
+    if with_cols:
+        ext = jnp.concatenate([w_ref[0], ext, e_ref[0]], axis=1)
+        gj = (y0 - t
+              + lax.broadcasted_iota(jnp.int32, (1, bn + 2 * t), 1))
+        keep = keep | (gj <= 0) | (gj >= ny - 1)
+        needs = needs | (y0 <= t) | (y0 + bn + t > ny - 1)
+        center = (slice(t, -t), slice(t, -t))
+    else:
+        # Full-width bands: the step form itself keeps the first/last
+        # columns, which ARE the global y boundary (y0 == 0, bn == ny) —
+        # the row-only mask C2 uses.
+        center = (slice(t, -t), slice(None))
+
+    def masked(v):
+        return jnp.where(keep, v, step(v, cx, cy))
+
+    @pl.when(needs)
+    def _():
+        out_ref[:] = _unrolled_steps(t, masked, ext)[center]
+
+    @pl.when(jnp.logical_not(needs))
+    def _():
+        out_ref[:] = _unrolled_steps(
+            t, lambda v: step(v, cx, cy), ext)[center]
+
+
+def shard_window_sweep(ue, north, west, east, scalars, *, rb, tsteps,
+                       nx, ny, cx, cy, step=_step_value):
+    """One T-step sweep over the extended shard carry ``ue`` of
+    (bm + T, bn) — rows [0, bm) the block, [bm, bm+T) the south halo.
+    ``west``/``east``: None (no y axis) or (nblk, rb+2T, T) per-band
+    windows of the exchanged column strips. In-place via alias; the
+    south-halo rows pass through untouched (no out block covers them)."""
+    mt, bn = ue.shape
+    t = tsteps
+    nblk = (mt - t) // rb
+    with_cols = west is not None
+    mspace, smem = _mem_spaces()
+    params = _compiler_params_cls()       # non-None: plan gated the route
+    in_specs = [pl.BlockSpec((2,), lambda i: (0,), **smem),
+                pl.BlockSpec((t, bn), lambda i: (0, 0), **mspace)]
+    args = [scalars, north]
+    if with_cols:
+        spec = pl.BlockSpec((1, rb + 2 * t, t), lambda i: (i, 0, 0),
+                            **mspace)
+        in_specs += [spec, spec]
+        args += [west, east]
+    in_specs.append(pl.BlockSpec((pl.Element(rb + t), pl.Element(bn)),
+                                 lambda i: (i * rb, 0), **mspace))
+    args.append(ue)
+    return pl.pallas_call(
+        functools.partial(_shard_window_kernel, with_cols, rb=rb,
+                          tsteps=t, nx=nx, ny=ny, cx=cx, cy=cy, step=step),
+        out_shape=jax.ShapeDtypeStruct(ue.shape, ue.dtype),
+        grid=(nblk,),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((rb, bn), lambda i: (i, 0), **mspace),
+        scratch_shapes=[pltpu.VMEM((t, bn), ue.dtype)],
+        input_output_aliases={len(args) - 1: 0},
+        compiler_params=params(dimension_semantics=("arbitrary",)),
+    )(*args)
 
 
 def make_shard_chunk_kernel(config):

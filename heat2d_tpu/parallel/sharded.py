@@ -150,6 +150,72 @@ def make_local_multi(config, mesh: Mesh, chunk_kernel=None):
     return multi
 
 
+def make_window_multi(config, mesh: Mesh, chunk_kernel):
+    """Gather-free hybrid sweeps (Pallas kernel D2) over an EXTENDED
+    (bm + T, bn) shard carry whose trailing T rows hold the current
+    sweep's south halo — refreshed in place per sweep (a strip-sized
+    dynamic_update_slice) instead of re-assembling strip operands per
+    chunk, the same per-sweep copy elimination kernel C2 made for the
+    single-chip path. Returns None when the route is not viable (off-TPU,
+    parity mode, resident-size shards, misaligned shapes) — kernel D
+    keeps those; else ``(multi, step, extend, strip)`` closures for
+    make_sharded_runner, all operating on the extended carry and only
+    callable inside shard_map."""
+    from heat2d_tpu.ops import pallas_stencil as ps
+    if getattr(config, "bitwise_parity", False):
+        return None     # the FMA-form-only route (the C2 envelope gate)
+    ax, ay = mesh.axis_names
+    gx, gy = (mesh.devices.shape[0], mesh.devices.shape[1])
+    pnx, pny = padded_global_shape(config, mesh)
+    bm, bn = pnx // gx, pny // gy
+    t = effective_halo_depth(config, mesh)
+    if ps.fits_vmem((bm + 2 * t, bn + 2 * t)):
+        return None     # whole-block-resident kernel D is already fused
+    with_cols = gy > 1
+    rb = ps.plan_shard_window(bm, bn, t, with_cols=with_cols)
+    if rb is None:
+        return None
+    nblk = bm // rb
+    cx, cy = config.cx, config.cy
+    nx, ny = config.nxprob, config.nyprob
+    legacy_chunk = make_local_chunk(config, mesh, chunk_kernel=chunk_kernel)
+
+    def sweep(ue):
+        core = ue[:bm]
+        north, south, west, east = exchange_halo_strips(
+            core, ax, ay, gx, gy, t)
+        ue = lax.dynamic_update_slice(ue, south, (bm, 0))
+        if with_cols:
+            wwin = ps._strip_windows(west, nblk, rb, t)
+            ewin = ps._strip_windows(east, nblk, rb, t)
+        else:
+            wwin = ewin = None
+        scalars = jnp.stack(
+            [(lax.axis_index(ax) * bm).astype(jnp.int32),
+             (lax.axis_index(ay) * bn).astype(jnp.int32)])
+        return ps.shard_window_sweep(ue, north, wwin, ewin, scalars,
+                                     rb=rb, tsteps=t, nx=nx, ny=ny,
+                                     cx=cx, cy=cy)
+
+    def multi(ue, n):
+        full, rem = divmod(n, t)
+        if full:
+            ue = lax.fori_loop(0, full, lambda _, v: sweep(v), ue,
+                               unroll=False)
+        if rem:
+            # Once-per-run tail (and the convergence tracked step):
+            # through kernel D on the plain block, spliced back.
+            ue = lax.dynamic_update_slice(
+                ue, legacy_chunk(ue[:bm], rem), (0, 0))
+        return ue
+
+    def extend(u):
+        return jnp.concatenate(
+            [u, jnp.zeros((t, bn), u.dtype)], axis=0)
+
+    return multi, (lambda ue: multi(ue, 1)), extend, (lambda ue: ue[:bm])
+
+
 def make_sharded_runner(config, mesh: Mesh, chunk_kernel=None):
     """Returns (runner, sharding): ``runner(u_sharded) -> (u, steps_done)``,
     jit-compiled over the mesh. The full loop (and convergence psum over
@@ -159,9 +225,27 @@ def make_sharded_runner(config, mesh: Mesh, chunk_kernel=None):
     accum = jnp.dtype(config.accum_dtype)
     local_step = make_local_step(config, mesh, chunk_kernel=chunk_kernel)
     local_multi = make_local_multi(config, mesh, chunk_kernel=chunk_kernel)
+    window = (make_window_multi(config, mesh, chunk_kernel)
+              if chunk_kernel is not None else None)
     sharding = NamedSharding(mesh, P(ax, ay))
 
     def local_run(u):
+        if window is not None:
+            w_multi, w_step, extend, strip = window
+
+            def residual_w(u_new, u_old):
+                return lax.psum(
+                    residual_sq(strip(u_new), strip(u_old), accum),
+                    (ax, ay))
+            ue = extend(u)
+            if config.convergence:
+                ue, k = engine.run_convergence_chunked(
+                    w_multi, w_step, residual_w, ue, config.steps,
+                    config.interval, config.sensitivity)
+            else:
+                ue = w_multi(ue, config.steps)
+                k = jnp.asarray(config.steps, jnp.int32)
+            return strip(ue), k
         if config.convergence:
             def residual(u_new, u_old):
                 return lax.psum(residual_sq(u_new, u_old, accum),

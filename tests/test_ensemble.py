@@ -276,6 +276,17 @@ def test_cli_ensemble_validation(tmp_path, capsys):
     assert "equal-length" in capsys.readouterr().err
 
 
+def test_cli_ensemble_rejects_f64_accum(tmp_path, capsys):
+    """The batched runners evaluate steps and residuals in f32; a
+    float64-accum request must be refused, not silently run as f32."""
+    from heat2d_tpu.cli import main
+    rc = main(["--mode", "serial", "--accum-dtype", "float64",
+               "--ensemble-cx", "0.1,0.2", "--ensemble-cy", "0.1,0.1",
+               "--outdir", str(tmp_path)])
+    assert rc == 1
+    assert "--accum-dtype float64" in capsys.readouterr().err
+
+
 def test_cli_ensemble_rejects_spatial_grid(tmp_path, capsys):
     """--gridx/--gridy would be silently reinterpreted (members shard
     over a batch axis, never space) — must be refused, not ignored."""

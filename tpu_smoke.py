@@ -110,6 +110,54 @@ def main() -> int:
     check("kernel D band route, divisor-poor rows (hybrid 1000x2048)",
           run("hybrid", 1000, 2048, 30), want)
 
+    # Kernel D2 (gather-free shard sweeps — the production hybrid route
+    # on TPU; the solver-level hybrid checks above already ran through
+    # it) pinned BITWISE to kernel D's gather route at the KERNEL level,
+    # with nonzero halo strips and a mid-grid shard offset — the cases a
+    # 1x1 mesh can't produce. Both column variants: with_cols=True (a
+    # y-axis mesh) and the full-width row-only-mask path.
+    import jax.numpy as jnp
+    rng = np.random.default_rng(1612)
+    m, bn, t = 512, 1024, 8
+    nx = 4096
+    for with_cols, y0 in ((True, 1024), (False, 0)):
+        # The no-cols variant exists only for gy == 1, where the shard
+        # spans the full global width (bn == ny) and the step form's
+        # first/last-column keep IS the global y boundary.
+        ny = 4096 if with_cols else bn
+        u = jnp.asarray(rng.random((m, bn), dtype=np.float32))
+        north = jnp.asarray(rng.random((t, bn), dtype=np.float32))
+        south = jnp.asarray(rng.random((t, bn), dtype=np.float32))
+        west = jnp.asarray(rng.random((m + 2 * t, t), dtype=np.float32))
+        east = jnp.asarray(rng.random((m + 2 * t, t), dtype=np.float32))
+        if not with_cols:
+            west = jnp.zeros_like(west)
+            east = jnp.zeros_like(east)
+        x0 = 1024
+        scalars = jnp.asarray([x0, y0], jnp.int32)
+        want = jax.jit(lambda u: ps._shard_band_chunk(
+            u, (north, south, west, east), scalars, t, 0.1, 0.1, nx, ny,
+            step=ps._step_value))(u)
+        rb = ps.plan_shard_window(m, bn, t, with_cols=with_cols)
+        assert rb is not None, "D2 plan rejected an aligned config"
+        nblk = m // rb
+
+        def d2(u):
+            ue = jnp.concatenate([u, south], axis=0)
+            wwin = ps._strip_windows(west, nblk, rb, t) if with_cols \
+                else None
+            ewin = ps._strip_windows(east, nblk, rb, t) if with_cols \
+                else None
+            out = ps.shard_window_sweep(ue, north, wwin, ewin, scalars,
+                                        rb=rb, tsteps=t, nx=nx, ny=ny,
+                                        cx=0.1, cy=0.1)
+            return out[:m]
+
+        got = jax.jit(d2)(u)
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+        print(f"PASS kernel D2 bitwise vs kernel D (with_cols={with_cols},"
+              f" rb={rb})")
+
     # Batched ensemble kernels with B > 1: the (B, 1, 2) scalar-block
     # layout (a (1, 2) block over (B, 2) is illegal on real TPU and
     # invisible in interpreter mode).
