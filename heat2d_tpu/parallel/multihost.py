@@ -63,9 +63,21 @@ def gather_to_host(u):
     spanning non-addressable devices allgather first (tiled: shards
     concatenate back into the global array); host arrays and replicated
     outputs convert directly. The one gather idiom every output path
-    (solver.run, CLI text dumps, ensemble batches) shares."""
+    (solver.run, CLI text dumps, ensemble batches) shares.
+
+    HEAT2D_FORBID_GATHER=1 (test tripwire): raise instead of
+    allgathering a host-spanning array — the no-cross-host-gather tests
+    (e.g. the device-resident periodic-checkpoint loop) run whole CLI
+    flows under it to prove no code path falls back to a global gather.
+    """
     import numpy as np
     if not getattr(u, "is_fully_addressable", True):
+        import os
+        if os.environ.get("HEAT2D_FORBID_GATHER"):
+            raise RuntimeError(
+                "cross-host allgather reached under HEAT2D_FORBID_GATHER "
+                "(test tripwire): this flow was expected to stay "
+                "per-shard/device-resident")
         from jax.experimental import multihost_utils
         u = multihost_utils.process_allgather(u, tiled=True)
     return np.asarray(u)
