@@ -35,14 +35,26 @@ from heat2d_tpu.parallel.mesh import shard_map_compat
 DEFAULT_HALO_DEPTH = 8
 
 
-def padded_global_shape(config, mesh: Mesh) -> tuple[int, int]:
+def _mesh_axes(mesh: Mesh, axes=None) -> tuple[str, str, int, int]:
+    """(ax, ay, gx, gy) of the SPATIAL mesh axes. For the plain 2-axis
+    meshes of dist1d/dist2d/hybrid these are the mesh itself; a 3-axis
+    batchxspatial ensemble mesh ('b','x','y') passes its spatial axes
+    explicitly — every helper below shards space over exactly these two
+    axes and never sees the batch axis."""
+    if axes is not None:
+        return axes
+    ax, ay = mesh.axis_names
+    return ax, ay, mesh.devices.shape[0], mesh.devices.shape[1]
+
+
+def padded_global_shape(config, mesh: Mesh, axes=None) -> tuple[int, int]:
     """Global shape padded up so every shard is equal-sized — the TPU
     answer to the reference's uneven averow/extra strips
     (mpi_heat2Dn.c:89-94): instead of first-k-shards-get-one-extra-row,
     pad to the next multiple and let the out-of-domain rows sit inert
     (they are outside the keep-mask's interior, never update, stay 0, and
     contribute 0 to the convergence residual)."""
-    gx, gy = (mesh.devices.shape[0], mesh.devices.shape[1])
+    _, _, gx, gy = _mesh_axes(mesh, axes)
     pnx = -(-config.nxprob // gx) * gx
     pny = -(-config.nyprob // gy) * gy
     return pnx, pny
@@ -61,7 +73,8 @@ def _keep_mask(shape, nx, ny, row0, col0):
     return (gi <= 0) | (gi >= nx - 1) | (gj <= 0) | (gj >= ny - 1)
 
 
-def make_local_step(config, mesh: Mesh, chunk_kernel=None):
+def make_local_step(config, mesh: Mesh, chunk_kernel=None, axes=None,
+                    cxy=None):
     """Shard-local single step — the wide-halo chunk at depth 1 (bitwise
     identical per the depth-parametrized tests; used as the tracked step
     of the convergence residual pair).
@@ -69,11 +82,13 @@ def make_local_step(config, mesh: Mesh, chunk_kernel=None):
     ``chunk_kernel``: optional Pallas chunk implementation (see
     make_local_chunk) replacing the jnp golden loop.
     """
-    chunk = make_local_chunk(config, mesh, chunk_kernel=chunk_kernel)
+    chunk = make_local_chunk(config, mesh, chunk_kernel=chunk_kernel,
+                             axes=axes, cxy=cxy)
     return lambda u: chunk(u, 1)
 
 
-def make_local_chunk(config, mesh: Mesh, chunk_kernel=None):
+def make_local_chunk(config, mesh: Mesh, chunk_kernel=None, axes=None,
+                     cxy=None):
     """Shard-local multi-step: ONE wide halo exchange, then T steps in
     place on the (bm+2T, bn+2T) extended block.
 
@@ -93,14 +108,21 @@ def make_local_chunk(config, mesh: Mesh, chunk_kernel=None):
     (the round-2 path paid three full-block HBM round-trips per chunk).
     VMEM-routed so arbitrarily large shards stream in row bands instead
     of OOMing.
+
+    ``cxy``: optional (cx, cy) overriding the config's diffusivities —
+    may be TRACED values (the batchxspatial ensemble builds the chunk
+    inside a vmap with per-member scalars); chunk_kernel, which bakes
+    its constants, cannot be combined with it.
     """
-    ax, ay = mesh.axis_names
-    gx, gy = (mesh.devices.shape[0], mesh.devices.shape[1])
+    ax, ay, gx, gy = _mesh_axes(mesh, axes)
     nx, ny = config.nxprob, config.nyprob   # true domain (masks use these)
-    pnx, pny = padded_global_shape(config, mesh)
+    pnx, pny = padded_global_shape(config, mesh, axes)
     bm, bn = pnx // gx, pny // gy
     accum = jnp.dtype(config.accum_dtype)
-    cx, cy = config.cx, config.cy
+    cx, cy = cxy if cxy is not None else (config.cx, config.cy)
+    if cxy is not None and chunk_kernel is not None:
+        raise ValueError("per-member cxy requires the jnp chunk path "
+                         "(chunk kernels bake their diffusivities)")
 
     def chunk(u, t):
         x0 = lax.axis_index(ax) * bm
@@ -124,19 +146,21 @@ def make_local_chunk(config, mesh: Mesh, chunk_kernel=None):
     return chunk
 
 
-def effective_halo_depth(config, mesh: Mesh) -> int:
-    gx, gy = (mesh.devices.shape[0], mesh.devices.shape[1])
-    pnx, pny = padded_global_shape(config, mesh)
+def effective_halo_depth(config, mesh: Mesh, axes=None) -> int:
+    _, _, gx, gy = _mesh_axes(mesh, axes)
+    pnx, pny = padded_global_shape(config, mesh, axes)
     bm, bn = pnx // gx, pny // gy
     want = config.halo_depth or DEFAULT_HALO_DEPTH
     return max(1, min(want, bm, bn))
 
 
-def make_local_multi(config, mesh: Mesh, chunk_kernel=None):
+def make_local_multi(config, mesh: Mesh, chunk_kernel=None, axes=None,
+                     cxy=None):
     """``multi(u, n)`` advancing a *static* n steps via wide-halo chunks
     of depth T plus a remainder chunk."""
-    chunk = make_local_chunk(config, mesh, chunk_kernel=chunk_kernel)
-    t = effective_halo_depth(config, mesh)
+    chunk = make_local_chunk(config, mesh, chunk_kernel=chunk_kernel,
+                             axes=axes, cxy=cxy)
+    t = effective_halo_depth(config, mesh, axes)
 
     def multi(u, n):
         full, rem = divmod(n, t)

@@ -287,14 +287,102 @@ def test_cli_ensemble_rejects_f64_accum(tmp_path, capsys):
     assert "--accum-dtype float64" in capsys.readouterr().err
 
 
-def test_cli_ensemble_rejects_spatial_grid(tmp_path, capsys):
-    """--gridx/--gridy would be silently reinterpreted (members shard
-    over a batch axis, never space) — must be refused, not ignored."""
+def test_cli_ensemble_rejects_spatial_grid_non_dist2d(tmp_path, capsys):
+    """--gridx/--gridy with a non-dist2d mode would be silently
+    reinterpreted — must be refused, not ignored (the dist2d composition
+    is the supported batch x spatial path)."""
     from heat2d_tpu.cli import main
-    rc = main(["--mode", "dist2d", "--nxprob", "8", "--nyprob", "16",
+    rc = main(["--mode", "hybrid", "--nxprob", "8", "--nyprob", "16",
                "--gridx", "4", "--gridy", "2",
                "--ensemble-cx", "0.1,0.2", "--ensemble-cy", "0.1,0.1",
                "--outdir", str(tmp_path)])
     assert rc == 1
     err = capsys.readouterr().err
-    assert "batch axis" in err and "--gridx" in err
+    assert "dist2d" in err and "--gridx" in err
+
+
+# --------------------------------------------------------------------- #
+# Batch x spatial composition (VERDICT r3 #5)
+# --------------------------------------------------------------------- #
+
+def test_ensemble_spatial_bitwise_vs_dist2d_runs():
+    """2 members on a ('b'=2, x=2, y=1) mesh, each member BITWISE equal
+    to its own dist2d run on a (2, 1) mesh — the composition changes the
+    orchestration (vmapped halo ppermutes over the spatial sub-axes),
+    never the numbers."""
+    from heat2d_tpu.config import HeatConfig
+    from heat2d_tpu.models.ensemble import run_ensemble_spatial
+    from heat2d_tpu.models.solver import Heat2DSolver
+
+    cxs, cys = [0.05, 0.2], [0.1, 0.15]
+    batch, ks = run_ensemble_spatial(24, 16, 30, cxs, cys,
+                                     gridx=2, gridy=1)
+    assert batch.shape == (2, 24, 16)
+    for i, (cx, cy) in enumerate(zip(cxs, cys)):
+        cfg = HeatConfig(nxprob=24, nyprob=16, steps=30, mode="dist2d",
+                         gridx=2, gridy=1, cx=cx, cy=cy)
+        want = Heat2DSolver(cfg).run(timed=False).u
+        np.testing.assert_array_equal(np.asarray(batch[i]), want)
+        assert int(ks[i]) == 30
+
+
+def test_ensemble_spatial_2d_submesh_uneven_batch():
+    """3 members on a ('b'=2, 2, 2) mesh: batch pads to the 'b' axis
+    with an inert member, spatial shards are genuine 2D blocks."""
+    from heat2d_tpu.config import HeatConfig
+    from heat2d_tpu.models.ensemble import run_ensemble_spatial
+    from heat2d_tpu.models.solver import Heat2DSolver
+
+    cxs, cys = [0.05, 0.1, 0.2], [0.1, 0.05, 0.15]
+    batch, _ = run_ensemble_spatial(16, 12, 25, cxs, cys,
+                                    gridx=2, gridy=2)
+    assert batch.shape == (3, 16, 12)
+    for i, (cx, cy) in enumerate(zip(cxs, cys)):
+        cfg = HeatConfig(nxprob=16, nyprob=12, steps=25, mode="dist2d",
+                         gridx=2, gridy=2, cx=cx, cy=cy)
+        want = Heat2DSolver(cfg).run(timed=False).u
+        np.testing.assert_array_equal(np.asarray(batch[i]), want)
+
+
+def test_ensemble_spatial_convergence_matches_individual():
+    """Per-member early exit on the batch x spatial mesh: steps_done and
+    planes BITWISE match individual dist2d convergence runs (the psum'd
+    residual rides the spatial axes only, vmapped over members)."""
+    from heat2d_tpu.config import HeatConfig
+    from heat2d_tpu.models.ensemble import run_ensemble_spatial
+    from heat2d_tpu.models.solver import Heat2DSolver
+
+    cxs, cys = [0.02, 0.2], [0.02, 0.2]
+    steps, interval, sens = 400, 20, 5.0
+    batch, ks = run_ensemble_spatial(
+        12, 16, steps, cxs, cys, gridx=2, gridy=1,
+        convergence=True, interval=interval, sensitivity=sens)
+    ks = [int(k) for k in ks]
+    for i, (cx, cy) in enumerate(zip(cxs, cys)):
+        cfg = HeatConfig(nxprob=12, nyprob=16, steps=steps,
+                         mode="dist2d", gridx=2, gridy=1, cx=cx, cy=cy,
+                         convergence=True, interval=interval,
+                         sensitivity=sens)
+        r = Heat2DSolver(cfg).run(timed=False)
+        assert ks[i] == int(r.steps_done), f"member {i}"
+        np.testing.assert_array_equal(np.asarray(batch[i]), r.u)
+    assert len(set(ks)) > 1, ks
+
+
+def test_cli_ensemble_spatial_run(tmp_path, capsys):
+    """CLI composition: --mode dist2d --gridx/--gridy + ensemble flags
+    runs the batch x spatial mesh (previously rejected)."""
+    import json
+    from heat2d_tpu.cli import main
+
+    rec_path = tmp_path / "rec.json"
+    rc = main(["--mode", "dist2d", "--nxprob", "16", "--nyprob", "12",
+               "--steps", "20", "--gridx", "2", "--gridy", "2",
+               "--ensemble-cx", "0.1,0.2", "--ensemble-cy", "0.1,0.1",
+               "--outdir", str(tmp_path), "--run-record", str(rec_path)])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "2x2 spatial submesh" in out
+    rec = json.loads(rec_path.read_text())
+    assert rec["summary"]["members"] == 2
+    assert (tmp_path / "final_m1.dat").exists()
