@@ -72,15 +72,18 @@ def run_convergence(step_fn: Callable, residual_fn: Callable, u0,
     return u, k
 
 
-def run_convergence_chunked(multi_step_fn, step_fn, residual_fn, u0,
-                            steps: int, interval: int, sensitivity: float):
-    """Convergence loop for engines with an efficient *static* multi-step
-    primitive (e.g. the VMEM-resident Pallas kernel, where N steps run in
-    one kernel invocation): each full INTERVAL chunk is ``interval-1``
-    fused steps plus one tracked step for the residual pair. A trailing
-    ``steps % interval`` remainder runs unchecked (the intended reference
-    schedule checks only every INTERVAL steps). Returns (u, steps_done).
-    """
+def run_convergence_fused(chunk_resid_fn, multi_step_fn, u0,
+                          steps: int, interval: int, sensitivity: float):
+    """run_convergence_chunked for engines whose multi-step primitive can
+    emit the residual itself: ``chunk_resid_fn(u, n) -> (u, residual)``
+    advances n steps and returns Σ(Δu)² of the final plane pair — the
+    same pair the chunked loop forms from its ``interval-1`` fused steps
+    plus one tracked step, without the tracked step or the separate
+    full-grid reduction (ops.pallas_stencil.window_chunk_resid fuses
+    both into the last band sweep). Schedule and early-exit semantics
+    are identical to run_convergence_chunked; only the residual's
+    summation order differs (per-band partials), an f32-ulp deviation of
+    the same class as the FMA step form such engines already use."""
     if steps:
         interval = max(1, min(interval, steps))
     n_chunks = steps // interval if interval else 0
@@ -88,10 +91,8 @@ def run_convergence_chunked(multi_step_fn, step_fn, residual_fn, u0,
 
     def body(carry):
         u, c, _ = carry
-        u_prev = multi_step_fn(u, interval - 1)
-        u_new = step_fn(u_prev)
-        res = residual_fn(u_new, u_prev).astype(jnp.float32)
-        return (u_new, c + 1, res)
+        u, res = chunk_resid_fn(u, interval)
+        return (u, c + 1, res.astype(jnp.float32))
 
     def cond(carry):
         _, c, res = carry
@@ -107,3 +108,23 @@ def run_convergence_chunked(multi_step_fn, step_fn, residual_fn, u0,
                      lambda v: multi_step_fn(v, remainder), u)
         k = jnp.where(converged, k, k + remainder).astype(jnp.int32)
     return u, k
+
+
+def run_convergence_chunked(multi_step_fn, step_fn, residual_fn, u0,
+                            steps: int, interval: int, sensitivity: float):
+    """Convergence loop for engines with an efficient *static* multi-step
+    primitive (e.g. the VMEM-resident Pallas kernel, where N steps run in
+    one kernel invocation): each full INTERVAL chunk is ``interval-1``
+    fused steps plus one tracked step for the residual pair — expressed
+    as ``run_convergence_fused`` with that pair assembled here. A
+    trailing ``steps % interval`` remainder runs unchecked (the intended
+    reference schedule checks only every INTERVAL steps). Returns
+    (u, steps_done).
+    """
+    def chunk_resid(u, n):
+        u_prev = multi_step_fn(u, n - 1)
+        u_new = step_fn(u_prev)
+        return u_new, residual_fn(u_new, u_prev)
+
+    return run_convergence_fused(chunk_resid, multi_step_fn, u0,
+                                 steps, interval, sensitivity)

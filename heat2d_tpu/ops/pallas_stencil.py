@@ -546,7 +546,15 @@ def band_multi_step(u, tsteps: int, cx: float, cy: float,
         u = jnp.pad(u, ((0, m_pad - m), (0, 0)))
     # hi_start only when an interior (mask-free) band exists; otherwise
     # the uniform masked body avoids compiling a dead second branch.
-    hi_start = _mask_hi_start(nx, bm, tsteps)
+    # ALSO require a full unroll group: with a ROLLED remainder loop
+    # (tsteps % _STEP_UNROLL != 0) the two pl.when bodies each carry the
+    # loop's VMEM stack and Mosaic allocates BOTH — measured 17.3 MB
+    # scoped (over the 16 MB core) for bm=128/T=4 at 16 KB rows, where
+    # the same shape at T=8 (one inlined group, no rolled loop) fits.
+    # Remainder sweeps are a once-per-chunk tail; the fast path's win is
+    # irrelevant there anyway.
+    hi_start = (_mask_hi_start(nx, bm, tsteps)
+                if tsteps % _STEP_UNROLL == 0 else 0)
     out = _banded_pallas(
         functools.partial(_band_multi_kernel, bm=bm, tsteps=tsteps,
                           nx=nx, ny=ny, cx=cx, cy=cy, step=step,
@@ -635,8 +643,13 @@ def plan_window_band(nrows: int, ny: int, tsteps: int,
     # ceil(nrows/bm) * (bm + 2T) — a band height dividing the row count
     # more evenly skips recomputing pad rows (4096 rows: bm=152 pads 8
     # rows -> 223.1k Mcells/s vs bm=160 padding 64 -> 221.3k measured).
+    # The scan covers the WHOLE candidate range: narrow rows give a
+    # deep bm_max whose divisor-poor pad can be huge (1280 rows at 4 KB:
+    # bm_max=624 pads 592 rows -> 154k Mcells/s, while bm=320 pads zero
+    # -> 234k measured via the D2 divisor rule in round 4). Ties prefer
+    # the taller band (fewer programs).
     bm = bm_max
-    for b in range(bm_max, max(2 * tsteps, bm_max - 32) - 1, -8):
+    for b in range(bm_max, 2 * tsteps + 8, -8):
         if b <= 2 * tsteps:
             break
         if (-(-nrows // b)) * (b + 2 * tsteps) \
@@ -645,8 +658,24 @@ def plan_window_band(nrows: int, ny: int, tsteps: int,
     return bm, -(-nrows // bm) * bm
 
 
-def _band_window_kernel(u_ref, out_ref, tail, *, bm, tsteps, nx, cx, cy,
-                        step, hi_start):
+def _window_steps(n, one, v):
+    """``n`` steps for the WINDOW-kernel family: inlined when n is under
+    a full unroll group — a rolled short loop loses the cross-step
+    unroll win (measured as the whole sweep slowing ~30%), and for
+    n <= _STEP_UNROLL the inline stack cannot exceed the 8-step group
+    body the C2 compile envelope was probed with. The non-window band
+    kernels keep _unrolled_steps' always-rolled remainder: their widest
+    user (the 8192-wide shard kernel) OOM'd Mosaic's stack on a 2-step
+    inline."""
+    if n < _STEP_UNROLL:
+        for _ in range(n):
+            v = one(v)
+        return v
+    return _unrolled_steps(n, one, v)
+
+
+def _band_window_kernel(u_ref, out_ref, tail, *, bm, tsteps, nsub,
+                        nx, cx, cy, step, hi_start):
     i = pl.program_id(0)
     t = tsteps
     up = tail[:]                   # prev band's original tail (garbage @ i=0)
@@ -659,31 +688,45 @@ def _band_window_kernel(u_ref, out_ref, tail, *, bm, tsteps, nx, cx, cy,
         return jnp.where(keep, v, step(v, cx, cy))
 
     if hi_start is None:
-        out_ref[:] = _unrolled_steps(tsteps, masked, ext)[t:-t]
+        out_ref[:] = _window_steps(nsub, masked, ext)[t:-t]
         return
     needs_mask = (i == 0) | (i >= hi_start)
 
     @pl.when(needs_mask)
     def _():
-        out_ref[:] = _unrolled_steps(tsteps, masked, ext)[t:-t]
+        out_ref[:] = _window_steps(nsub, masked, ext)[t:-t]
 
     @pl.when(jnp.logical_not(needs_mask))
     def _():
-        out_ref[:] = _unrolled_steps(
-            tsteps, lambda v: step(v, cx, cy), ext)[t:-t]
+        out_ref[:] = _window_steps(
+            nsub, lambda v: step(v, cx, cy), ext)[t:-t]
 
 
-def _band_window_sweep(u, tsteps, cx, cy, bm, nx, step):
-    """One T-step sweep over ``u`` of shape (m_pad + T, ny); the last T
-    rows are inert overrun pad for the last band's element window."""
+def _band_window_sweep(u, tsteps, cx, cy, bm, nx, step, nsub=None):
+    """One sweep over ``u`` of shape (m_pad + T, ny); the last T rows
+    are inert overrun pad for the last band's element window. ``nsub``:
+    steps to advance this sweep (<= tsteps; default tsteps) — the
+    window/relay geometry stays T-deep, only fewer steps run, so the
+    kept centers (T rows in, stale depth nsub <= T) remain exact. This
+    is how ``n % T`` remainders stay on the window route instead of
+    dropping to a legacy gathered sweep (which cost ~2x per step —
+    rolled loop + re-gather — and showed up directly in the fused
+    convergence overhead)."""
     mt, ny = u.shape
     t = tsteps
     nblk = (mt - t) // bm
-    hi_start = _mask_hi_start(nx, bm, t)
+    # Partial sweeps (nsub < T) run the uniform masked body: their steps
+    # INLINE (_window_steps), and two pl.when bodies of inlined steps
+    # would double the Mosaic VMEM stack past the envelope probed with
+    # one 8-step body — the same dual-body OOM band_multi_step gates.
+    # They are once-per-chunk tails; the fast path is irrelevant there.
+    hi_start = (_mask_hi_start(nx, bm, t)
+                if nsub is None or nsub == tsteps else 0)
     mspace, _ = _mem_spaces()
     params = _compiler_params_cls()   # non-None: window_band_viable gated
     return pl.pallas_call(
-        functools.partial(_band_window_kernel, bm=bm, tsteps=t, nx=nx,
+        functools.partial(_band_window_kernel, bm=bm, tsteps=t,
+                          nsub=tsteps if nsub is None else nsub, nx=nx,
                           cx=cx, cy=cy, step=step,
                           hi_start=hi_start if hi_start > 1 else None),
         out_shape=jax.ShapeDtypeStruct(u.shape, u.dtype),
@@ -699,10 +742,104 @@ def _band_window_sweep(u, tsteps, cx, cy, bm, nx, step):
     )(u)
 
 
+def _band_window_resid_kernel(u_ref, out_ref, r_ref, tail, *, bm, tsteps,
+                              nx, cx, cy, step):
+    """C2 window sweep that ALSO emits each band's partial residual
+    Σ(Δu)² of the sweep's LAST step pair (rows of the band's kept
+    center; boundary/pad rows are keep-masked so their delta is 0).
+    One uniform masked body — the dual-body fast path doubles Mosaic's
+    VMEM stack (the round-4 remainder-sweep OOM) and this kernel runs
+    once per INTERVAL, where the select cost is irrelevant."""
+    i = pl.program_id(0)
+    t = tsteps
+    up = tail[:]
+    tail[:] = u_ref[bm - t:bm, :]
+    ext = jnp.concatenate([up, u_ref[:]], axis=0)
+    gi = (i * bm - t + lax.broadcasted_iota(jnp.int32, (bm + 2 * t, 1), 0))
+    keep = (gi <= 0) | (gi >= nx - 1)
+
+    def masked(v):
+        return jnp.where(keep, v, step(v, cx, cy))
+
+    # All t steps INLINED as one group (t == _STEP_UNROLL by the route's
+    # gate): `_unrolled_steps(t-1)` would take its rolled-loop path —
+    # measured as the whole sweep losing the cross-step unroll win and
+    # conv overhead REGRESSING at 2560x2048 (18.5% -> 35.1%). Inlining
+    # matches kernel C2's own group body; only `prev` adds a live array.
+    v = ext
+    for _ in range(tsteps - 1):
+        v = masked(v)
+    prev = v
+    last = masked(v)
+    out_ref[:] = last[t:-t]
+    d = last[t:-t] - prev[t:-t]
+    # Shaped (1, 1, 1) store: Mosaic has no scalar stores to VMEM.
+    r_ref[...] = jnp.sum(d * d).reshape(1, 1, 1)
+
+
+def _window_resid_sweep(u, tsteps, cx, cy, bm, nx, step):
+    """One T-step C2R sweep over the (m_pad + T, ny) padded layout:
+    returns (u_new, residual) with the residual summed from the per-band
+    partials (summation order differs from residual_sq's full-array sum
+    at f32-ulp level — same deviation class as the FMA step form this
+    route is gated to)."""
+    mt, ny = u.shape
+    t = tsteps
+    nblk = (mt - t) // bm
+    mspace, _ = _mem_spaces()
+    params = _compiler_params_cls()
+    out, parts = pl.pallas_call(
+        functools.partial(_band_window_resid_kernel, bm=bm, tsteps=t,
+                          nx=nx, cx=cx, cy=cy, step=step),
+        # Partials ride as (nblk, 1, 1) with (1, 1, 1) blocks — the last
+        # two block dims must equal the array's (a (1, 1) block over
+        # (nblk, 1) breaks the Mosaic block rule for nblk > 1, the same
+        # real-TPU-only failure the ensemble scalar blocks hit).
+        out_shape=[jax.ShapeDtypeStruct(u.shape, u.dtype),
+                   jax.ShapeDtypeStruct((nblk, 1, 1), jnp.float32)],
+        grid=(nblk,),
+        in_specs=[
+            pl.BlockSpec((pl.Element(bm + t), pl.Element(ny)),
+                         lambda i: (i * bm, 0), **mspace),
+        ],
+        out_specs=[pl.BlockSpec((bm, ny), lambda i: (i, 0), **mspace),
+                   pl.BlockSpec((1, 1, 1), lambda i: (i, 0, 0), **mspace)],
+        scratch_shapes=[pltpu.VMEM((t, ny), u.dtype)],
+        input_output_aliases={0: 0},
+        compiler_params=params(dimension_semantics=("arbitrary",)),
+    )(u)
+    return out, jnp.sum(parts)
+
+
+def window_chunk_resid(u, n, cx, cy, tsteps, bm, step=_step_value):
+    """Advance ``n >= tsteps`` steps and return (u_new, residual) where
+    the residual is Σ(Δu)² between the final two planes — the
+    convergence chunk with the tracked step and the residual pass FUSED
+    into the last window sweep (they were a full-grid kernel-B step plus
+    a full-grid reduction: ~78% overhead measured at 4096² on the
+    unfused path, benchmarks/results/sweep_conv.md round 4)."""
+    nx, ny = u.shape
+    lead = n - tsteps
+    m_pad = -(-nx // bm) * bm
+    u = jnp.pad(u, ((0, m_pad - nx + tsteps), (0, 0)))   # pad ONCE
+    nsweeps, rem = divmod(lead, tsteps)
+    if nsweeps:
+        u = lax.fori_loop(
+            0, nsweeps,
+            lambda _, v: _band_window_sweep(v, tsteps, cx, cy, bm, nx,
+                                            step),
+            u, unroll=False)
+    if rem:
+        u = _band_window_sweep(u, tsteps, cx, cy, bm, nx, step,
+                               nsub=rem)
+    out, r = _window_resid_sweep(u, tsteps, cx, cy, bm, nx, step)
+    return out[:nx], r
+
+
 def _window_chunk(u, n, cx, cy, tsteps, bm, step):
     """``n`` steps via gather-free window sweeps (kernel C2); the
-    ``n % T`` remainder runs through the legacy kernel C machinery (a
-    once-per-chunk tail where the sweep cost is irrelevant)."""
+    ``n % T`` remainder runs as a partial-depth window sweep (nsub) —
+    same kernel, same layout, inlined short step loop."""
     nx, ny = u.shape
     _check_band_vmem(bm, tsteps, ny, u.dtype)
     # The probed envelope binds explicit bm too: past it the compile
@@ -719,18 +856,17 @@ def _window_chunk(u, n, cx, cy, tsteps, bm, step):
             f"choose")
     m_pad = -(-nx // bm) * bm
     nsweeps, rem = divmod(n, tsteps)
-    out = u
+    out = jnp.pad(u, ((0, m_pad - nx + tsteps), (0, 0)))
     if nsweeps:
-        out = jnp.pad(out, ((0, m_pad - nx + tsteps), (0, 0)))
         out = lax.fori_loop(
             0, nsweeps,
             lambda _, v: _band_window_sweep(v, tsteps, cx, cy, bm, nx,
                                             step),
             out, unroll=False)
-        out = out[:nx]
     if rem:
-        out = band_multi_step(out, rem, cx, cy, step=step)
-    return out
+        out = _band_window_sweep(out, tsteps, cx, cy, bm, nx, step,
+                                 nsub=rem)
+    return out[:nx]
 
 
 def band_chunk(u, n: int, cx: float, cy: float,
@@ -809,9 +945,29 @@ def make_single_chip_runner(config):
         def chunk(u, n):  # temporally-blocked sweeps (~T x less HBM traffic)
             return band_chunk(u, n, cx, cy, step=form)
 
+    # Fused-residual convergence (C2R): on the streaming C2 route with
+    # INTERVAL >= T, the chunk's tracked step + residual reduction fold
+    # into the last window sweep — the unfused pair cost ~78% over
+    # fixed-step at 4096² (sweep_conv.md round 4). Parity runs (literal
+    # form) and resident grids keep the chunked loop.
+    chunk_resid = None
+    if (config.convergence and not resident and form is _step_value
+            and config.interval >= DEFAULT_TSTEPS
+            and config.steps >= DEFAULT_TSTEPS       # clamp keeps >= T
+            and _on_tpu() and ny % 128 == 0):
+        bm_w, _ = plan_window_band(nx, ny, DEFAULT_TSTEPS)
+        if window_band_viable(ny, bm_w, DEFAULT_TSTEPS):
+            def chunk_resid(u, n):
+                return window_chunk_resid(u, n, cx, cy, DEFAULT_TSTEPS,
+                                          bm_w, step=form)
+
     def run(u):
         residual = lambda a, b: residual_sq(a, b)  # noqa: E731
         if config.convergence:
+            if chunk_resid is not None:
+                return engine.run_convergence_fused(
+                    chunk_resid, chunk, u,
+                    config.steps, config.interval, config.sensitivity)
             return engine.run_convergence_chunked(
                 chunk, step, residual, u,
                 config.steps, config.interval, config.sensitivity)

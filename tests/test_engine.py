@@ -70,3 +70,34 @@ def test_convergence_physics_actually_converges():
     _, k = run(u0)
     assert int(k) < 100000
     assert int(k) % 20 == 0
+
+
+def test_convergence_fused_matches_chunked():
+    """run_convergence_fused with a chunk_resid built from the SAME step
+    form must reproduce run_convergence_chunked's schedule, planes, and
+    steps_done exactly — early exit, full budget, and remainder cases."""
+    def multi(u, n):
+        for _ in range(n):
+            u = _step(u)
+        return u
+
+    def chunk_resid(u, n):
+        u_prev = multi(u, n - 1)
+        u_new = _step(u_prev)
+        return u_new, _residual(u_new, u_prev)
+
+    u0 = inidat(12, 16)
+    for steps, interval, sens in [(100, 20, 5.0),     # early exit
+                                  (50, 20, 0.0),      # full budget + rem
+                                  (40, 20, 1e30)]:    # first-chunk exit
+        want_u, want_k = jax.jit(
+            lambda u, s=steps, i=interval, e=sens:
+            engine.run_convergence_chunked(multi, _step, _residual,
+                                           u, s, i, e))(u0)
+        got_u, got_k = jax.jit(
+            lambda u, s=steps, i=interval, e=sens:
+            engine.run_convergence_fused(chunk_resid, multi,
+                                         u, s, i, e))(u0)
+        assert int(got_k) == int(want_k), (steps, interval, sens)
+        np.testing.assert_array_equal(np.asarray(got_u),
+                                      np.asarray(want_u))

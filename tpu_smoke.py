@@ -83,6 +83,15 @@ def main() -> int:
         np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
         print(f"PASS kernel C2 bitwise vs kernel C ({shape[0]}x{shape[1]})")
 
+    # 16 KB rows + a remainder sweep (steps % 8 != 0): the legacy-C
+    # remainder runs a ROLLED in-kernel loop, where the dual-body
+    # interior fast path blew Mosaic's scoped-VMEM stack at this row
+    # width (17.3 MB for bm=128/T=4 — the round-4 conv-sweep crash);
+    # band_multi_step must gate the fast path off for partial groups.
+    want = run("serial", 512, 4096, 20)
+    check("kernel C remainder sweep (512x4096, 20 steps)",
+          run("pallas", 512, 4096, 20), want)
+
     # Kernel B (single-step band) via the convergence path on an
     # HBM-sized grid: run_convergence_chunked's tracked step is a
     # band_step call, exercising the interior-fast-path pl.when branch
@@ -96,6 +105,19 @@ def main() -> int:
 
     check("kernel B (band single-step, convergence 2048^2)",
           run_conv("pallas"), run_conv("serial"))
+
+    # C2R fused-residual convergence (the production streaming conv
+    # route: interval >= T, so run_conv above already exercised the
+    # fused kernel's state path). Early-exit: a huge sensitivity must
+    # stop both modes at the first INTERVAL with the same steps_done.
+    def first_exit(mode):
+        cfg = HeatConfig(nxprob=2048, nyprob=2048, steps=48, mode=mode,
+                         convergence=True, interval=12,
+                         sensitivity=1e30)
+        return int(Heat2DSolver(cfg).run(timed=False).steps_done)
+
+    assert first_exit("pallas") == first_exit("serial") == 12
+    print("PASS C2R fused-residual early exit (steps_done parity)")
 
     # Kernel D (hybrid shard kernels) on a 1x1 mesh: VMEM route at a
     # small shard, band route at the round-1 OOM config, and a
@@ -168,6 +190,18 @@ def main() -> int:
     want = run_ensemble(1024, 2048, 16, cxs, cys, method="jnp")
     check("ensemble band kernel (B=2, HBM members)",
           run_ensemble(1024, 2048, 16, cxs, cys, method="band"), want)
+
+    # Batch x spatial ensemble on the single chip (a (1,1,1) mesh): the
+    # vmapped shard_map program with traced per-member (cx, cy) must
+    # compile and run on real XLA:TPU (the CPU suite covers multi-device
+    # meshes; this pins the TPU lowering of the vmapped halo ppermutes).
+    from heat2d_tpu.models.ensemble import run_ensemble_spatial
+    got, ks = run_ensemble_spatial(128, 256, 25, cxs, cys,
+                                   gridx=1, gridy=1)
+    check("ensemble batch x spatial ((1,1,1) mesh)", got[0],
+          run_ensemble(128, 256, 25, cxs, cys, method="jnp")[0])
+    assert [int(k) for k in ks] == [25, 25]
+    print("PASS ensemble batch x spatial ((1,1,1) mesh) steps")
 
     print("ALL TPU SMOKE PATHS PASS")
     return 0
