@@ -127,7 +127,7 @@ def two_point_estimate(timed_run, lo, hi0, max_hi,
 
 
 def run_point(mode, nx, ny, steps, gridx=1, gridy=1, convergence=False,
-              max_hi=MAX_HI_STEPS, min_hi=None):
+              max_hi=MAX_HI_STEPS, min_hi=None, sensitivity=None):
     from heat2d_tpu.config import HeatConfig
     from heat2d_tpu.models.solver import Heat2DSolver
 
@@ -138,15 +138,24 @@ def run_point(mode, nx, ny, steps, gridx=1, gridy=1, convergence=False,
         # untimed priming run (the solver cache keeps the compiled runner).
         fresh = n not in solvers
         if fresh:
+            kw = {} if sensitivity is None else dict(
+                sensitivity=sensitivity)
             cfg = HeatConfig(nxprob=nx, nyprob=ny, steps=n, mode=mode,
                              gridx=gridx, gridy=gridy,
-                             convergence=convergence)
+                             convergence=convergence, **kw)
             solvers[n] = Heat2DSolver(cfg)
         return solvers[n].run(timed=True, warmup=fresh)
 
     rec = {"mode": mode, "grid": f"{nx}x{ny}", "mesh": f"{gridx}x{gridy}"}
+    # sensitivity=0: the residual (a sum of squares, >= 0) can never go
+    # BELOW zero, so the check runs on schedule and never fires —
+    # steps_done == steps, data-independent, and the two-point marginal
+    # is valid. This is THE measurement of the residual-check overhead
+    # (the reference's Tables 4-6 exist to quantify it; its end-to-end
+    # rows here cannot separate it from the fence).
+    marginal_conv = convergence and sensitivity == 0.0
     step_time = None
-    if convergence:
+    if convergence and not marginal_conv:
         # steps_done is data-dependent — end-to-end is the honest figure
         # (and what the reference's Tables 4-6 clock).
         result = timed_run(steps)
@@ -170,6 +179,8 @@ def run_point(mode, nx, ny, steps, gridx=1, gridy=1, convergence=False,
                        elapsed_s=round(result.elapsed, 6),
                        mcells_per_s=round(result.mcells_per_s, 2),
                        method="end-to-end (two-point within noise)")
+        if marginal_conv:
+            rec.update(convergence=True, sensitivity=0.0)
 
     ref_serial = REF_CONV_SERIAL_S if convergence else REF_SERIAL_S
     ref_best = REF_CONV_BEST_S if convergence else REF_BEST_S
@@ -178,11 +189,12 @@ def run_point(mode, nx, ny, steps, gridx=1, gridy=1, convergence=False,
         # Reference tables are 100-iteration wall-clocks (no tunnel
         # fence); the like-for-like figure is marginal step time x 100.
         # Convergence rows compare end-to-end wall-clocks (both sides run
-        # the same capped-iteration convergence workload). Noise-fallback
-        # fixed-step rows get NO ref columns: comparing our fence floor
-        # to the reference's real compute would be the exact distortion
-        # this protocol exists to avoid.
-        if convergence:
+        # the same capped-iteration convergence workload; marginal
+        # sensitivity-0 rows use step time x 100 like fixed-step rows).
+        # Noise-fallback fixed-step rows get NO ref columns: comparing
+        # our fence floor to the reference's real compute would be the
+        # exact distortion this protocol exists to avoid.
+        if convergence and not marginal_conv:
             ours_100 = rec["elapsed_s"]
         else:
             ours_100 = step_time * 100 if step_time is not None else None
@@ -294,12 +306,46 @@ def sanity_pass(records, points, max_hi):
 def suite_conv(steps, quick):
     """Convergence-enabled sweep — the Tables 4-6 analogue, on the
     *intended* every-INTERVAL schedule (the reference's actual build
-    checked every iteration at its measured grids; BASELINE.md caveat)."""
+    checked every iteration at its measured grids; BASELINE.md caveat).
+
+    Two row families:
+    - end-to-end rows at the reference's grids/steps (the literal
+      Tables 4-6 workload — early exit allowed, fence included);
+    - MARGINAL overhead pairs at the large grids: a fixed-step two-point
+      row and a convergence sensitivity=0 two-point row (check always
+      runs, never fires — data-independent, so the marginal is valid).
+      The overhead post-pass (add_conv_overhead) turns each pair into a
+      % cost of the residual schedule — the number the end-to-end rows
+      cannot resolve under the ~0.15 s fence (VERDICT r3 weak #3).
+    """
     sizes = REF_SIZES[:2] if quick else REF_SIZES
     for nx, ny in sizes:
         for mode in ("serial", "pallas"):
             yield dict(mode=mode, nx=nx, ny=ny, steps=steps,
                        convergence=True)
+    big = [s for s in sizes if s[0] * s[1] >= 1280 * 1024]
+    if not quick:
+        big.append(NORTH_STAR)
+    for nx, ny in big:
+        for mode in ("serial", "pallas"):
+            yield dict(mode=mode, nx=nx, ny=ny, steps=steps)
+            yield dict(mode=mode, nx=nx, ny=ny, steps=steps,
+                       convergence=True, sensitivity=0.0)
+
+
+def add_conv_overhead(records):
+    """Post-pass for --suite conv: % cost of the residual-check schedule
+    from each (fixed-step, sensitivity=0 convergence) two-point pair —
+    the reference's Tables 4 vs 1 comparison, fence-free."""
+    fixed = {(r["mode"], r["grid"], r["mesh"]): r.get("step_time_s")
+             for r in records if not r.get("convergence")}
+    for r in records:
+        if r.get("sensitivity") == 0.0 and r.get("step_time_s"):
+            base = fixed.get((r["mode"], r["grid"], r["mesh"]))
+            if base:
+                r["conv_overhead_pct"] = round(
+                    (r["step_time_s"] / base - 1) * 100, 1)
+    return records
 
 
 def suite_scaling(steps, quick, n_devices):
@@ -387,7 +433,10 @@ def redesign_payoff(records):
 
 def to_markdown(records, platform, is_cpu_host):
     scaling = any("speedup_vs_1dev" in r for r in records)
+    conv_oh = any("conv_overhead_pct" in r for r in records)
     extra_hdr = " speedup vs 1 dev | efficiency |" if scaling else ""
+    if conv_oh:
+        extra_hdr += " conv overhead % |"
     lines = [f"# heat2d-tpu sweep ({platform})", ""]
     if is_cpu_host:
         lines += [
@@ -415,12 +464,17 @@ def to_markdown(records, platform, is_cpu_host):
         "elapsed (s) | method | ref serial 100-step (s) | speedup vs ref "
         f"serial | vs ref best (160 tasks) | vs ref CUDA |{extra_hdr}",
         "|---|---|---|---|---|---|---|---|---|---|---|---|"
-        + ("---|---|" if scaling else ""),
+        + ("---|---|" if scaling else "") + ("---|" if conv_oh else ""),
     ]
     for r in records:
         st = r.get("step_time_s")
+        mode_cell = r["mode"]
+        if r.get("sensitivity") == 0.0:
+            mode_cell += " +conv(sens=0)"
+        elif r.get("convergence"):
+            mode_cell += " +conv"
         row = (
-            f"| {r['mode']} | {r['grid']} | {r['mesh']} | {r['steps']} "
+            f"| {mode_cell} | {r['grid']} | {r['mesh']} | {r['steps']} "
             f"| {f'{st:.3g}' if st else '—'} "
             f"| {r['mcells_per_s']:.4g} "
             f"| {r['elapsed_s']:.4g} "
@@ -432,6 +486,8 @@ def to_markdown(records, platform, is_cpu_host):
         if scaling:
             row += (f" {r.get('speedup_vs_1dev', '—')} "
                     f"| {r.get('efficiency', '—')} |")
+        if conv_oh:
+            row += f" {r.get('conv_overhead_pct', '—')} |"
         lines.append(row)
 
     payoff = redesign_payoff(records)
@@ -508,6 +564,8 @@ def main(argv=None) -> int:
     records = sanity_pass(records, points, max_hi)
     if args.suite == "scaling":
         add_scaling_columns(records)
+    elif args.suite == "conv":
+        add_conv_overhead(records)
 
     os.makedirs(args.outdir, exist_ok=True)
     tag = f"{args.suite}{'_quick' if args.quick else ''}"
