@@ -147,11 +147,23 @@ class Heat2DSolver:
         def step(u):
             return stencil_step(u, cfg.cx, cfg.cy, accum)
 
+        def multi(u, n):
+            from jax import lax
+            return lax.fori_loop(0, n, lambda _, v: step(v), u,
+                                 unroll=False)
+
         def run(u):
             if cfg.convergence:
-                return engine.run_convergence(
-                    step, lambda a, b: residual_sq(a, b, accum), u,
-                    cfg.steps, cfg.interval, cfg.sensitivity)
+                # Chunked loop (same plane sequence and steps_done as
+                # run_convergence — the tests pin dist modes, which use
+                # it, bitwise to serial): carrying the residual pair
+                # only at each INTERVAL boundary instead of every step
+                # measured ~2x faster at 2560x2048+ (the per-step
+                # (prev, cur) carry doubled the serial conv cost,
+                # sweep_conv.md round 4).
+                return engine.run_convergence_chunked(
+                    multi, step, lambda a, b: residual_sq(a, b, accum),
+                    u, cfg.steps, cfg.interval, cfg.sensitivity)
             return engine.run_fixed(step, u, cfg.steps)
 
         self._runner = jax.jit(run)

@@ -603,14 +603,25 @@ def window_band_viable(ny: int, bm: int, tsteps: int) -> bool:
             and tsteps % 8 == 0 and bm > 2 * tsteps)
 
 
-#: Measured C2 compile envelope on the 16 MB-VMEM v5e (round-4 probe):
+#: Measured C2 compile envelope on the 16 MB-VMEM v5e (round-4 probes):
 #: max viable ext rows (bm + 2T) per row width — the next 8-row step up
-#: OOMs the compiler's scoped VMEM (168 @ 16 KB rows, 336 @ 8 KB). The
-#: envelope does NOT follow a single bytes cap across widths (2.88 MB
-#: windows compile at 16 KB rows but fail at 8 KB), hence a probed
-#:  table, not a formula. bm at these points is also the measured perf
-#: optimum: 160 -> 223k Mcells/s at 4096^2, 320 -> 237k at 2560x2048.
-_WINDOW_EXT_ROWS = {16 * 1024: 176, 8 * 1024: 336}
+#: OOMs the compiler's scoped VMEM (168 @ 16 KB rows, 336 @ 8 KB,
+#: 64 @ 32 KB — bm=56's 72 ext rows need 16.76 MB scoped). The envelope
+#: does NOT follow a single bytes cap across widths (2.88 MB windows
+#: compile at 16 KB rows but fail at 8 KB; 2 MB fails at 32 KB), hence
+#: a probed table, not a formula. bm at these points is also the
+#: measured perf optimum: 152 -> 223k Mcells/s at 4096^2, 320 -> 237k
+#: at 2560x2048, 48 -> 204k at 8192^2.
+_WINDOW_EXT_ROWS = {32 * 1024: 64, 16 * 1024: 176, 8 * 1024: 336}
+
+#: Ext-row cap for row widths the table doesn't cover: 640 rows is the
+#: largest window VERIFIED to compile off-table (bm=624 at 4 KB rows —
+#: the round-4 chip sweep ran 1280x1024 through it before the pad-aware
+#: scan widened); combined with the byte caps below it keeps every
+#: unprobed width at or under a verified point instead of extrapolating
+#: (the 2.5 MB byte cap alone admitted 80 ext rows at 32 KB — 16.76 MB
+#: scoped, compile OOM).
+_WINDOW_EXT_ROWS_UNPROBED_CAP = 640
 
 
 def _probed_ext_rows(row_bytes: int) -> int | None:
@@ -624,17 +635,40 @@ def _probed_ext_rows(row_bytes: int) -> int | None:
     return None
 
 
+def _window_ext_rows(row_bytes: int, tsteps: int) -> int:
+    """Max ext rows for a window sweep at this row width: the probed
+    table when it applies, else a conservative byte cap (2.5 MB at the
+    v5e budget) bounded by the largest VERIFIED off-table window
+    (_WINDOW_EXT_ROWS_UNPROBED_CAP rows) and, for rows wider than any
+    probed point, by the widest probed point's byte allowance — the
+    envelope SHRINKS with width (2.63 MB ok at 8 KB rows, 2 MB is the
+    break at 32 KB), so extrapolating the byte cap upward OOMs (the
+    8192^2 compile failure this helper fixes)."""
+    ext = _probed_ext_rows(row_bytes)
+    if ext is not None:
+        return ext
+    cap_bytes = vmem_budget_bytes() * 5 // 16
+    if row_bytes > 16 * 1024:
+        # At or beyond the widest probed points the break sits at
+        # ~2-2.25 MB (64 ext rows x 32 KB), below the 2.5 MB narrow-row
+        # cap — hold anything wider than the last generous probe point
+        # (16 KB: 2.75 MB ok) to the 32 KB point's byte budget. ">"
+        # with 16 KB, not 32: exactly-32 KB rows land here whenever the
+        # table is bypassed (budget override), and the 16-32 KB gap is
+        # unprobed.
+        cap_bytes = min(cap_bytes, vmem_budget_bytes() // 4)
+    return max(8 + 2 * tsteps,
+               min(cap_bytes // row_bytes, _WINDOW_EXT_ROWS_UNPROBED_CAP))
+
+
 def plan_window_band(nrows: int, ny: int, tsteps: int,
                      dtype=jnp.float32) -> tuple[int, int]:
     """(bm, m_pad) for the C2 route: probed envelope for the widths
-    measured on the default-budget v5e; elsewhere a conservative 2.5 MB
-    window cap (scaled to the VMEM budget), safely inside every probed
-    break point."""
-    row_bytes = ny * jnp.dtype(dtype).itemsize
-    ext = _probed_ext_rows(row_bytes)
-    if ext is None:
-        cap_bytes = vmem_budget_bytes() * 5 // 16    # 2.5 MB at v5e
-        ext = max(8 + 2 * tsteps, cap_bytes // row_bytes)
+    measured on the default-budget v5e; elsewhere the conservative
+    _window_ext_rows bound (byte cap tightened beyond the probed widths
+    plus a verified ext-row ceiling — the bare 2.5 MB cap compile-OOMs
+    at 32 KB rows)."""
+    ext = _window_ext_rows(ny * jnp.dtype(dtype).itemsize, tsteps)
     bm_max = max(8, (ext - 2 * tsteps) // 8 * 8)
     if bm_max >= nrows:
         bm = max(8, nrows // 8 * 8)  # keep at least one full band
@@ -1212,11 +1246,7 @@ def plan_shard_window(m: int, bn: int, tsteps: int, dtype=jnp.float32,
         return None
     if bn % 128 or tsteps % 8 or tsteps < 8 or m % 8:
         return None
-    row_bytes = bn * jnp.dtype(dtype).itemsize
-    ext = _probed_ext_rows(row_bytes)
-    if ext is None:
-        ext = max(8 + 2 * tsteps,
-                  (vmem_budget_bytes() * 5 // 16) // row_bytes)
+    ext = _window_ext_rows(bn * jnp.dtype(dtype).itemsize, tsteps)
     if with_cols:
         # The two lane-padded (rb+2T, 128) strip windows double-buffer on
         # top of the C2 working set — probed on the v5e: the 8 KB-row
