@@ -604,9 +604,10 @@ def window_band_viable(ny: int, bm: int, tsteps: int) -> bool:
 
 
 #: Measured C2 compile envelope on the 16 MB-VMEM v5e (round-4 probes):
-#: max viable ext rows (bm + 2T) per row width — the next 8-row step up
-#: OOMs the compiler's scoped VMEM (168 @ 16 KB rows, 336 @ 8 KB,
-#: 64 @ 32 KB — bm=56's 72 ext rows need 16.76 MB scoped). The envelope
+#: max VIABLE ext rows (bm + 2T) per row width — 176 @ 16 KB rows,
+#: 336 @ 8 KB, 64 @ 32 KB; the next probed step up (184 / 352 / 72
+#: ext rows) OOMs the compiler's scoped VMEM (72 rows @ 32 KB need
+#: 16.76 MB; full frontier in benchmarks/results/tune_bands.md). The envelope
 #: does NOT follow a single bytes cap across widths (2.88 MB windows
 #: compile at 16 KB rows but fail at 8 KB; 2 MB fails at 32 KB), hence
 #: a probed table, not a formula. bm at these points is also the

@@ -282,3 +282,42 @@ def test_hybrid_mode_matches_serial():
     want = Heat2DSolver(cfg.replace(mode="serial", gridx=1, gridy=1)
                         ).run(timed=False)
     np.testing.assert_allclose(got.u, want.u, rtol=1e-6, atol=1e-4)
+
+
+def test_window_envelope_planner():
+    """The window planners' envelope decisions (the probed table applies
+    off-TPU too: the VMEM fallback total matches the probed device).
+    Pins the 8192^2 compile-OOM class: the fallback byte cap must never
+    exceed the probed 32 KB entry or the verified off-table ceiling."""
+    import heat2d_tpu.ops.pallas_stencil as ps
+
+    # Probed entries (bm + 2T <= table ext rows).
+    assert ps._window_ext_rows(16 * 1024, 8) == 176
+    assert ps._window_ext_rows(8 * 1024, 8) == 336
+    assert ps._window_ext_rows(32 * 1024, 8) == 64
+    # Unprobed widths: 24 KB held to the widest probe point's byte
+    # budget; 4 KB to the verified 640-row ceiling.
+    assert ps._window_ext_rows(24 * 1024, 8) * 24 * 1024 \
+        <= ps.vmem_budget_bytes() // 4
+    assert ps._window_ext_rows(4 * 1024, 8) == 640
+    # Budget override bypasses the table; exactly-32 KB rows must still
+    # land at or under the probed break (the review finding: '>' vs
+    # '>=' admitted the 16.76 MB OOM config under an override equal to
+    # the default).
+    old = ps.VMEM_BUDGET_BYTES
+    try:
+        ps.VMEM_BUDGET_BYTES = 8 * 1024 * 1024
+        assert ps._window_ext_rows(32 * 1024, 8) <= 64
+    finally:
+        ps.VMEM_BUDGET_BYTES = old
+
+    # plan_window_band: pad-aware full-range scan (the 1280x1024 fix:
+    # bm=624 padded 592 rows; 432 pads 16 and sweeps 30% fewer rows).
+    bm, m_pad = ps.plan_window_band(1280, 1024, 8)
+    assert bm == 432 and m_pad == 1296
+    bm, _ = ps.plan_window_band(4096, 4096, 8)
+    assert bm == 152
+    bm, _ = ps.plan_window_band(2560, 2048, 8)
+    assert bm == 320
+    bm, _ = ps.plan_window_band(8192, 8192, 8)
+    assert bm == 48
