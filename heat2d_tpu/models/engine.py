@@ -79,8 +79,8 @@ def run_convergence_fused(chunk_resid_fn, multi_step_fn, u0,
     advances n steps and returns Σ(Δu)² of the final plane pair — the
     same pair the chunked loop forms from its ``interval-1`` fused steps
     plus one tracked step, without the tracked step or the separate
-    full-grid reduction (ops.pallas_stencil.window_chunk_resid fuses
-    both into the last band sweep). Schedule and early-exit semantics
+    full-grid reduction (the C2R/D2R window sweeps fuse both into the
+    chunk's last band sweep). Schedule and early-exit semantics
     are identical to run_convergence_chunked; only the residual's
     summation order differs (per-band partials), an f32-ulp deviation of
     the same class as the FMA step form such engines already use."""

@@ -846,29 +846,22 @@ def _window_resid_sweep(u, tsteps, cx, cy, bm, nx, step):
     return out, jnp.sum(parts)
 
 
-def window_chunk_resid(u, n, cx, cy, tsteps, bm, step=_step_value):
-    """Advance ``n >= tsteps`` steps and return (u_new, residual) where
-    the residual is Σ(Δu)² between the final two planes — the
-    convergence chunk with the tracked step and the residual pass FUSED
-    into the last window sweep (they were a full-grid kernel-B step plus
-    a full-grid reduction: ~78% overhead measured at 4096² on the
-    unfused path, benchmarks/results/sweep_conv.md round 4)."""
-    nx, ny = u.shape
-    lead = n - tsteps
-    m_pad = -(-nx // bm) * bm
-    u = jnp.pad(u, ((0, m_pad - nx + tsteps), (0, 0)))   # pad ONCE
-    nsweeps, rem = divmod(lead, tsteps)
+def _window_multi_padded(up, n, tsteps, cx, cy, bm, nx, step):
+    """``n`` steps on the padded (m_pad + T, ny) sweep layout: full
+    T-sweeps plus a partial-depth (nsub) remainder sweep — the ONE
+    sweep-scheduling loop the C2 chunk and the persistent-carry fused
+    convergence runner share."""
+    nsweeps, rem = divmod(n, tsteps)
     if nsweeps:
-        u = lax.fori_loop(
+        up = lax.fori_loop(
             0, nsweeps,
             lambda _, v: _band_window_sweep(v, tsteps, cx, cy, bm, nx,
                                             step),
-            u, unroll=False)
+            up, unroll=False)
     if rem:
-        u = _band_window_sweep(u, tsteps, cx, cy, bm, nx, step,
-                               nsub=rem)
-    out, r = _window_resid_sweep(u, tsteps, cx, cy, bm, nx, step)
-    return out[:nx], r
+        up = _band_window_sweep(up, tsteps, cx, cy, bm, nx, step,
+                                nsub=rem)
+    return up
 
 
 def _window_chunk(u, n, cx, cy, tsteps, bm, step):
@@ -890,18 +883,9 @@ def _window_chunk(u, n, cx, cy, tsteps, bm, step):
             f"{(ext_cap - 2 * tsteps) // 8 * 8} or let plan_window_band "
             f"choose")
     m_pad = -(-nx // bm) * bm
-    nsweeps, rem = divmod(n, tsteps)
-    out = jnp.pad(u, ((0, m_pad - nx + tsteps), (0, 0)))
-    if nsweeps:
-        out = lax.fori_loop(
-            0, nsweeps,
-            lambda _, v: _band_window_sweep(v, tsteps, cx, cy, bm, nx,
-                                            step),
-            out, unroll=False)
-    if rem:
-        out = _band_window_sweep(out, tsteps, cx, cy, bm, nx, step,
-                                 nsub=rem)
-    return out[:nx]
+    up = jnp.pad(u, ((0, m_pad - nx + tsteps), (0, 0)))
+    return _window_multi_padded(up, n, tsteps, cx, cy, bm, nx,
+                                step)[:nx]
 
 
 def band_chunk(u, n: int, cx: float, cy: float,
@@ -983,26 +967,42 @@ def make_single_chip_runner(config):
     # Fused-residual convergence (C2R): on the streaming C2 route with
     # INTERVAL >= T, the chunk's tracked step + residual reduction fold
     # into the last window sweep — the unfused pair cost ~78% over
-    # fixed-step at 4096² (sweep_conv.md round 4). Parity runs (literal
-    # form) and resident grids keep the chunked loop.
-    chunk_resid = None
+    # fixed-step at 4096² (sweep_conv.md round 4). The carry stays in
+    # the PADDED (m_pad + T, ny) sweep layout across the whole while
+    # loop (the D2 persistent-carry trick — re-padding per chunk cost
+    # ~10% of the chunk at 4096²); extend/strip happen once per run.
+    # Parity runs (literal form) and resident grids keep the chunked
+    # loop.
+    fused = None
     if (config.convergence and not resident and form is _step_value
             and config.interval >= DEFAULT_TSTEPS
             and config.steps >= DEFAULT_TSTEPS       # clamp keeps >= T
             and _on_tpu() and ny % 128 == 0):
-        bm_w, _ = plan_window_band(nx, ny, DEFAULT_TSTEPS)
+        bm_w, m_pad_w = plan_window_band(nx, ny, DEFAULT_TSTEPS)
         if window_band_viable(ny, bm_w, DEFAULT_TSTEPS):
-            def chunk_resid(u, n):
-                return window_chunk_resid(u, n, cx, cy, DEFAULT_TSTEPS,
-                                          bm_w, step=form)
+            tw = DEFAULT_TSTEPS
+
+            def multi_p(up, n):
+                return _window_multi_padded(up, n, tw, cx, cy, bm_w,
+                                            nx, form)
+
+            def chunk_resid_p(up, n):
+                up = multi_p(up, n - tw)
+                return _window_resid_sweep(up, tw, cx, cy, bm_w, nx,
+                                           form)
+
+            def fused(u):
+                up = jnp.pad(u, ((0, m_pad_w - nx + tw), (0, 0)))
+                up, k = engine.run_convergence_fused(
+                    chunk_resid_p, multi_p, up,
+                    config.steps, config.interval, config.sensitivity)
+                return up[:nx], k
 
     def run(u):
         residual = lambda a, b: residual_sq(a, b)  # noqa: E731
         if config.convergence:
-            if chunk_resid is not None:
-                return engine.run_convergence_fused(
-                    chunk_resid, chunk, u,
-                    config.steps, config.interval, config.sensitivity)
+            if fused is not None:
+                return fused(u)
             return engine.run_convergence_chunked(
                 chunk, step, residual, u,
                 config.steps, config.interval, config.sensitivity)
@@ -1261,12 +1261,18 @@ def plan_shard_window(m: int, bn: int, tsteps: int, dtype=jnp.float32,
     return None
 
 
-def _shard_window_kernel(with_cols, s_ref, n_ref, *refs, rb, tsteps,
-                         nx, ny, cx, cy, step):
+def _shard_window_kernel(with_cols, resid, s_ref, n_ref, *refs, rb,
+                         tsteps, nsub, nx, ny, cx, cy, step):
     if with_cols:
-        w_ref, e_ref, u_ref, out_ref, tail = refs
+        if resid:
+            w_ref, e_ref, u_ref, out_ref, r_ref, tail = refs
+        else:
+            w_ref, e_ref, u_ref, out_ref, tail = refs
     else:
-        u_ref, out_ref, tail = refs
+        if resid:
+            u_ref, out_ref, r_ref, tail = refs
+        else:
+            u_ref, out_ref, tail = refs
     i = pl.program_id(0)
     t = tsteps
     x0, y0 = s_ref[0], s_ref[1]
@@ -1294,6 +1300,26 @@ def _shard_window_kernel(with_cols, s_ref, n_ref, *refs, rb, tsteps,
     def masked(v):
         return jnp.where(keep, v, step(v, cx, cy))
 
+    if resid:
+        # D2R: track the final plane pair and emit this band's partial
+        # Σ(Δu)² (the C2R design on the shard sweep). Single masked
+        # body, steps inlined — once per INTERVAL, and dual pl.when
+        # bodies of inlined steps double Mosaic's VMEM stack.
+        v = ext
+        for _ in range(nsub - 1):
+            v = masked(v)
+        prev = v
+        last = masked(v)
+        out_ref[:] = last[center]
+        d = last[center] - prev[center]
+        r_ref[...] = jnp.sum(d * d).reshape(1, 1, 1)
+        return
+    if nsub < tsteps:
+        # Partial-depth sweep (chunk remainders): single masked body,
+        # same stack rule as above; _window_steps inlines the short run.
+        out_ref[:] = _window_steps(nsub, masked, ext)[center]
+        return
+
     @pl.when(needs)
     def _():
         out_ref[:] = _unrolled_steps(t, masked, ext)[center]
@@ -1305,12 +1331,19 @@ def _shard_window_kernel(with_cols, s_ref, n_ref, *refs, rb, tsteps,
 
 
 def shard_window_sweep(ue, north, west, east, scalars, *, rb, tsteps,
-                       nx, ny, cx, cy, step=_step_value):
-    """One T-step sweep over the extended shard carry ``ue`` of
-    (bm + T, bn) — rows [0, bm) the block, [bm, bm+T) the south halo.
-    ``west``/``east``: None (no y axis) or (nblk, rb+2T, T) per-band
-    windows of the exchanged column strips. In-place via alias; the
-    south-halo rows pass through untouched (no out block covers them)."""
+                       nx, ny, cx, cy, step=_step_value, nsub=None,
+                       resid=False):
+    """One sweep over the extended shard carry ``ue`` of (bm + T, bn) —
+    rows [0, bm) the block, [bm, bm+T) the south halo. ``west``/``east``:
+    None (no y axis) or (nblk, rb+2T, T) per-band windows of the
+    exchanged column strips. In-place via alias; the south-halo rows
+    pass through untouched (no out block covers them).
+
+    ``nsub``: steps to advance (<= T; default T) — partial-depth chunk
+    remainders stay on the window route. ``resid=True`` (D2R): returns
+    ``(ue_new, partials)`` where ``partials`` sums per band to this
+    SHARD's Σ(Δu)² of the final plane pair; callers psum it across the
+    mesh for the global residual."""
     mt, bn = ue.shape
     t = tsteps
     nblk = (mt - t) // rb
@@ -1328,17 +1361,29 @@ def shard_window_sweep(ue, north, west, east, scalars, *, rb, tsteps,
     in_specs.append(pl.BlockSpec((pl.Element(rb + t), pl.Element(bn)),
                                  lambda i: (i * rb, 0), **mspace))
     args.append(ue)
-    return pl.pallas_call(
-        functools.partial(_shard_window_kernel, with_cols, rb=rb,
-                          tsteps=t, nx=nx, ny=ny, cx=cx, cy=cy, step=step),
-        out_shape=jax.ShapeDtypeStruct(ue.shape, ue.dtype),
+    out_shape = [jax.ShapeDtypeStruct(ue.shape, ue.dtype)]
+    out_specs = [pl.BlockSpec((rb, bn), lambda i: (i, 0), **mspace)]
+    if resid:
+        # (nblk, 1, 1) partials with (1, 1, 1) blocks — the Mosaic
+        # scalar-block layout (see _window_resid_sweep).
+        out_shape.append(jax.ShapeDtypeStruct((nblk, 1, 1), jnp.float32))
+        out_specs.append(pl.BlockSpec((1, 1, 1), lambda i: (i, 0, 0),
+                                      **mspace))
+    out = pl.pallas_call(
+        functools.partial(_shard_window_kernel, with_cols, resid, rb=rb,
+                          tsteps=t, nsub=t if nsub is None else nsub,
+                          nx=nx, ny=ny, cx=cx, cy=cy, step=step),
+        out_shape=out_shape if resid else out_shape[0],
         grid=(nblk,),
         in_specs=in_specs,
-        out_specs=pl.BlockSpec((rb, bn), lambda i: (i, 0), **mspace),
+        out_specs=out_specs if resid else out_specs[0],
         scratch_shapes=[pltpu.VMEM((t, bn), ue.dtype)],
         input_output_aliases={len(args) - 1: 0},
         compiler_params=params(dimension_semantics=("arbitrary",)),
     )(*args)
+    if resid:
+        return out[0], jnp.sum(out[1])
+    return out
 
 
 def make_shard_chunk_kernel(config):

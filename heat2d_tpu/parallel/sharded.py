@@ -18,6 +18,8 @@ never leaves the devices until I/O.
 
 from __future__ import annotations
 
+import types
+
 import jax
 import jax.numpy as jnp
 from jax import lax
@@ -174,7 +176,7 @@ def make_local_multi(config, mesh: Mesh, chunk_kernel=None, axes=None,
     return multi
 
 
-def make_window_multi(config, mesh: Mesh, chunk_kernel):
+def make_window_multi(config, mesh: Mesh):
     """Gather-free hybrid sweeps (Pallas kernel D2) over an EXTENDED
     (bm + T, bn) shard carry whose trailing T rows hold the current
     sweep's south halo — refreshed in place per sweep (a strip-sized
@@ -182,9 +184,11 @@ def make_window_multi(config, mesh: Mesh, chunk_kernel):
     chunk, the same per-sweep copy elimination kernel C2 made for the
     single-chip path. Returns None when the route is not viable (off-TPU,
     parity mode, resident-size shards, misaligned shapes) — kernel D
-    keeps those; else ``(multi, step, extend, strip)`` closures for
-    make_sharded_runner, all operating on the extended carry and only
-    callable inside shard_map."""
+    keeps those; else a namespace of closures (``multi``, ``step``,
+    ``extend``, ``strip``, ``chunk_resid`` for the fused D2R
+    convergence path, and the sweep ``depth``) for make_sharded_runner,
+    all operating on the extended carry and only callable inside
+    shard_map."""
     from heat2d_tpu.ops import pallas_stencil as ps
     if getattr(config, "bitwise_parity", False):
         return None     # the FMA-form-only route (the C2 envelope gate)
@@ -202,9 +206,8 @@ def make_window_multi(config, mesh: Mesh, chunk_kernel):
     nblk = bm // rb
     cx, cy = config.cx, config.cy
     nx, ny = config.nxprob, config.nyprob
-    legacy_chunk = make_local_chunk(config, mesh, chunk_kernel=chunk_kernel)
 
-    def sweep(ue):
+    def sweep(ue, nsub=None, resid=False):
         core = ue[:bm]
         north, south, west, east = exchange_halo_strips(
             core, ax, ay, gx, gy, t)
@@ -219,7 +222,8 @@ def make_window_multi(config, mesh: Mesh, chunk_kernel):
              (lax.axis_index(ay) * bn).astype(jnp.int32)])
         return ps.shard_window_sweep(ue, north, wwin, ewin, scalars,
                                      rb=rb, tsteps=t, nx=nx, ny=ny,
-                                     cx=cx, cy=cy)
+                                     cx=cx, cy=cy, nsub=nsub,
+                                     resid=resid)
 
     def multi(ue, n):
         full, rem = divmod(n, t)
@@ -227,17 +231,26 @@ def make_window_multi(config, mesh: Mesh, chunk_kernel):
             ue = lax.fori_loop(0, full, lambda _, v: sweep(v), ue,
                                unroll=False)
         if rem:
-            # Once-per-run tail (and the convergence tracked step):
-            # through kernel D on the plain block, spliced back.
-            ue = lax.dynamic_update_slice(
-                ue, legacy_chunk(ue[:bm], rem), (0, 0))
+            # Chunk remainders (and the unfused tracked step) stay on
+            # the window route as partial-depth sweeps.
+            ue = sweep(ue, nsub=rem)
         return ue
+
+    def chunk_resid(ue, n):
+        """``n >= t`` steps + this chunk's GLOBAL residual: the last
+        sweep is a D2R sweep whose per-shard partial psums across the
+        mesh (the MPI_Allreduce, fused into the kernel's tail)."""
+        ue = multi(ue, n - t)
+        ue, part = sweep(ue, resid=True)
+        return ue, lax.psum(part, (ax, ay))
 
     def extend(u):
         return jnp.concatenate(
             [u, jnp.zeros((t, bn), u.dtype)], axis=0)
 
-    return multi, (lambda ue: multi(ue, 1)), extend, (lambda ue: ue[:bm])
+    return types.SimpleNamespace(
+        multi=multi, step=(lambda ue: multi(ue, 1)), extend=extend,
+        strip=(lambda ue: ue[:bm]), chunk_resid=chunk_resid, depth=t)
 
 
 def make_sharded_runner(config, mesh: Mesh, chunk_kernel=None):
@@ -249,27 +262,43 @@ def make_sharded_runner(config, mesh: Mesh, chunk_kernel=None):
     accum = jnp.dtype(config.accum_dtype)
     local_step = make_local_step(config, mesh, chunk_kernel=chunk_kernel)
     local_multi = make_local_multi(config, mesh, chunk_kernel=chunk_kernel)
-    window = (make_window_multi(config, mesh, chunk_kernel)
+    # chunk_kernel's presence is the mode='hybrid' signal; the window
+    # route itself no longer needs the kernel-D chunk builder (its
+    # remainders are partial-depth window sweeps).
+    window = (make_window_multi(config, mesh)
               if chunk_kernel is not None else None)
     sharding = NamedSharding(mesh, P(ax, ay))
 
     def local_run(u):
         if window is not None:
-            w_multi, w_step, extend, strip = window
-
-            def residual_w(u_new, u_old):
-                return lax.psum(
-                    residual_sq(strip(u_new), strip(u_old), accum),
-                    (ax, ay))
-            ue = extend(u)
+            ue = window.extend(u)
             if config.convergence:
-                ue, k = engine.run_convergence_chunked(
-                    w_multi, w_step, residual_w, ue, config.steps,
-                    config.interval, config.sensitivity)
+                if (config.interval >= window.depth
+                        and config.steps >= window.depth
+                        and accum == jnp.float32):
+                    # (accum gate: the D2R kernel sums its partials in
+                    # f32; a float64-accum residual must stay on the
+                    # unfused path below, which honors it.)
+                    # Fused D2R path: tracked step + residual + psum
+                    # fold into the chunk's last sweep.
+                    ue, k = engine.run_convergence_fused(
+                        window.chunk_resid, window.multi, ue,
+                        config.steps, config.interval,
+                        config.sensitivity)
+                else:
+                    def residual_w(u_new, u_old):
+                        return lax.psum(
+                            residual_sq(window.strip(u_new),
+                                        window.strip(u_old), accum),
+                            (ax, ay))
+                    ue, k = engine.run_convergence_chunked(
+                        window.multi, window.step, residual_w, ue,
+                        config.steps, config.interval,
+                        config.sensitivity)
             else:
-                ue = w_multi(ue, config.steps)
+                ue = window.multi(ue, config.steps)
                 k = jnp.asarray(config.steps, jnp.int32)
-            return strip(ue), k
+            return window.strip(ue), k
         if config.convergence:
             def residual(u_new, u_old):
                 return lax.psum(residual_sq(u_new, u_old, accum),

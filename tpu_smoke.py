@@ -119,6 +119,17 @@ def main() -> int:
     assert first_exit("pallas") == first_exit("serial") == 12
     print("PASS C2R fused-residual early exit (steps_done parity)")
 
+    # D2R (the fused residual on the hybrid shard sweeps): same step
+    # form and per-cell op sequence as C2R, so the final state must be
+    # BITWISE equal to pallas's, with the same early-exit count.
+    got = run("hybrid", 2048, 2048, 48, convergence=True, interval=12,
+              sensitivity=0.0)
+    want = run("pallas", 2048, 2048, 48, convergence=True, interval=12,
+               sensitivity=0.0)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+    assert first_exit("hybrid") == 12
+    print("PASS D2R fused-residual (hybrid bitwise vs pallas + exit)")
+
     # Kernel D (hybrid shard kernels) on a 1x1 mesh: VMEM route at a
     # small shard, band route at the round-1 OOM config, and a
     # divisor-poor height (pad rows + windowed column strips).
