@@ -327,7 +327,9 @@ def suite_conv(steps, quick):
     if not quick:
         big.append(NORTH_STAR)
     for nx, ny in big:
-        for mode in ("serial", "pallas"):
+        # hybrid pairs measure the D2R fused path — the per-chip
+        # residual-schedule cost every chip of a pod pays.
+        for mode in ("serial", "pallas", "hybrid"):
             yield dict(mode=mode, nx=nx, ny=ny, steps=steps)
             yield dict(mode=mode, nx=nx, ny=ny, steps=steps,
                        convergence=True, sensitivity=0.0)

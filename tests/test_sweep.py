@@ -176,8 +176,9 @@ def test_conv_suite_marginal_pairs_and_overhead():
     pts = list(sweep.suite_conv(100, quick=False))
     pairs = [p for p in pts if p.get("sensitivity") == 0.0]
     fixed = [p for p in pts if not p.get("convergence")]
-    # 1280x1024, 2560x2048 and the 4096^2 north star, both modes.
-    assert len(pairs) == 6 and len(fixed) == 6
+    # 1280x1024, 2560x2048 and the 4096^2 north star; serial, pallas
+    # and hybrid (the D2R fused path).
+    assert len(pairs) == 9 and len(fixed) == 9
     assert all(p["convergence"] for p in pairs)
 
     recs = [
