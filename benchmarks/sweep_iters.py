@@ -57,7 +57,8 @@ def measure(nx: int, ny: int, mode: str = "pallas"):
         print(json.dumps(rows[-1]), file=sys.stderr)
     for i, r in enumerate(rows):
         r["per_step_s"] = r["total_s"] / r["steps"]
-        r["x_vs_10it"] = rows[0]["total_s"] and r["total_s"] / rows[0]["total_s"]
+        r["x_vs_10it"] = (r["total_s"] / rows[0]["total_s"]
+                          if rows[0]["total_s"] else None)
         if i:
             p = rows[i - 1]
             dt = r["total_s"] - p["total_s"]
@@ -92,11 +93,13 @@ def to_markdown(rows, nx, ny, mode, platform) -> str:
             mcell = "(window < noise floor)"
         else:
             mcell = "—"
+        x10 = r["x_vs_10it"]
         lines.append(
             f"| {r['steps']} | {r['total_s']:.4g} "
             f"| {r['per_step_s']:.3g} "
             f"| {mcell} "
-            f"| {r['x_vs_10it']:.4g} | {r['steps'] // 10} |")
+            f"| {'—' if x10 is None else format(x10, '.4g')} "
+            f"| {r['steps'] // 10} |")
     margs = [r["marginal_s"] for r in rows if "marginal_s" in r]
     if margs:
         spread = max(margs) / min(margs)

@@ -625,13 +625,31 @@ _WINDOW_EXT_ROWS = {32 * 1024: 64, 16 * 1024: 176, 8 * 1024: 336}
 _WINDOW_EXT_ROWS_UNPROBED_CAP = 640
 
 
+#: Kinds the _WINDOW_EXT_ROWS envelope was actually MEASURED on (the
+#: assumed-16 MB kinds in _KNOWN_VMEM_TOTAL_BYTES are not listed: their
+#: true break points are unprobed, so an explicit --vmem-budget raise is
+#: honored there as the documented escape hatch).
+_PROBED_VMEM_KINDS = ("TPU v5 lite", "TPU v5e")
+
+
 def _probed_ext_rows(row_bytes: int) -> int | None:
     """Probed max ext rows for this row width, or None when the attached
-    device is not the probed 16 MB-VMEM kind, the budget is overridden,
-    or the width is unprobed — the ONE lookup the C2/D2 planners and the
-    explicit-bm fast-fail share (a site updating the table must not be
-    able to desynchronize them)."""
-    if VMEM_BUDGET_BYTES is None and _vmem_total()[0] == 16 * 1024 * 1024:
+    device is not a 16 MB-VMEM kind or the width is unprobed — the ONE
+    lookup the C2/D2 planners and the explicit-bm fast-fail share (a
+    site updating the table must not be able to desynchronize them).
+
+    On a kind the table was actually measured on, the entry binds
+    regardless of any --vmem-budget override — the override changes the
+    plan budget, not the physical chip, so neither a raise nor a lower
+    may admit shapes past the measured compile break points (advisor r4
+    + review r5). On unprobed kinds an explicit override is the
+    documented escape hatch, so the table only applies un-overridden
+    (where the 16 MB fallback total matches the probed device — the CPU
+    test harness relies on that)."""
+    total, kind = _vmem_total()
+    if total != 16 * 1024 * 1024:
+        return None
+    if VMEM_BUDGET_BYTES is None or kind in _PROBED_VMEM_KINDS:
         return _WINDOW_EXT_ROWS.get(row_bytes)
     return None
 
@@ -646,9 +664,18 @@ def _window_ext_rows(row_bytes: int, tsteps: int) -> int:
     break at 32 KB), so extrapolating the byte cap upward OOMs (the
     8192^2 compile failure this helper fixes)."""
     ext = _probed_ext_rows(row_bytes)
-    if ext is not None:
+    total, kind = _vmem_total()
+    budget = vmem_budget_bytes()
+    if kind in _PROBED_VMEM_KINDS:
+        # A raised override cannot enlarge the physical chip: off-table
+        # widths must not scale their byte cap past the chip's real
+        # budget (review r5 — a 24 KB-row plan under --vmem-budget 32M
+        # would otherwise double the measured break region). A lowered
+        # override still tightens below.
+        budget = min(budget, total // 2)
+    if ext is not None and budget >= total // 2:
         return ext
-    cap_bytes = vmem_budget_bytes() * 5 // 16
+    cap_bytes = budget * 5 // 16
     if row_bytes > 16 * 1024:
         # At or beyond the widest probed points the break sits at
         # ~2-2.25 MB (64 ext rows x 32 KB), below the 2.5 MB narrow-row
@@ -657,9 +684,12 @@ def _window_ext_rows(row_bytes: int, tsteps: int) -> int:
         # with 16 KB, not 32: exactly-32 KB rows land here whenever the
         # table is bypassed (budget override), and the 16-32 KB gap is
         # unprobed.
-        cap_bytes = min(cap_bytes, vmem_budget_bytes() // 4)
-    return max(8 + 2 * tsteps,
-               min(cap_bytes // row_bytes, _WINDOW_EXT_ROWS_UNPROBED_CAP))
+        cap_bytes = min(cap_bytes, budget // 4)
+    cap = max(8 + 2 * tsteps,
+              min(cap_bytes // row_bytes, _WINDOW_EXT_ROWS_UNPROBED_CAP))
+    # A lowered budget tightens probed widths too (min with the table,
+    # which still fast-fail-binds above).
+    return min(ext, cap) if ext is not None else cap
 
 
 def plan_window_band(nrows: int, ny: int, tsteps: int,
@@ -684,9 +714,9 @@ def plan_window_band(nrows: int, ny: int, tsteps: int,
     # -> 234k measured via the D2 divisor rule in round 4). Ties prefer
     # the taller band (fewer programs).
     bm = bm_max
+    # Range stop 2T + 8 keeps every candidate > 2T (the window-viability
+    # floor) without a redundant in-loop guard (advisor r4).
     for b in range(bm_max, 2 * tsteps + 8, -8):
-        if b <= 2 * tsteps:
-            break
         if (-(-nrows // b)) * (b + 2 * tsteps) \
                 < (-(-nrows // bm)) * (bm + 2 * tsteps):
             bm = b

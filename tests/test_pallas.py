@@ -311,6 +311,38 @@ def test_window_envelope_planner():
     finally:
         ps.VMEM_BUDGET_BYTES = old
 
+    # On a kind the table was MEASURED on, no override direction may
+    # admit shapes past the compile break points — the table binds the
+    # plan AND (via the shared _probed_ext_rows) the explicit-bm
+    # fast-fail (advisor r4 + review r5); off-table widths keep the
+    # default-budget byte cap under a raise; a LOWERED override still
+    # tightens everywhere.
+    import unittest.mock as mock
+    with mock.patch.object(ps, "_detected", (16 * 2**20, "TPU v5 lite")):
+        old = ps.VMEM_BUDGET_BYTES
+        default_24k = ps._window_ext_rows(24 * 1024, 8)
+        try:
+            ps.VMEM_BUDGET_BYTES = 32 * 1024 * 1024
+            assert ps._probed_ext_rows(32 * 1024) == 64
+            assert ps._window_ext_rows(32 * 1024, 8) == 64
+            assert ps._window_ext_rows(16 * 1024, 8) == 176
+            assert ps._window_ext_rows(24 * 1024, 8) == default_24k
+            ps.VMEM_BUDGET_BYTES = 2 * 1024 * 1024
+            assert ps._probed_ext_rows(32 * 1024) == 64  # fast-fail bound
+            assert ps._window_ext_rows(16 * 1024, 8) < 176
+        finally:
+            ps.VMEM_BUDGET_BYTES = old
+    # An UNPROBED kind honors an explicit raise (the documented escape
+    # hatch — its true break points are unknown).
+    with mock.patch.object(ps, "_detected", (16 * 2**20, "TPU vNext")):
+        old = ps.VMEM_BUDGET_BYTES
+        try:
+            ps.VMEM_BUDGET_BYTES = 32 * 1024 * 1024
+            assert ps._probed_ext_rows(16 * 1024) is None
+            assert ps._window_ext_rows(16 * 1024, 8) > 176
+        finally:
+            ps.VMEM_BUDGET_BYTES = old
+
     # plan_window_band: pad-aware full-range scan (the 1280x1024 fix:
     # bm=624 padded 592 rows; 432 pads 16 and sweeps 30% fewer rows).
     bm, m_pad = ps.plan_window_band(1280, 1024, 8)
