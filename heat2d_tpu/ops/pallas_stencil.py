@@ -632,26 +632,31 @@ _WINDOW_EXT_ROWS_UNPROBED_CAP = 640
 _PROBED_VMEM_KINDS = ("TPU v5 lite", "TPU v5e")
 
 
-def _probed_ext_rows(row_bytes: int) -> int | None:
-    """Probed max ext rows for this row width, or None when the attached
-    device is not a 16 MB-VMEM kind or the width is unprobed — the ONE
-    lookup the C2/D2 planners and the explicit-bm fast-fail share (a
-    site updating the table must not be able to desynchronize them).
+def _probed_table_ext_rows(table: dict, row_bytes: int) -> int | None:
+    """Probed-table lookup with the shared device/override gating.
 
-    On a kind the table was actually measured on, the entry binds
+    On a kind a table was actually measured on, the entry binds
     regardless of any --vmem-budget override — the override changes the
     plan budget, not the physical chip, so neither a raise nor a lower
     may admit shapes past the measured compile break points (advisor r4
     + review r5). On unprobed kinds an explicit override is the
-    documented escape hatch, so the table only applies un-overridden
-    (where the 16 MB fallback total matches the probed device — the CPU
-    test harness relies on that)."""
+    documented escape hatch, so tables only apply un-overridden (where
+    the 16 MB fallback total matches the probed device — the CPU test
+    harness relies on that)."""
     total, kind = _vmem_total()
     if total != 16 * 1024 * 1024:
         return None
     if VMEM_BUDGET_BYTES is None or kind in _PROBED_VMEM_KINDS:
-        return _WINDOW_EXT_ROWS.get(row_bytes)
+        return table.get(row_bytes)
     return None
+
+
+def _probed_ext_rows(row_bytes: int) -> int | None:
+    """Probed max ext rows for this row width, or None when the attached
+    device is not a 16 MB-VMEM kind or the width is unprobed — the ONE
+    lookup the C2/D2 planners and the explicit-bm fast-fail share (a
+    site updating the table must not be able to desynchronize them)."""
+    return _probed_table_ext_rows(_WINDOW_EXT_ROWS, row_bytes)
 
 
 def _window_ext_rows(row_bytes: int, tsteps: int) -> int:
@@ -692,6 +697,28 @@ def _window_ext_rows(row_bytes: int, tsteps: int) -> int:
     return min(ext, cap) if ext is not None else cap
 
 
+def _pad_aware_bm(nrows: int, bm_max: int, tsteps: int) -> int:
+    """Pad-aware band-height refinement: minimize total ext rows swept,
+    ceil(nrows/bm) * (bm + 2T) — a band height dividing the row count
+    more evenly skips recomputing pad rows (4096 rows: bm=152 pads 8
+    rows -> 223.1k Mcells/s vs bm=160 padding 64 -> 221.3k measured).
+    The scan covers the WHOLE candidate range: narrow rows give a
+    deep bm_max whose divisor-poor pad can be huge (1280 rows at 4 KB:
+    bm_max=624 pads 592 rows -> 154k Mcells/s, while bm=320 pads zero
+    -> 234k measured via the D2 divisor rule in round 4). Ties prefer
+    the taller band (fewer programs)."""
+    if bm_max >= nrows:
+        return max(8, nrows // 8 * 8)  # keep at least one full band
+    bm = bm_max
+    # Range stop 2T + 8 keeps every candidate > 2T (the window-viability
+    # floor) without a redundant in-loop guard (advisor r4).
+    for b in range(bm_max, 2 * tsteps + 8, -8):
+        if (-(-nrows // b)) * (b + 2 * tsteps) \
+                < (-(-nrows // bm)) * (bm + 2 * tsteps):
+            bm = b
+    return bm
+
+
 def plan_window_band(nrows: int, ny: int, tsteps: int,
                      dtype=jnp.float32) -> tuple[int, int]:
     """(bm, m_pad) for the C2 route: probed envelope for the widths
@@ -700,26 +727,7 @@ def plan_window_band(nrows: int, ny: int, tsteps: int,
     plus a verified ext-row ceiling — the bare 2.5 MB cap compile-OOMs
     at 32 KB rows)."""
     ext = _window_ext_rows(ny * jnp.dtype(dtype).itemsize, tsteps)
-    bm_max = max(8, (ext - 2 * tsteps) // 8 * 8)
-    if bm_max >= nrows:
-        bm = max(8, nrows // 8 * 8)  # keep at least one full band
-        return bm, -(-nrows // bm) * bm
-    # Pad-aware refinement: minimize total ext rows swept,
-    # ceil(nrows/bm) * (bm + 2T) — a band height dividing the row count
-    # more evenly skips recomputing pad rows (4096 rows: bm=152 pads 8
-    # rows -> 223.1k Mcells/s vs bm=160 padding 64 -> 221.3k measured).
-    # The scan covers the WHOLE candidate range: narrow rows give a
-    # deep bm_max whose divisor-poor pad can be huge (1280 rows at 4 KB:
-    # bm_max=624 pads 592 rows -> 154k Mcells/s, while bm=320 pads zero
-    # -> 234k measured via the D2 divisor rule in round 4). Ties prefer
-    # the taller band (fewer programs).
-    bm = bm_max
-    # Range stop 2T + 8 keeps every candidate > 2T (the window-viability
-    # floor) without a redundant in-loop guard (advisor r4).
-    for b in range(bm_max, 2 * tsteps + 8, -8):
-        if (-(-nrows // b)) * (b + 2 * tsteps) \
-                < (-(-nrows // bm)) * (bm + 2 * tsteps):
-            bm = b
+    bm = _pad_aware_bm(nrows, max(8, (ext - 2 * tsteps) // 8 * 8), tsteps)
     return bm, -(-nrows // bm) * bm
 
 
@@ -739,13 +747,45 @@ def _window_steps(n, one, v):
     return _unrolled_steps(n, one, v)
 
 
-def _band_window_kernel(u_ref, out_ref, tail, *, bm, tsteps, nsub,
+def _split_window_refs(has_w, has_e, refs):
+    """(w_ref, e_ref, rest) from a window kernel's positional refs —
+    the ONE unpack the C2/C3 sweep and resid kernels share."""
+    w_ref = refs[0] if has_w else None
+    e_ref = refs[1 if has_w else 0] if has_e else None
+    return w_ref, e_ref, refs[has_w + has_e:]
+
+
+def _concat_halo_cols(ext, w_ref, e_ref):
+    """Concatenate the optional E/W halo-column windows onto a band's
+    row-extended block, and the kept-center column slice. Halo columns
+    ride in whole (their top/corner rows come from the strip windows'
+    extended-row coverage, not the scratch relay)."""
+    has_w, has_e = w_ref is not None, e_ref is not None
+    if has_w or has_e:
+        ext = jnp.concatenate(
+            ([w_ref[0]] if has_w else []) + [ext]
+            + ([e_ref[0]] if has_e else []), axis=1)
+    t = w_ref.shape[-1] if has_w else (e_ref.shape[-1] if has_e else 0)
+    return ext, slice(t if has_w else None, -t if has_e else None)
+
+
+def _band_window_kernel(has_w, has_e, u_ref, *refs, bm, tsteps, nsub,
                         nx, cx, cy, step, hi_start):
+    """C2/C3 window-sweep kernel. ``has_w``/``has_e``: optional per-band
+    column-strip window operands (the C3 panel route — a panel's E/W
+    halo columns from its neighbor panels, pre-windowed per band exactly
+    like the shard kernels' _strip_windows operands). The keep mask
+    stays ROW-only: edge panels extend toward the interior only, so the
+    step form's kept first/last columns ARE the global y boundary there,
+    and interior panels' outermost columns are discarded halo — the
+    interior fast path survives panelization unchanged."""
+    w_ref, e_ref, (out_ref, tail) = _split_window_refs(has_w, has_e, refs)
     i = pl.program_id(0)
     t = tsteps
     up = tail[:]                   # prev band's original tail (garbage @ i=0)
     tail[:] = u_ref[bm - t:bm, :]  # stash own original tail for band i+1
-    ext = jnp.concatenate([up, u_ref[:]], axis=0)     # (bm + 2t, ny)
+    ext = jnp.concatenate([up, u_ref[:]], axis=0)     # (bm + 2t, nyp)
+    ext, cs = _concat_halo_cols(ext, w_ref, e_ref)
     gi = (i * bm - t + lax.broadcasted_iota(jnp.int32, (bm + 2 * t, 1), 0))
     keep = (gi <= 0) | (gi >= nx - 1)
 
@@ -753,22 +793,40 @@ def _band_window_kernel(u_ref, out_ref, tail, *, bm, tsteps, nsub,
         return jnp.where(keep, v, step(v, cx, cy))
 
     if hi_start is None:
-        out_ref[:] = _window_steps(nsub, masked, ext)[t:-t]
+        out_ref[:] = _window_steps(nsub, masked, ext)[t:-t, cs]
         return
     needs_mask = (i == 0) | (i >= hi_start)
 
     @pl.when(needs_mask)
     def _():
-        out_ref[:] = _window_steps(nsub, masked, ext)[t:-t]
+        out_ref[:] = _window_steps(nsub, masked, ext)[t:-t, cs]
 
     @pl.when(jnp.logical_not(needs_mask))
     def _():
         out_ref[:] = _window_steps(
-            nsub, lambda v: step(v, cx, cy), ext)[t:-t]
+            nsub, lambda v: step(v, cx, cy), ext)[t:-t, cs]
 
 
-def _band_window_sweep(u, tsteps, cx, cy, bm, nx, step, nsub=None):
-    """One sweep over ``u`` of shape (m_pad + T, ny); the last T rows
+def _window_operands(u, wwin, ewin, bm, t, mspace):
+    """(in_specs, args) for a C2/C3 window sweep: the row-overlapping
+    element window over the carry plus the optional per-band E/W
+    column-strip windows — the ONE operand-assembly the plain and resid
+    sweeps share."""
+    in_specs = [pl.BlockSpec((pl.Element(bm + t), pl.Element(u.shape[1])),
+                             lambda i: (i * bm, 0), **mspace)]
+    args = [u]
+    strip_spec = pl.BlockSpec((1, bm + 2 * t, t), lambda i: (i, 0, 0),
+                              **mspace)
+    for win in (wwin, ewin):
+        if win is not None:
+            in_specs.append(strip_spec)
+            args.append(win)
+    return in_specs, args
+
+
+def _band_window_sweep(u, tsteps, cx, cy, bm, nx, step, nsub=None,
+                       wwin=None, ewin=None):
+    """One sweep over ``u`` of shape (m_pad + T, nyp); the last T rows
     are inert overrun pad for the last band's element window. ``nsub``:
     steps to advance this sweep (<= tsteps; default tsteps) — the
     window/relay geometry stays T-deep, only fewer steps run, so the
@@ -776,8 +834,11 @@ def _band_window_sweep(u, tsteps, cx, cy, bm, nx, step, nsub=None):
     is how ``n % T`` remainders stay on the window route instead of
     dropping to a legacy gathered sweep (which cost ~2x per step —
     rolled loop + re-gather — and showed up directly in the fused
-    convergence overhead)."""
-    mt, ny = u.shape
+    convergence overhead).
+
+    ``wwin``/``ewin``: optional (nblk, bm+2T, T) per-band column-strip
+    windows (the C3 panel route)."""
+    mt, nyp = u.shape
     t = tsteps
     nblk = (mt - t) // bm
     # Partial sweeps (nsub < T) run the uniform masked body: their steps
@@ -789,37 +850,39 @@ def _band_window_sweep(u, tsteps, cx, cy, bm, nx, step, nsub=None):
                 if nsub is None or nsub == tsteps else 0)
     mspace, _ = _mem_spaces()
     params = _compiler_params_cls()   # non-None: window_band_viable gated
+    in_specs, args = _window_operands(u, wwin, ewin, bm, t, mspace)
     return pl.pallas_call(
-        functools.partial(_band_window_kernel, bm=bm, tsteps=t,
+        functools.partial(_band_window_kernel, wwin is not None,
+                          ewin is not None, bm=bm, tsteps=t,
                           nsub=tsteps if nsub is None else nsub, nx=nx,
                           cx=cx, cy=cy, step=step,
                           hi_start=hi_start if hi_start > 1 else None),
         out_shape=jax.ShapeDtypeStruct(u.shape, u.dtype),
         grid=(nblk,),
-        in_specs=[
-            pl.BlockSpec((pl.Element(bm + t), pl.Element(ny)),
-                         lambda i: (i * bm, 0), **mspace),
-        ],
-        out_specs=pl.BlockSpec((bm, ny), lambda i: (i, 0), **mspace),
-        scratch_shapes=[pltpu.VMEM((t, ny), u.dtype)],
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((bm, nyp), lambda i: (i, 0), **mspace),
+        scratch_shapes=[pltpu.VMEM((t, nyp), u.dtype)],
         input_output_aliases={0: 0},
         compiler_params=params(dimension_semantics=("arbitrary",)),
-    )(u)
+    )(*args)
 
 
-def _band_window_resid_kernel(u_ref, out_ref, r_ref, tail, *, bm, tsteps,
+def _band_window_resid_kernel(has_w, has_e, u_ref, *refs, bm, tsteps,
                               nx, cx, cy, step):
-    """C2 window sweep that ALSO emits each band's partial residual
+    """C2/C3 window sweep that ALSO emits each band's partial residual
     Σ(Δu)² of the sweep's LAST step pair (rows of the band's kept
     center; boundary/pad rows are keep-masked so their delta is 0).
     One uniform masked body — the dual-body fast path doubles Mosaic's
     VMEM stack (the round-4 remainder-sweep OOM) and this kernel runs
     once per INTERVAL, where the select cost is irrelevant."""
+    w_ref, e_ref, (out_ref, r_ref, tail) = _split_window_refs(
+        has_w, has_e, refs)
     i = pl.program_id(0)
     t = tsteps
     up = tail[:]
     tail[:] = u_ref[bm - t:bm, :]
     ext = jnp.concatenate([up, u_ref[:]], axis=0)
+    ext, cs = _concat_halo_cols(ext, w_ref, e_ref)
     gi = (i * bm - t + lax.broadcasted_iota(jnp.int32, (bm + 2 * t, 1), 0))
     keep = (gi <= 0) | (gi >= nx - 1)
 
@@ -836,25 +899,28 @@ def _band_window_resid_kernel(u_ref, out_ref, r_ref, tail, *, bm, tsteps,
         v = masked(v)
     prev = v
     last = masked(v)
-    out_ref[:] = last[t:-t]
-    d = last[t:-t] - prev[t:-t]
+    out_ref[:] = last[t:-t, cs]
+    d = last[t:-t, cs] - prev[t:-t, cs]
     # Shaped (1, 1, 1) store: Mosaic has no scalar stores to VMEM.
     r_ref[...] = jnp.sum(d * d).reshape(1, 1, 1)
 
 
-def _window_resid_sweep(u, tsteps, cx, cy, bm, nx, step):
-    """One T-step C2R sweep over the (m_pad + T, ny) padded layout:
+def _window_resid_sweep(u, tsteps, cx, cy, bm, nx, step,
+                        wwin=None, ewin=None):
+    """One T-step C2R/C3R sweep over the (m_pad + T, nyp) padded layout:
     returns (u_new, residual) with the residual summed from the per-band
     partials (summation order differs from residual_sq's full-array sum
     at f32-ulp level — same deviation class as the FMA step form this
     route is gated to)."""
-    mt, ny = u.shape
+    mt, nyp = u.shape
     t = tsteps
     nblk = (mt - t) // bm
     mspace, _ = _mem_spaces()
     params = _compiler_params_cls()
+    in_specs, args = _window_operands(u, wwin, ewin, bm, t, mspace)
     out, parts = pl.pallas_call(
-        functools.partial(_band_window_resid_kernel, bm=bm, tsteps=t,
+        functools.partial(_band_window_resid_kernel, wwin is not None,
+                          ewin is not None, bm=bm, tsteps=t,
                           nx=nx, cx=cx, cy=cy, step=step),
         # Partials ride as (nblk, 1, 1) with (1, 1, 1) blocks — the last
         # two block dims must equal the array's (a (1, 1) block over
@@ -863,16 +929,13 @@ def _window_resid_sweep(u, tsteps, cx, cy, bm, nx, step):
         out_shape=[jax.ShapeDtypeStruct(u.shape, u.dtype),
                    jax.ShapeDtypeStruct((nblk, 1, 1), jnp.float32)],
         grid=(nblk,),
-        in_specs=[
-            pl.BlockSpec((pl.Element(bm + t), pl.Element(ny)),
-                         lambda i: (i * bm, 0), **mspace),
-        ],
-        out_specs=[pl.BlockSpec((bm, ny), lambda i: (i, 0), **mspace),
+        in_specs=in_specs,
+        out_specs=[pl.BlockSpec((bm, nyp), lambda i: (i, 0), **mspace),
                    pl.BlockSpec((1, 1, 1), lambda i: (i, 0, 0), **mspace)],
-        scratch_shapes=[pltpu.VMEM((t, ny), u.dtype)],
+        scratch_shapes=[pltpu.VMEM((t, nyp), u.dtype)],
         input_output_aliases={0: 0},
         compiler_params=params(dimension_semantics=("arbitrary",)),
-    )(u)
+    )(*args)
     return out, jnp.sum(parts)
 
 
@@ -959,6 +1022,222 @@ def band_chunk(u, n: int, cx: float, cy: float,
 
 
 # --------------------------------------------------------------------- #
+# Kernel C3: column-panel window sweeps for very wide rows
+# --------------------------------------------------------------------- #
+#
+# The C2 compile envelope SHRINKS with row width (176 ext rows at 16 KB
+# rows, 336 at 8 KB, only 64 at 32 KB — tune_bands.md), so 8192-wide
+# grids were stuck at bm=48 paying a 33% halo-recompute tax per sweep
+# (203.5k Mcells/s vs the framework's 237.5k frontier at 8 KB rows;
+# VERDICT r4 weak #1). C3 restores the deep-band envelope by walking the
+# grid in P column PANELS of nyp = ny/P cells:
+#
+# - Each panel keeps its own (m_pad + T, nyp) C2 carry; its sweeps are
+#   plain C2 window sweeps plus per-band E/W column-strip windows (the
+#   shard kernel D's _strip_windows operands) holding the T halo columns
+#   from the neighbor panels — gathered fresh each sweep from the
+#   pre-sweep carries (T/nyp of the grid's bytes, ~0.4%; nothing like
+#   the 2T/bm row-strip gather C2 exists to avoid).
+# - Edge panels extend toward the interior ONLY: their outer ext column
+#   is the global y boundary itself, which the step form keeps — so the
+#   keep mask stays row-only and the interior mask-free fast path
+#   survives panelization (a D2-style column mask would have disabled
+#   it for every band of both edge panels at P=2).
+# - Staleness: a panel's halo columns are exact at sweep start and
+#   degrade one column per in-VMEM step; the kept center sits T columns
+#   in — the same cone argument as the rows (and as kernel D's strips).
+# - Bitwise: every cell's per-step arithmetic DAG is unchanged (same
+#   step form, same keep semantics), so C3 output is BITWISE equal to
+#   C2/C at any panel count — tpu_smoke pins this on hardware.
+
+#: Measured C3 compile envelope on the v5e (round-5 probes, T=8): max
+#: ext rows per PANEL row width WITH the two column-strip windows —
+#: much tighter than C2's no-cols table (the strips cost ~50-90 ext
+#: rows of compiler headroom, not the 8 rows D2's short-shard probe
+#: suggested): bm=112 compiles at 16 KB panels, bm=120 does not;
+#: bm=248 / bm=256 at 8 KB; bm=464 / bm=504 at 4 KB (all at 8192-row
+#: grids — full frontier in benchmarks/results/tune_bands.md).
+_PANEL_WINDOW_EXT_ROWS = {16 * 1024: 128, 8 * 1024: 264, 4 * 1024: 480}
+
+#: Fallback headroom for panel widths the table doesn't cover: the
+#: largest measured gap between the no-cols and with-cols envelopes
+#: (4 KB rows: 640 -> 480).
+_PANEL_COL_EXT_ALLOWANCE = 160
+
+
+def _panel_ext_rows(row_bytes: int, tsteps: int) -> int:
+    ext = _probed_table_ext_rows(_PANEL_WINDOW_EXT_ROWS, row_bytes)
+    if ext is not None:
+        return ext
+    return max(8 + 2 * tsteps,
+               _window_ext_rows(row_bytes, tsteps)
+               - _PANEL_COL_EXT_ALLOWANCE)
+
+
+def plan_panel_window(nrows: int, nyp: int, tsteps: int,
+                      dtype=jnp.float32) -> tuple[int, int]:
+    """(bm, m_pad) for a C3 panel of width ``nyp``: the pad-aware band
+    scan under the panel (with-cols) envelope at the panel's row
+    width."""
+    ext = _panel_ext_rows(nyp * jnp.dtype(dtype).itemsize, tsteps)
+    bm = _pad_aware_bm(nrows, max(8, (ext - 2 * tsteps) // 8 * 8), tsteps)
+    return bm, -(-nrows // bm) * bm
+
+
+def plan_panels(nrows: int, ny: int, tsteps: int,
+                dtype=jnp.float32) -> tuple[int, int | None]:
+    """(P, bm) for the single-chip window route; P=1 means plain C2
+    (bm=None: caller uses plan_window_band).
+
+    MEASURED policy (tune_panels, 8192^2 + 4096^2 on the v5e): split
+    only when the row width's own C2 envelope has collapsed — at 16 KB
+    rows (4096^2) every P=2 config LOSES 3-7% to plain C2 (the per-sweep
+    strip gathers and per-panel launches weigh 4x more at the smaller
+    grid), while at 32 KB rows (8192^2) P=2 wins +7.5% same-run
+    (201.3k vs 187.3k Mcells/s). The split lands panels at <= 16 KB
+    rows — the last width with a generous envelope; smaller panels
+    measured strictly worse at 8192^2 (P=4: 199.9k, P=8: 174.0k vs
+    P=2: 201.3k — the deeper envelope of narrower panels doesn't make
+    up the extra boundary columns and launches)."""
+    if not (_on_tpu() and _compiler_params_cls() is not None):
+        return 1, None
+    itemsize = jnp.dtype(dtype).itemsize
+    row_bytes = ny * itemsize
+    if (ny % 128 or tsteps % 8 or tsteps < 8
+            or row_bytes <= 16 * 1024):
+        return 1, None
+    pp = -(-row_bytes // (16 * 1024))     # smallest P reaching <= 16 KB
+    if ny % pp or (ny // pp) % 128:
+        return 1, None
+    bm, _ = plan_panel_window(nrows, ny // pp, tsteps, dtype)
+    if bm <= 2 * tsteps or bm % 8:
+        return 1, None
+    return pp, bm
+
+
+def panel_route_viable(ny: int, panels: int, bm: int | None,
+                       tsteps: int) -> bool:
+    if panels < 2 or bm is None or ny % panels:
+        return False
+    return window_band_viable(ny // panels, bm, tsteps)
+
+
+def _panel_split(u, panels: int, bm: int, tsteps: int):
+    """(nx, ny) -> tuple of P (m_pad + T, nyp) panel carries (each the
+    C2 padded sweep layout over its own columns)."""
+    nx, ny = u.shape
+    nyp = ny // panels
+    m_pad = -(-nx // bm) * bm
+    pad = ((0, m_pad - nx + tsteps), (0, 0))
+    return tuple(jnp.pad(u[:, p * nyp:(p + 1) * nyp], pad)
+                 for p in range(panels))
+
+
+def _panel_join(carries, nx: int):
+    return jnp.concatenate([c[:nx] for c in carries], axis=1)
+
+
+def _panel_strip_windows(carries, bm: int, t: int):
+    """Per-sweep cross-panel halo windows: panel p's west window from
+    panel p-1's tail columns, east from panel p+1's head columns, as
+    (nblk, bm+2T, T) per-band windows (_strip_windows on a full-height
+    strip with T zero rows on top — rows above the domain are
+    keep-masked like every other out-of-domain row, and rows below it
+    are the carries' inert pad, 0 forever). Gathered from the PRE-sweep
+    carries: every panel's new value depends only on old neighbor
+    values, so sweep order between panels is immaterial."""
+    mt = carries[0].shape[0]          # m_pad + T
+    nblk = (mt - t) // bm
+    z = jnp.zeros((t, t), carries[0].dtype)
+
+    def windows(cols):
+        return _strip_windows(jnp.concatenate([z, cols], axis=0),
+                              nblk, bm, t)
+
+    last = len(carries) - 1
+    return [(windows(carries[p - 1][:, -t:]) if p else None,
+             windows(carries[p + 1][:, :t]) if p < last else None)
+            for p in range(len(carries))]
+
+
+def _panel_sweep_all(carries, tsteps, cx, cy, bm, nx, step, nsub=None,
+                     resid=False):
+    """One window sweep of every panel (strips gathered first, from the
+    pre-sweep carries). ``resid=True``: C3R — every panel's sweep is a
+    resid sweep; returns (carries, Σ partials)."""
+    wins = _panel_strip_windows(carries, bm, tsteps)
+    if resid:
+        outs, parts = [], []
+        for c, (w, e) in zip(carries, wins):
+            o, r = _window_resid_sweep(c, tsteps, cx, cy, bm, nx, step,
+                                       wwin=w, ewin=e)
+            outs.append(o)
+            parts.append(r)
+        return tuple(outs), sum(parts)
+    return tuple(
+        _band_window_sweep(c, tsteps, cx, cy, bm, nx, step, nsub=nsub,
+                           wwin=w, ewin=e)
+        for c, (w, e) in zip(carries, wins))
+
+
+def _panel_multi(carries, n, tsteps, cx, cy, bm, nx, step):
+    """``n`` steps on the panel carries: full T-sweeps plus a
+    partial-depth remainder sweep — _window_multi_padded for the panel
+    route."""
+    nsweeps, rem = divmod(n, tsteps)
+    if nsweeps:
+        carries = lax.fori_loop(
+            0, nsweeps,
+            lambda _, cs: _panel_sweep_all(cs, tsteps, cx, cy, bm, nx,
+                                           step),
+            carries, unroll=False)
+    if rem:
+        carries = _panel_sweep_all(carries, tsteps, cx, cy, bm, nx, step,
+                                   nsub=rem)
+    return carries
+
+
+def panel_chunk(u, n: int, cx: float, cy: float,
+                tsteps: int = DEFAULT_TSTEPS, panels: int | None = None,
+                bm: int | None = None, step=_step_value):
+    """Advance ``n`` (static) steps via the C3 panel route. ``panels``/
+    ``bm`` default to the plan_panels policy (which may choose P=1 —
+    then this is exactly band_chunk's window route)."""
+    nx, ny = u.shape
+    if panels is None:
+        panels, bm = plan_panels(nx, ny, tsteps, u.dtype)
+    if panels < 2:
+        return band_chunk(u, n, cx, cy, tsteps=tsteps, bm=bm, step=step)
+    if ny % panels:
+        raise ConfigError(
+            f"panel count {panels} does not divide the {ny}-cell row "
+            f"width — columns would be silently dropped")
+    if bm is None or bm % 8 or bm <= 2 * tsteps:
+        raise ConfigError(
+            f"explicit panels={panels} needs an explicit 8-aligned "
+            f"bm > {2 * tsteps}, got {bm} (or let plan_panels choose "
+            f"both)")
+    nyp = ny // panels
+    strip_bytes = (2 * (bm + 2 * tsteps) * max(tsteps, 128)
+                   * jnp.dtype(u.dtype).itemsize)
+    _check_band_vmem(bm, tsteps, nyp + 2 * tsteps, u.dtype,
+                     extra_bytes=strip_bytes)
+    ext_cap = _probed_table_ext_rows(_PANEL_WINDOW_EXT_ROWS,
+                                     nyp * jnp.dtype(u.dtype).itemsize)
+    if ext_cap is not None and bm + 2 * tsteps > ext_cap:
+        raise ConfigError(
+            f"panel window of {bm + 2 * tsteps} ext rows x {nyp} cells "
+            f"(+column strips) is over the probed {ext_cap}-row "
+            f"with-cols envelope for this panel width "
+            f"({_vmem_total()[1]}): use bm <= "
+            f"{(ext_cap - 2 * tsteps) // 8 * 8} or let plan_panels "
+            f"choose")
+    carries = _panel_split(u, panels, bm, tsteps)
+    carries = _panel_multi(carries, n, tsteps, cx, cy, bm, nx, step)
+    return _panel_join(carries, nx)
+
+
+# --------------------------------------------------------------------- #
 # Engine integration
 # --------------------------------------------------------------------- #
 
@@ -980,6 +1259,12 @@ def make_single_chip_runner(config):
     resident = fits_vmem((nx, ny))
     form = (_step_value_literal if getattr(config, "bitwise_parity", False)
             else _step_value)
+    # C3 panel route for very wide HBM grids (FMA form only — the panel
+    # envelope, like C2's, was probed with it; parity runs keep the
+    # legacy route via band_chunk).
+    pP, pbm = ((1, None) if resident or form is not _step_value
+               else plan_panels(nx, ny, DEFAULT_TSTEPS))
+    use_panels = panel_route_viable(ny, pP, pbm, DEFAULT_TSTEPS)
 
     if resident:
         def step(u):
@@ -987,6 +1272,16 @@ def make_single_chip_runner(config):
 
         def chunk(u, n):  # n is a static Python int: baked into the kernel
             return multi_step_vmem(u, n, cx, cy, step=form)
+    elif use_panels:
+        def step(u):
+            # The tracked single step (unfused convergence only): the
+            # route-agnostic gathered band step — bitwise-equal to an
+            # nsub=1 panel sweep and far cheaper than the panel
+            # split/strip/join machinery for one step.
+            return band_step(u, cx, cy, step=form)
+
+        def chunk(u, n):
+            return panel_chunk(u, n, cx, cy, panels=pP, bm=pbm, step=form)
     else:
         def step(u):
             return band_step(u, cx, cy, step=form)
@@ -1008,25 +1303,45 @@ def make_single_chip_runner(config):
             and config.interval >= DEFAULT_TSTEPS
             and config.steps >= DEFAULT_TSTEPS       # clamp keeps >= T
             and _on_tpu() and ny % 128 == 0):
-        bm_w, m_pad_w = plan_window_band(nx, ny, DEFAULT_TSTEPS)
-        if window_band_viable(ny, bm_w, DEFAULT_TSTEPS):
-            tw = DEFAULT_TSTEPS
+        tw = DEFAULT_TSTEPS
+        if use_panels:
+            # C3R: the panel carries ride the whole while loop (the
+            # persistent-carry trick); each chunk's last sweep is a
+            # resid sweep on every panel, partials summed across
+            # bands AND panels.
+            def multi_c3(cs, n):
+                return _panel_multi(cs, n, tw, cx, cy, pbm, nx, form)
 
-            def multi_p(up, n):
-                return _window_multi_padded(up, n, tw, cx, cy, bm_w,
-                                            nx, form)
-
-            def chunk_resid_p(up, n):
-                up = multi_p(up, n - tw)
-                return _window_resid_sweep(up, tw, cx, cy, bm_w, nx,
-                                           form)
+            def chunk_resid_c3(cs, n):
+                cs = multi_c3(cs, n - tw)
+                return _panel_sweep_all(cs, tw, cx, cy, pbm, nx, form,
+                                        resid=True)
 
             def fused(u):
-                up = jnp.pad(u, ((0, m_pad_w - nx + tw), (0, 0)))
-                up, k = engine.run_convergence_fused(
-                    chunk_resid_p, multi_p, up,
+                cs = _panel_split(u, pP, pbm, tw)
+                cs, k = engine.run_convergence_fused(
+                    chunk_resid_c3, multi_c3, cs,
                     config.steps, config.interval, config.sensitivity)
-                return up[:nx], k
+                return _panel_join(cs, nx), k
+        else:
+            bm_w, m_pad_w = plan_window_band(nx, ny, DEFAULT_TSTEPS)
+            if window_band_viable(ny, bm_w, DEFAULT_TSTEPS):
+                def multi_p(up, n):
+                    return _window_multi_padded(up, n, tw, cx, cy, bm_w,
+                                                nx, form)
+
+                def chunk_resid_p(up, n):
+                    up = multi_p(up, n - tw)
+                    return _window_resid_sweep(up, tw, cx, cy, bm_w, nx,
+                                               form)
+
+                def fused(u):
+                    up = jnp.pad(u, ((0, m_pad_w - nx + tw), (0, 0)))
+                    up, k = engine.run_convergence_fused(
+                        chunk_resid_p, multi_p, up,
+                        config.steps, config.interval,
+                        config.sensitivity)
+                    return up[:nx], k
 
     def run(u):
         residual = lambda a, b: residual_sq(a, b)  # noqa: E731

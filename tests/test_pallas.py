@@ -353,3 +353,36 @@ def test_window_envelope_planner():
     assert bm == 320
     bm, _ = ps.plan_window_band(8192, 8192, 8)
     assert bm == 48
+
+
+def test_panel_planner():
+    """plan_panels policy (measured, round 5): split only past 16 KB
+    rows, smallest P landing panels at <= 16 KB, bm from the with-cols
+    probed envelope (much tighter than C2's: the two strip windows cost
+    ~50-90 ext rows of compiler headroom)."""
+    import unittest.mock as mock
+    import heat2d_tpu.ops.pallas_stencil as ps
+
+    # Off-TPU (this harness): always P=1 — the CPU suite never panels.
+    assert ps.plan_panels(8192, 8192, 8) == (1, None)
+
+    with mock.patch.object(ps, "_on_tpu", lambda: True):
+        # 32 KB rows split in 2; the probed 16 KB with-cols envelope
+        # (128 ext rows) gives bm=112 at 8192 rows, bm=104 at 512.
+        assert ps.plan_panels(8192, 8192, 8) == (2, 112)
+        assert ps.plan_panels(512, 8192, 8) == (2, 104)
+        # <= 16 KB rows: never split (panels measured 3-7% SLOWER at
+        # 4096^2 — tune_panels round 5).
+        assert ps.plan_panels(4096, 4096, 8) == (1, None)
+        assert ps.plan_panels(2560, 2048, 8) == (1, None)
+        # 64 KB rows: P=4.
+        pp, bm = ps.plan_panels(8192, 16384, 8)
+        assert pp == 4 and bm == 112
+        # Misaligned tsteps: no panel route.
+        assert ps.plan_panels(8192, 8192, 4) == (1, None)
+        # With-cols probed entries + the off-table allowance.
+        assert ps._panel_ext_rows(16 * 1024, 8) == 128
+        assert ps._panel_ext_rows(8 * 1024, 8) == 264
+        assert ps._panel_ext_rows(4 * 1024, 8) == 480
+        assert ps._panel_ext_rows(2 * 1024, 8) \
+            == ps._window_ext_rows(2 * 1024, 8) - 160

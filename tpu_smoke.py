@@ -83,6 +83,55 @@ def main() -> int:
         np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
         print(f"PASS kernel C2 bitwise vs kernel C ({shape[0]}x{shape[1]})")
 
+    # Kernel C3 (column-panel window sweeps — the wide-row production
+    # route) pinned BITWISE to the C2 window route: same per-cell step
+    # DAG, different tiling (per-panel carries + cross-panel strip
+    # windows). Covers P=2 and P=4, divisor-poor rows (m_pad overrun),
+    # and a remainder sweep (n % T != 0).
+    for shape, panels, bmp, n in (((1000, 4096), 2, 144, 52),
+                                  ((512, 2048), 4, 64, 16)):
+        u = inidat(*shape)
+        want = jax.jit(lambda v: ps.band_chunk(v, n, 0.1, 0.1))(u)
+        got = jax.jit(lambda v: ps.panel_chunk(
+            v, n, 0.1, 0.1, panels=panels, bm=bmp))(u)
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+        print(f"PASS kernel C3 bitwise vs C2 ({shape[0]}x{shape[1]}, "
+              f"P={panels}, bm={bmp})")
+
+    # C3R: the panel resid sweep's state must stay bitwise equal to the
+    # plain route, and its residual must match the last step pair's
+    # Σ(Δu)² (per-band partial summation order differs at f32-ulp).
+    import jax.numpy as jnp
+    u = inidat(1000, 4096)
+
+    def c3r(v):
+        cs = ps._panel_split(v, 2, 144, 8)
+        cs, r = ps._panel_sweep_all(cs, 8, 0.1, 0.1, 144, v.shape[0],
+                                    ps._step_value, resid=True)
+        return ps._panel_join(cs, v.shape[0]), r
+
+    got8, res = jax.jit(c3r)(u)
+    want8 = jax.jit(lambda v: ps.band_chunk(v, 8, 0.1, 0.1))(u)
+    want7 = jax.jit(lambda v: ps.band_chunk(v, 7, 0.1, 0.1))(u)
+    np.testing.assert_array_equal(np.asarray(got8), np.asarray(want8))
+    np.testing.assert_allclose(
+        float(res), float(jnp.sum((want8 - want7) ** 2)), rtol=1e-4)
+    print("PASS kernel C3R resid sweep (state bitwise + residual)")
+
+    # Solver-level C3: at >16 KB rows the production pallas route must
+    # go through plan_panels (P=2 here) — fixed-step and the fused C3R
+    # convergence path, both against the serial golden model.
+    pp, pbm = ps.plan_panels(512, 8192, 8)
+    assert pp == 2 and pbm is not None, (pp, pbm)
+    want = run("serial", 512, 8192, 30)
+    check("kernel C3 solver route (512x8192, plan P=2)",
+          run("pallas", 512, 8192, 30), want)
+    want = run("serial", 512, 8192, 48, convergence=True, interval=12,
+               sensitivity=0.0)
+    check("kernel C3R solver convergence (512x8192)",
+          run("pallas", 512, 8192, 48, convergence=True, interval=12,
+              sensitivity=0.0), want)
+
     # 16 KB rows + a remainder sweep (steps % 8 != 0): the legacy-C
     # remainder runs a ROLLED in-kernel loop, where the dual-body
     # interior fast path blew Mosaic's scoped-VMEM stack at this row
