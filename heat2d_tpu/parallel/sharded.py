@@ -178,17 +178,19 @@ def make_local_multi(config, mesh: Mesh, chunk_kernel=None, axes=None,
 
 def make_window_multi(config, mesh: Mesh):
     """Gather-free hybrid sweeps (Pallas kernel D2) over an EXTENDED
-    (bm + T, bn) shard carry whose trailing T rows hold the current
-    sweep's south halo — refreshed in place per sweep (a strip-sized
-    dynamic_update_slice) instead of re-assembling strip operands per
-    chunk, the same per-sweep copy elimination kernel C2 made for the
-    single-chip path. Returns None when the route is not viable (off-TPU,
-    parity mode, resident-size shards, misaligned shapes) — kernel D
-    keeps those; else a namespace of closures (``multi``, ``step``,
-    ``extend``, ``strip``, ``chunk_resid`` for the fused D2R
-    convergence path, and the sweep ``depth``) for make_sharded_runner,
-    all operating on the extended carry and only callable inside
-    shard_map."""
+    (m_pad + T, bn) shard carry: rows [0, bm) the block, [bm, bm+T) the
+    current sweep's south halo — refreshed in place per sweep (a
+    strip-sized dynamic_update_slice) instead of re-assembling strip
+    operands per chunk, the same per-sweep copy elimination kernel C2
+    made for the single-chip path — and [bm+T, m_pad+T) inert pad for
+    divisor-poor shard heights (m_pad == bm when rb divides bm; see
+    plan_shard_window for the pad-correctness argument). Returns None
+    when the route is not viable (off-TPU, parity mode, resident-size
+    shards, misaligned shapes) — kernel D keeps those; else a namespace
+    of closures (``multi``, ``step``, ``extend``, ``strip``,
+    ``chunk_resid`` for the fused D2R convergence path, and the sweep
+    ``depth``) for make_sharded_runner, all operating on the extended
+    carry and only callable inside shard_map."""
     from heat2d_tpu.ops import pallas_stencil as ps
     if getattr(config, "bitwise_parity", False):
         return None     # the FMA-form-only route (the C2 envelope gate)
@@ -200,10 +202,12 @@ def make_window_multi(config, mesh: Mesh):
     if ps.fits_vmem((bm + 2 * t, bn + 2 * t)):
         return None     # whole-block-resident kernel D is already fused
     with_cols = gy > 1
-    rb = ps.plan_shard_window(bm, bn, t, with_cols=with_cols)
-    if rb is None:
+    plan = ps.plan_shard_window(bm, bn, t, with_cols=with_cols)
+    if plan is None:
         return None
-    nblk = bm // rb
+    rb, m_pad = plan
+    nblk = m_pad // rb
+    pad_rows = m_pad - bm
     cx, cy = config.cx, config.cy
     nx, ny = config.nxprob, config.nyprob
 
@@ -213,8 +217,17 @@ def make_window_multi(config, mesh: Mesh):
             core, ax, ay, gx, gy, t)
         ue = lax.dynamic_update_slice(ue, south, (bm, 0))
         if with_cols:
-            wwin = ps._strip_windows(west, nblk, rb, t)
-            ewin = ps._strip_windows(east, nblk, rb, t)
+            if pad_rows:
+                # Column strips must cover the pad bands' windows too
+                # (strip rows [bm+T, m_pad+T) sit in the garbage zone —
+                # values there only ever feed pad-row updates).
+                zpad = jnp.zeros((pad_rows, t), ue.dtype)
+                west_p = jnp.concatenate([west, zpad], axis=0)
+                east_p = jnp.concatenate([east, zpad], axis=0)
+            else:
+                west_p, east_p = west, east
+            wwin = ps._strip_windows(west_p, nblk, rb, t)
+            ewin = ps._strip_windows(east_p, nblk, rb, t)
         else:
             wwin = ewin = None
         scalars = jnp.stack(
@@ -223,7 +236,7 @@ def make_window_multi(config, mesh: Mesh):
         return ps.shard_window_sweep(ue, north, wwin, ewin, scalars,
                                      rb=rb, tsteps=t, nx=nx, ny=ny,
                                      cx=cx, cy=cy, nsub=nsub,
-                                     resid=resid)
+                                     resid=resid, valid_rows=bm)
 
     def multi(ue, n):
         full, rem = divmod(n, t)
@@ -246,7 +259,7 @@ def make_window_multi(config, mesh: Mesh):
 
     def extend(u):
         return jnp.concatenate(
-            [u, jnp.zeros((t, bn), u.dtype)], axis=0)
+            [u, jnp.zeros((pad_rows + t, bn), u.dtype)], axis=0)
 
     return types.SimpleNamespace(
         multi=multi, step=(lambda ue: multi(ue, 1)), extend=extend,

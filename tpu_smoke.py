@@ -192,6 +192,15 @@ def main() -> int:
     check("kernel D band route, divisor-poor rows (hybrid 1000x2048)",
           run("hybrid", 1000, 2048, 30), want)
 
+    # Solver-level padded D2: 1048 rows on a 1x1 mesh used to silently
+    # drop to kernel D (no 8-aligned divisor > 2T); the padded plan
+    # keeps the window route (asserted) and must match serial.
+    plan = ps.plan_shard_window(1048, 2048, 8)
+    assert plan is not None and plan[1] > 1048, plan
+    want = run("serial", 1048, 2048, 30)
+    check("kernel D2 padded solver route (hybrid 1048x2048)",
+          run("hybrid", 1048, 2048, 30), want)
+
     # Kernel D2 (gather-free shard sweeps — the production hybrid route
     # on TPU; the solver-level hybrid checks above already ran through
     # it) pinned BITWISE to kernel D's gather route at the KERNEL level,
@@ -220,9 +229,11 @@ def main() -> int:
         want = jax.jit(lambda u: ps._shard_band_chunk(
             u, (north, south, west, east), scalars, t, 0.1, 0.1, nx, ny,
             step=ps._step_value))(u)
-        rb = ps.plan_shard_window(m, bn, t, with_cols=with_cols)
-        assert rb is not None, "D2 plan rejected an aligned config"
-        nblk = m // rb
+        plan = ps.plan_shard_window(m, bn, t, with_cols=with_cols)
+        assert plan is not None, "D2 plan rejected an aligned config"
+        rb, m_pad = plan
+        assert m_pad == m, plan      # 512 divides: zero pad
+        nblk = m_pad // rb
 
         def d2(u):
             ue = jnp.concatenate([u, south], axis=0)
@@ -239,6 +250,112 @@ def main() -> int:
         np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
         print(f"PASS kernel D2 bitwise vs kernel D (with_cols={with_cols},"
               f" rb={rb})")
+
+    # D2 PADDED (the divisor-cliff fix): a 1048-row mid-grid shard has
+    # no deep 8-aligned divisor (1048 = 8 x 131), and 1004 is not even
+    # 8-aligned (the south halo lands at an unaligned offset); the
+    # padded plan must keep the window route and stay bitwise-equal to
+    # kernel D. Both column variants; nonzero halos, mid-grid offset.
+    # The with_cols case also pins the D2R residual on a padded plan:
+    # band centers past the shard's true height hold overwritten
+    # garbage the valid_rows mask must exclude (review r5).
+    bn, t = 1024, 8
+    nx = 4096
+    for m, with_cols, y0 in ((1048, True, 1024), (1048, False, 0),
+                             (1004, False, 0)):
+        ny = 4096 if with_cols else bn
+        u = jnp.asarray(rng.random((m, bn), dtype=np.float32))
+        north = jnp.asarray(rng.random((t, bn), dtype=np.float32))
+        south = jnp.asarray(rng.random((t, bn), dtype=np.float32))
+        west = jnp.asarray(rng.random((m + 2 * t, t), dtype=np.float32))
+        east = jnp.asarray(rng.random((m + 2 * t, t), dtype=np.float32))
+        if not with_cols:
+            west = jnp.zeros_like(west)
+            east = jnp.zeros_like(east)
+        scalars = jnp.asarray([1024, y0], jnp.int32)
+        want = jax.jit(lambda u: ps._shard_band_chunk(
+            u, (north, south, west, east), scalars, t, 0.1, 0.1, nx, ny,
+            step=ps._step_value))(u)
+        plan = ps.plan_shard_window(m, bn, t, with_cols=with_cols)
+        assert plan is not None, f"padded D2 plan rejected {m} rows"
+        rb, m_pad = plan
+        assert m_pad > m and rb > 2 * t, plan
+        nblk = m_pad // rb
+
+        def d2pad(u, resid=False):
+            ue = jnp.concatenate(
+                [u, south, jnp.zeros((m_pad - m, bn), u.dtype)], axis=0)
+            if with_cols:
+                zp = jnp.zeros((m_pad - m, t), u.dtype)
+                wwin = ps._strip_windows(
+                    jnp.concatenate([west, zp], axis=0), nblk, rb, t)
+                ewin = ps._strip_windows(
+                    jnp.concatenate([east, zp], axis=0), nblk, rb, t)
+            else:
+                wwin = ewin = None
+            out = ps.shard_window_sweep(ue, north, wwin, ewin, scalars,
+                                        rb=rb, tsteps=t, nx=nx, ny=ny,
+                                        cx=0.1, cy=0.1, resid=resid,
+                                        valid_rows=m)
+            if resid:
+                return out[0][:m], out[1]
+            return out[:m]
+
+        got = jax.jit(d2pad)(u)
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+        print(f"PASS kernel D2 padded bitwise vs D (with_cols={with_cols},"
+              f" rb={rb}, m_pad={m_pad})")
+        if with_cols:
+            got8, part = jax.jit(lambda u: d2pad(u, resid=True))(u)
+            np.testing.assert_array_equal(np.asarray(got8),
+                                          np.asarray(want))
+            # 7-step ground truth via a D chunk of depth t-1, its halos
+            # sliced from the t-deep ones (the rows/cols adjacent to
+            # the block; the staleness cone allows the shallower
+            # depth).
+            want7 = jax.jit(lambda u: ps._shard_band_chunk(
+                u, (north[1:], south[:-1], west[1:-1, 1:],
+                    east[1:-1, :-1]),
+                scalars, t - 1, 0.1, 0.1, nx, ny,
+                step=ps._step_value))(u)
+            expect = float(jnp.sum((jnp.asarray(want)
+                                    - jnp.asarray(want7)) ** 2))
+            np.testing.assert_allclose(float(part), expect, rtol=1e-4)
+            print("PASS kernel D2R padded residual excludes pad rows")
+
+    # Pod-relevant D2 with-cols envelope: a 4096-wide (16 KB) shard with
+    # column strips at the plan's rb must COMPILE on the real chip (a
+    # 2x2 mesh at 8192^2 gives exactly this shard; C3's much tighter
+    # with-cols envelope says allowances don't transfer between kernel
+    # structures, so this pin keeps D2's -8 rule honest).
+    m, bn, t = 2048, 4096, 8
+    nx, ny = 8192, 8192
+    plan = ps.plan_shard_window(m, bn, t, with_cols=True)
+    assert plan is not None
+    rb, m_pad = plan
+    u = jnp.asarray(rng.random((m, bn), dtype=np.float32))
+    north = jnp.asarray(rng.random((t, bn), dtype=np.float32))
+    south = jnp.asarray(rng.random((t, bn), dtype=np.float32))
+    west = jnp.asarray(rng.random((m + 2 * t, t), dtype=np.float32))
+    east = jnp.asarray(rng.random((m + 2 * t, t), dtype=np.float32))
+    scalars = jnp.asarray([2048, 4096], jnp.int32)
+    nblk = m_pad // rb
+
+    def d2wide(u):
+        ue = jnp.concatenate(
+            [u, south, jnp.zeros((m_pad - m, bn), u.dtype)], axis=0)
+        zp = jnp.zeros((m_pad - m, t), u.dtype)
+        wwin = ps._strip_windows(jnp.concatenate([west, zp], axis=0),
+                                 nblk, rb, t)
+        ewin = ps._strip_windows(jnp.concatenate([east, zp], axis=0),
+                                 nblk, rb, t)
+        out = ps.shard_window_sweep(ue, north, wwin, ewin, scalars,
+                                    rb=rb, tsteps=t, nx=nx, ny=ny,
+                                    cx=0.1, cy=0.1)
+        return out[:m]
+
+    jax.block_until_ready(jax.jit(d2wide)(u))
+    print(f"PASS kernel D2 with-cols 16 KB shard compiles (rb={rb})")
 
     # Batched ensemble kernels with B > 1: the (B, 1, 2) scalar-block
     # layout (a (1, 2) block over (B, 2) is illegal on real TPU and
