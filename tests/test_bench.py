@@ -1,0 +1,37 @@
+"""bench.py record contract: the one JSON line the driver consumes, and
+its calibrated self-honesty field (VERDICT r4 next #7)."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import bench
+
+
+def test_calibrated_bound_tracks_the_planned_route():
+    # 4096^2 streams through C2 at bm=152 (plan_window_band): bound =
+    # VPU calibration at 16 KB rows x bm/(bm+2T).
+    b = bench.calibrated_bound_mcells(4096, 4096)
+    assert abs(b - 248_000.0 * 152 / 168) < 1e-6
+    # VMEM-resident shapes have no streaming structure to bound.
+    assert bench.calibrated_bound_mcells(640, 512) is None
+
+
+def test_record_emits_pct_of_calibrated_bound():
+    rec = bench.build_record(220_000.0, "two-point", 1.5,
+                             nx=4096, ny=4096, steps=24000)
+    b = bench.calibrated_bound_mcells(4096, 4096)
+    assert rec["pct_of_calibrated_bound"] == round(100 * 220_000.0 / b, 1)
+    assert 50 < rec["pct_of_calibrated_bound"] < 120
+    assert rec["unit"] == "Mcells/s"
+    assert rec["vs_baseline"] == round(220_000.0 / 669.0, 2)
+    # Resident shapes: the field is absent, not wrong.
+    rec = bench.build_record(200_000.0, "two-point", 1.0,
+                             nx=640, ny=512, steps=100)
+    assert "pct_of_calibrated_bound" not in rec
+    # Fence-dominated single-run fallbacks are not comparable to the
+    # ceiling: no field.
+    rec = bench.build_record(500.0, "single-run (two-point within "
+                             "noise)", 0.2, nx=4096, ny=4096, steps=100)
+    assert "pct_of_calibrated_bound" not in rec

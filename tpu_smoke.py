@@ -368,6 +368,34 @@ def main() -> int:
     check("ensemble band kernel (B=2, HBM members)",
           run_ensemble(1024, 2048, 16, cxs, cys, method="band"), want)
 
+    # Batched WINDOW route (gather-free ensemble sweeps) bitwise vs the
+    # legacy gathered-strip route: same per-member step DAG, different
+    # dataflow (stacked carries + element windows + scratch relay across
+    # member boundaries). Divisor-poor rows exercise the per-member pad;
+    # 20 steps exercise the partial-depth remainder sweep.
+    import unittest.mock as mock
+    from heat2d_tpu.models import ensemble as ens
+    got = run_ensemble(1000, 2048, 20, cxs, cys, method="band")
+    with mock.patch.object(ps, "window_band_viable",
+                           lambda *a, **k: False):
+        want = run_ensemble(1000, 2048, 20, cxs, cys, method="band")
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+    print("PASS ensemble window route bitwise vs legacy band (B=2)")
+
+    # Convergence ensemble through the window-band chunks: per-member
+    # early exit must match the vmap'd golden loop's steps_done.
+    from heat2d_tpu.models.ensemble import run_ensemble_convergence
+    uw, kw = run_ensemble_convergence(1000, 2048, 200, 20, 1e4,
+                                      cxs, cys, method="band")
+    uj, kj = run_ensemble_convergence(1000, 2048, 200, 20, 1e4,
+                                      cxs, cys, method="jnp")
+    assert [int(x) for x in kw] == [int(x) for x in kj], (kw, kj)
+    # 200 steps of the kernel's FMA factoring vs the golden literal
+    # form: ~2e-5 rel drift (the Appendix-B class; same allowance as
+    # test_pallas_mode_convergence per step count).
+    check("ensemble window convergence (steps_done parity)", uw, uj,
+          rtol=1e-4)
+
     # Batch x spatial ensemble on the single chip (a (1,1,1) mesh): the
     # vmapped shard_map program with traced per-member (cx, cy) must
     # compile and run on real XLA:TPU (the CPU suite covers multi-device
