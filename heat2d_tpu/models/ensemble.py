@@ -307,8 +307,10 @@ def _ens_conv_kernel(resid, s_ref, act_ref, u_ref, *refs, bm, tsteps,
             return jnp.where(keep, v, _step_value(v, cx, cy))
 
         if resid:
+            # nsub <= t: the chunk-tail resid schedule (every other
+            # sweep of the chunk stays a full fast one).
             v = ext
-            for _ in range(tsteps - 1):
+            for _ in range(nsub - 1):
                 v = masked(v)
             prev = v
             last = masked(v)
@@ -460,9 +462,11 @@ def _run_batch_conv_window(u0, cxs, cys, *, steps, interval, sensitivity,
     def body(carry):
         u, i, chunks, done = carry
         act = act_of(done)
-        u = multi(u, iv - t, act)
+        d = iv % t or t      # chunk-tail resid depth
+        u = multi(u, iv - d, act)
         u, res = _batched_conv_sweep(scal, act, u, resid_bm, t,
-                                     m_pad // resid_bm, nx, resid=True)
+                                     m_pad // resid_bm, nx, nsub=d,
+                                     resid=True)
         # Frozen members wrote through unchanged in-kernel (no outer
         # select: a second consumer of the carry breaks the alias
         # chain — see _ens_conv_kernel) and report res=0, which cannot

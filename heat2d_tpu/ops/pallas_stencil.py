@@ -881,7 +881,7 @@ def _band_window_sweep(u, tsteps, cx, cy, bm, nx, step, nsub=None,
 
 
 def _band_window_resid_kernel(has_w, has_e, u_ref, *refs, bm, tsteps,
-                              nx, cx, cy, step):
+                              nsub, nx, cx, cy, step):
     """C2/C3 window sweep that ALSO emits each band's partial residual
     Σ(Δu)² of the sweep's LAST step pair (rows of the band's kept
     center; boundary/pad rows are keep-masked so their delta is 0).
@@ -902,13 +902,16 @@ def _band_window_resid_kernel(has_w, has_e, u_ref, *refs, bm, tsteps,
     def masked(v):
         return jnp.where(keep, v, step(v, cx, cy))
 
-    # All t steps INLINED as one group (t == _STEP_UNROLL by the route's
-    # gate): `_unrolled_steps(t-1)` would take its rolled-loop path —
-    # measured as the whole sweep losing the cross-step unroll win and
-    # conv overhead REGRESSING at 2560x2048 (18.5% -> 35.1%). Inlining
-    # matches kernel C2's own group body; only `prev` adds a live array.
+    # All nsub steps INLINED as one group (nsub <= t == _STEP_UNROLL by
+    # the route's gate): `_unrolled_steps(nsub-1)` would take its
+    # rolled-loop path — measured as the whole sweep losing the
+    # cross-step unroll win and conv overhead REGRESSING at 2560x2048
+    # (18.5% -> 35.1%). Inlining matches kernel C2's own group body;
+    # only `prev` adds a live array. ``nsub`` < t is the round-5
+    # chunk-tail schedule: the resid sweep advances only the chunk's
+    # REMAINDER depth so every other sweep stays a full fast one.
     v = ext
-    for _ in range(tsteps - 1):
+    for _ in range(nsub - 1):
         v = masked(v)
     prev = v
     last = masked(v)
@@ -919,11 +922,12 @@ def _band_window_resid_kernel(has_w, has_e, u_ref, *refs, bm, tsteps,
 
 
 def _window_resid_sweep(u, tsteps, cx, cy, bm, nx, step,
-                        wwin=None, ewin=None):
-    """One T-step C2R/C3R sweep over the (m_pad + T, nyp) padded layout:
-    returns (u_new, residual) with the residual summed from the per-band
-    partials (summation order differs from residual_sq's full-array sum
-    at f32-ulp level — same deviation class as the FMA step form this
+                        wwin=None, ewin=None, nsub=None):
+    """One C2R/C3R resid sweep over the (m_pad + T, nyp) padded layout,
+    advancing ``nsub`` (<= T; default T) steps: returns (u_new,
+    residual) with the residual summed from the per-band partials
+    (summation order differs from residual_sq's full-array sum at
+    f32-ulp level — same deviation class as the FMA step form this
     route is gated to)."""
     mt, nyp = u.shape
     t = tsteps
@@ -934,6 +938,7 @@ def _window_resid_sweep(u, tsteps, cx, cy, bm, nx, step,
     out, parts = pl.pallas_call(
         functools.partial(_band_window_resid_kernel, wwin is not None,
                           ewin is not None, bm=bm, tsteps=t,
+                          nsub=t if nsub is None else nsub,
                           nx=nx, cx=cx, cy=cy, step=step),
         # Partials ride as (nblk, 1, 1) with (1, 1, 1) blocks — the last
         # two block dims must equal the array's (a (1, 1) block over
@@ -1182,7 +1187,7 @@ def _panel_sweep_all(carries, tsteps, cx, cy, bm, nx, step, nsub=None,
         outs, parts = [], []
         for c, (w, e) in zip(carries, wins):
             o, r = _window_resid_sweep(c, tsteps, cx, cy, bm, nx, step,
-                                       wwin=w, ewin=e)
+                                       wwin=w, ewin=e, nsub=nsub)
             outs.append(o)
             parts.append(r)
         return tuple(outs), sum(parts)
@@ -1325,9 +1330,13 @@ def make_single_chip_runner(config):
                 return _panel_multi(cs, n, tw, cx, cy, pbm, nx, form)
 
             def chunk_resid_c3(cs, n):
-                cs = multi_c3(cs, n - tw)
+                # Chunk-tail resid schedule: the resid sweep advances
+                # only the remainder depth so every other sweep is a
+                # full fast one (round-5: cut conv overhead ~in half).
+                d = n % tw or tw
+                cs = multi_c3(cs, n - d)
                 return _panel_sweep_all(cs, tw, cx, cy, pbm, nx, form,
-                                        resid=True)
+                                        nsub=d, resid=True)
 
             def fused(u):
                 cs = _panel_split(u, pP, pbm, tw)
@@ -1343,9 +1352,11 @@ def make_single_chip_runner(config):
                                                 nx, form)
 
                 def chunk_resid_p(up, n):
-                    up = multi_p(up, n - tw)
+                    # Chunk-tail resid schedule (see chunk_resid_c3).
+                    d = n % tw or tw
+                    up = multi_p(up, n - d)
                     return _window_resid_sweep(up, tw, cx, cy, bm_w, nx,
-                                               form)
+                                               form, nsub=d)
 
                 def fused(u):
                     up = jnp.pad(u, ((0, m_pad_w - nx + tw), (0, 0)))

@@ -252,9 +252,14 @@ def make_window_multi(config, mesh: Mesh):
     def chunk_resid(ue, n):
         """``n >= t`` steps + this chunk's GLOBAL residual: the last
         sweep is a D2R sweep whose per-shard partial psums across the
-        mesh (the MPI_Allreduce, fused into the kernel's tail)."""
-        ue = multi(ue, n - t)
-        ue, part = sweep(ue, resid=True)
+        mesh (the MPI_Allreduce, fused into the kernel's tail). The
+        resid sweep advances only the chunk-tail depth (n % t, or a
+        full t when t | n) so every other sweep is a full fast-path
+        sweep — round 5: hybrid conv overhead 14.8% -> see
+        sweep_conv.md."""
+        d = n % t or t
+        ue = multi(ue, n - d)
+        ue, part = sweep(ue, nsub=d, resid=True)
         return ue, lax.psum(part, (ax, ay))
 
     def extend(u):

@@ -214,7 +214,8 @@ def test_sweep_iters_markdown_math():
                                / (r["steps"] - p["steps"]))
     assert abs(rows[1]["marginal_s"] - 1e-3) < 1e-12
     assert abs(rows[2]["marginal_s"] - 1e-3) < 1e-12
-    md = sweep_iters.to_markdown(rows, 2560, 2048, "pallas", "test")
+    key = {"mode": "pallas", "grid": "2560x2048", "platform": "test"}
+    md = sweep_iters.section_markdown(rows, key)
     assert "fence-noise floor: 1.000x" in md
     assert "| 1000 |" in md
     # A window under the floor gets no marginal, and is labeled so.
@@ -222,5 +223,10 @@ def test_sweep_iters_markdown_math():
               "x_vs_10it": 1.0},
              {"steps": 100, "total_s": 0.21, "per_step_s": 0.0021,
               "x_vs_10it": 1.05, "marginal_noise": True}]
-    md2 = sweep_iters.to_markdown(noisy, 2560, 2048, "pallas", "test")
+    md2 = sweep_iters.section_markdown(noisy, key)
     assert "(window < noise floor)" in md2
+    # Sections merge by key; pre-round-5 keyless rows are dropped.
+    for r in rows:
+        r["key"] = key
+    full = sweep_iters.render(rows)
+    assert "## pallas 2560x2048 on test" in full
