@@ -223,13 +223,21 @@ def mesh_shapes(n_devices):
 
 
 def suite_chip(steps, quick):
-    sizes = REF_SIZES[:2] if quick else REF_SIZES + [NORTH_STAR]
+    sizes = REF_SIZES[:2] if quick else REF_SIZES + [NORTH_STAR,
+                                                     (8192, 8192)]
     for nx, ny in sizes:
         # hybrid at 1x1 mesh = the per-shard fused kernel path on one
         # chip; rows at the large sizes document the hybrid-vs-pallas
         # per-chip ratio every chip of a pod would pay (VERDICT r2 #1).
-        modes = ("serial", "pallas", "hybrid") \
-            if not quick and nx * ny >= 1280 * 1024 else ("serial", "pallas")
+        # 8192^2 (the C3 column-panel route, round 5) skips the serial
+        # row: the jnp path's amortization span there costs ~10 min for
+        # a number the 4096^2 row already anchors.
+        if nx >= 8192:
+            modes = ("pallas", "hybrid")
+        elif not quick and nx * ny >= 1280 * 1024:
+            modes = ("serial", "pallas", "hybrid")
+        else:
+            modes = ("serial", "pallas")
         for mode in modes:
             yield dict(mode=mode, nx=nx, ny=ny, steps=steps)
 
@@ -278,6 +286,24 @@ def suspect_rows(records):
                     and mesh(q) == mesh(r)
                     and cells(q) > cells(r) and st > AGREE_FACTOR * qt):
                 out.add(i)
+    # Same-mode cross-grid plausibility for LARGE grids, where per-cell
+    # step time is roughly flat: without it the sweep's LARGEST grid is
+    # structurally unguardable — the monotonicity check above can only
+    # flag a row when a bigger grid exists, and 8192^2 has no serial
+    # anchor (review r5). A bogus two-point marginal (the round-2
+    # class) lands far outside AGREE_FACTOR; healthy large-row spreads
+    # measure <= ~1.25x. Both rows of a violating pair re-measure (two
+    # rows cannot say which is wrong; a healthy row just re-confirms).
+    big = {}
+    for i, r in enumerate(records):
+        st = r.get("step_time_s")
+        if st is not None and cells(r) >= 1280 * 1024:
+            big.setdefault((r["mode"], mesh(r)), []).append(
+                (i, st / cells(r)))
+    for group in big.values():
+        percell = [p for _, p in group]
+        if len(group) > 1 and max(percell) > AGREE_FACTOR * min(percell):
+            out.update(i for i, _ in group)
     return sorted(out)
 
 
@@ -460,8 +486,9 @@ def to_markdown(records, platform, is_cpu_host):
         "pallas grids small enough to stay resident (<= ~2.6 MB, e.g. "
         "640x512) run the zero-HBM-traffic resident kernel and can beat "
         "the streaming band kernel's per-cell rate at larger grids "
-        "(640x512's ~276 Gcells/s row re-confirms at 244-267 under "
-        "600k-step amortization).", "",
+        "(640x512 has measured ~244-283 Gcells/s across sessions under "
+        "long amortization — the table row below is this run's "
+        "number).", "",
         "| mode | grid | mesh | steps | step time (s) | Mcells/s | "
         "elapsed (s) | method | ref serial 100-step (s) | speedup vs ref "
         f"serial | vs ref best (160 tasks) | vs ref CUDA |{extra_hdr}",

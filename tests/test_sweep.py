@@ -112,6 +112,28 @@ def test_suspect_rows_flags_committed_bogus_row():
     assert sweep.suspect_rows(recs) == [1]
 
 
+def test_suspect_rows_guards_largest_large_grid():
+    """The cross-grid per-cell plausibility rule (review r5): the
+    sweep's LARGEST grid has no bigger-grid monotonicity partner and
+    (at 8192^2) no serial anchor, so a bogus two-point row there was
+    structurally unguardable. Healthy large-row spreads stay within
+    AGREE_FACTOR; a bogus row flags the whole (mode, mesh) group for
+    re-measurement (two rows cannot say which is wrong)."""
+    recs = [
+        {"mode": "pallas", "grid": "4096x4096", "step_time_s": 7.6e-5},
+        {"mode": "pallas", "grid": "8192x8192", "step_time_s": 3.3e-4},
+    ]
+    assert sweep.suspect_rows(recs) == []          # healthy pair (1.09x)
+    recs[1]["step_time_s"] = 3.3e-3                # 10x-off largest row
+    assert sweep.suspect_rows(recs) == [0, 1]
+    # Small grids are exempt (dispatch-dominated, per-cell rates wild).
+    recs = [
+        {"mode": "pallas", "grid": "80x64", "step_time_s": 2.0e-6},
+        {"mode": "pallas", "grid": "640x512", "step_time_s": 2.4e-6},
+    ]
+    assert sweep.suspect_rows(recs) == []
+
+
 def test_suspect_rows_monotonicity():
     # A smaller grid slower per step than a larger one (same mode), but
     # not >10x serial: caught by the monotonicity rule alone.
