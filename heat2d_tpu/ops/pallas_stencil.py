@@ -1306,19 +1306,18 @@ def make_single_chip_runner(config):
         def chunk(u, n):  # temporally-blocked sweeps (~T x less HBM traffic)
             return band_chunk(u, n, cx, cy, step=form)
 
-    # Fused-residual convergence (C2R): on the streaming C2 route with
-    # INTERVAL >= T, the chunk's tracked step + residual reduction fold
-    # into the last window sweep — the unfused pair cost ~78% over
-    # fixed-step at 4096² (sweep_conv.md round 4). The carry stays in
-    # the PADDED (m_pad + T, ny) sweep layout across the whole while
-    # loop (the D2 persistent-carry trick — re-padding per chunk cost
-    # ~10% of the chunk at 4096²); extend/strip happen once per run.
-    # Parity runs (literal form) and resident grids keep the chunked
-    # loop.
+    # Fused-residual convergence (C2R): on the streaming C2 route the
+    # chunk's tracked step + residual reduction fold into the last
+    # window sweep — the unfused pair cost ~78% over fixed-step at
+    # 4096² (sweep_conv.md round 4). The carry stays in the PADDED
+    # (m_pad + T, ny) sweep layout across the whole while loop (the D2
+    # persistent-carry trick — re-padding per chunk cost ~10% of the
+    # chunk at 4096²); extend/strip happen once per run. Any interval
+    # >= 1 is viable since round 5's chunk-tail schedule (the resid
+    # sweep's depth adapts to the chunk tail, d = n % T or T). Parity
+    # runs (literal form) and resident grids keep the chunked loop.
     fused = None
     if (config.convergence and not resident and form is _step_value
-            and config.interval >= DEFAULT_TSTEPS
-            and config.steps >= DEFAULT_TSTEPS       # clamp keeps >= T
             and _on_tpu() and ny % 128 == 0):
         tw = DEFAULT_TSTEPS
         if use_panels:

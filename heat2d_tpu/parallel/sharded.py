@@ -250,13 +250,15 @@ def make_window_multi(config, mesh: Mesh):
         return ue
 
     def chunk_resid(ue, n):
-        """``n >= t`` steps + this chunk's GLOBAL residual: the last
+        """``n >= 1`` steps + this chunk's GLOBAL residual: the last
         sweep is a D2R sweep whose per-shard partial psums across the
         mesh (the MPI_Allreduce, fused into the kernel's tail). The
         resid sweep advances only the chunk-tail depth (n % t, or a
         full t when t | n) so every other sweep is a full fast-path
         sweep — round 5: hybrid conv overhead 14.8% -> see
-        sweep_conv.md."""
+        sweep_conv.md. For n < t the whole chunk IS the resid sweep
+        (multi runs zero sweeps) — the small-interval path tpu_smoke
+        pins."""
         d = n % t or t
         ue = multi(ue, n - d)
         ue, part = sweep(ue, nsub=d, resid=True)
@@ -291,12 +293,12 @@ def make_sharded_runner(config, mesh: Mesh, chunk_kernel=None):
         if window is not None:
             ue = window.extend(u)
             if config.convergence:
-                if (config.interval >= window.depth
-                        and config.steps >= window.depth
-                        and accum == jnp.float32):
+                if accum == jnp.float32:
                     # (accum gate: the D2R kernel sums its partials in
                     # f32; a float64-accum residual must stay on the
-                    # unfused path below, which honors it.)
+                    # unfused path below, which honors it. Any
+                    # interval >= 1 is viable since the round-5
+                    # chunk-tail resid schedule.)
                     # Fused D2R path: tracked step + residual + psum
                     # fold into the chunk's last sweep.
                     ue, k = engine.run_convergence_fused(

@@ -168,6 +168,19 @@ def main() -> int:
     assert first_exit("pallas") == first_exit("serial") == 12
     print("PASS C2R fused-residual early exit (steps_done parity)")
 
+    # Small-interval fused convergence (interval < T — viable since the
+    # round-5 chunk-tail schedule lets the resid sweep depth adapt):
+    # state + steps_done vs serial, pallas and hybrid.
+    want = run("serial", 2048, 2048, 23, convergence=True, interval=5,
+               sensitivity=0.0)
+    for mode in ("pallas", "hybrid"):
+        cfg = HeatConfig(nxprob=2048, nyprob=2048, steps=23, mode=mode,
+                         convergence=True, interval=5, sensitivity=0.0)
+        r = Heat2DSolver(cfg).run(timed=False)
+        assert int(r.steps_done) == 23, (mode, r.steps_done)
+        check(f"fused conv interval<T ({mode}, iv=5, 23 steps)", r.u,
+              want)
+
     # D2R (the fused residual on the hybrid shard sweeps): same step
     # form and per-cell op sequence as C2R, so the final state must be
     # BITWISE equal to pallas's, with the same early-exit count.
