@@ -72,6 +72,10 @@ def build_record(value: float, method: str, elapsed: float,
         "method": method,
         "end_to_end_s": round(elapsed, 4),
     }
+    # Unified record envelope (obs/record.py): schema tag + execution
+    # context beside the driver-contract keys above, which stay as-is.
+    from heat2d_tpu.obs.record import attach_context
+    attach_context(rec, "bench")
     bound = calibrated_bound_mcells(nx, ny)
     if bound is not None and method == "two-point" and mode == "pallas":
         # Only the pallas route's two-point marginal is comparable to

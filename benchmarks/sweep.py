@@ -208,7 +208,11 @@ def run_point(mode, nx, ny, steps, gridx=1, gridy=1, convergence=False,
     if ref_mc is not None:
         rec["ref_cuda_mcells_per_s"] = ref_mc
         rec["vs_ref_cuda"] = round(rec["mcells_per_s"] / ref_mc, 2)
-    return rec
+    # Unified record envelope (obs/record.py): every sweep row carries
+    # the same schema tag + execution context as the CLI and bench
+    # records (the three divergent shapes collapsed into one).
+    from heat2d_tpu.obs.record import attach_context
+    return attach_context(rec, "sweep-point")
 
 
 def mesh_shapes(n_devices):

@@ -92,10 +92,21 @@ def build_parser() -> argparse.ArgumentParser:
                    help="checkpoint to resume from (remaining steps run)")
     o.add_argument("--run-record", default=None,
                    help="path for the JSON run record")
+    o.add_argument("--metrics-out", default=None, metavar="PATH",
+                   help="write the run's telemetry as JSONL (metrics "
+                        "registry events + snapshot + the unified run "
+                        "record); on convergence runs this also enables "
+                        "in-loop residual streaming out of the compiled "
+                        "loop (obs/ subsystem). Off by default: the "
+                        "timed hot path is byte-identical without it")
     o.add_argument("--profile", default=None, metavar="LOGDIR",
                    help="capture a jax.profiler device trace of the timed "
-                        "run (the mpiP analogue; view with tensorboard "
-                        "--logdir or ui.perfetto.dev)")
+                        "run (the mpiP analogue; digest it with "
+                        "heat2d-tpu-prof LOGDIR, or view with "
+                        "tensorboard --logdir / ui.perfetto.dev)")
+    p.add_argument("--log-level", default=None,
+                   choices=["debug", "info", "warning", "error"],
+                   help="python logging level for the heat2d_tpu loggers")
     p.add_argument("--accum-dtype", default="float32",
                    choices=["float32", "float64"],
                    help="float64 mirrors the C reference's double promotion")
@@ -280,6 +291,17 @@ def _run_ensemble_cli(args, cfg) -> int:
 
     primary = jax.process_index() == 0
     sharded = cfg.mode in ("dist1d", "dist2d", "hybrid")
+
+    registry = telemetry = None
+    if args.metrics_out:
+        from heat2d_tpu.obs import MetricsRegistry, TelemetryStream
+        registry = MetricsRegistry()
+        if cfg.convergence and not sharded and spatial_grid is None:
+            # Chunk-progress streaming only where the tap is actually
+            # wired (timed_ensemble nulls it on sharded/spatial meshes:
+            # device-local member vectors aren't meaningful
+            # cluster-wide).
+            telemetry = TelemetryStream(registry=registry)
     if primary:
         print(f"Starting ensemble of {len(cxs)} members"
               + (f" over {len(jax.devices())} devices" if sharded else ""))
@@ -295,7 +317,9 @@ def _run_ensemble_cli(args, cfg) -> int:
             cfg.nxprob, cfg.nyprob, cfg.steps, cxs, cys, sharded=sharded,
             convergence=cfg.convergence, interval=cfg.interval,
             sensitivity=cfg.sensitivity, spatial_grid=spatial_grid,
-            halo_depth=cfg.halo_depth)
+            halo_depth=cfg.halo_depth,
+            tap=(telemetry.tap_members if telemetry is not None
+                 and spatial_grid is None else None))
     except (ConfigError, ValueError) as e:
         print(f"{e}\nQuitting...", file=sys.stderr)
         return 1
@@ -322,13 +346,26 @@ def _run_ensemble_cli(args, cfg) -> int:
                 name = f"final_m{i}.dat"
                 writer(member, os.path.join(args.outdir, name))
                 print(f"Writing {name} ...")
-        record = {
-            "config": cfg.to_dict(),
-            "elapsed_s": float(elapsed),
-            "members": [
-                {"cx": cx, "cy": cy} for cx, cy in zip(cxs, cys)],
-            "summary": ensemble_summary(batch, steps_done=steps_done),
-        }
+        from heat2d_tpu.obs.record import build_record
+        record = build_record(
+            "ensemble", config=cfg, elapsed_s=elapsed,
+            extra={
+                "members": [
+                    {"cx": cx, "cy": cy} for cx, cy in zip(cxs, cys)],
+                "summary": ensemble_summary(batch,
+                                            steps_done=steps_done),
+            })
+        if telemetry is not None and telemetry.chunk_progress():
+            # Key present only when streaming actually collected chunks
+            # (the 'jnp' method's vmapped loop ignores the tap) — an
+            # empty list would read as 'zero chunks ran'.
+            record["chunk_progress"] = telemetry.chunk_progress()
+        if registry is not None:
+            registry.gauge("elapsed_s", float(elapsed))
+            registry.gauge("members", len(cxs))
+            registry.write_jsonl(
+                args.metrics_out,
+                extra_records=[{"event": "run_record", **record}])
         if args.run_record:
             with open(args.run_record, "w") as f:
                 json.dump(record, f, indent=2)
@@ -339,6 +376,12 @@ def _run_ensemble_cli(args, cfg) -> int:
 
 def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
+    if args.log_level:
+        import logging
+        logging.basicConfig(
+            format="%(asctime)s %(name)s %(levelname)s %(message)s")
+        logging.getLogger("heat2d_tpu").setLevel(
+            getattr(logging, args.log_level.upper()))
     _apply_platform(args)
 
     multihost = (args.multihost or args.coordinator is not None
@@ -417,8 +460,25 @@ def main(argv=None) -> int:
     if cfg.convergence:
         say(f"Check for convergence every {cfg.interval} iterations")
 
+    # Telemetry (obs/): opt-in via --metrics-out. The registry records
+    # host-side metrics (always safe); the stream wires the in-loop
+    # residual tap into the compiled convergence loop (an extra
+    # debug_callback per INTERVAL — without the flag the traced program
+    # is byte-identical to the untelemetered one).
+    registry = telemetry = None
+    if args.metrics_out:
+        from heat2d_tpu.obs import MetricsRegistry, TelemetryStream
+        registry = MetricsRegistry()
+        if cfg.convergence and not args.checkpoint_every:
+            # (periodic-checkpoint segments rebuild solvers per segment
+            # with segment-local step counts — their trajectories would
+            # interleave; streaming stays off there.)
+            telemetry = TelemetryStream(registry=registry)
+        registry.event("run_start", mode=cfg.mode,
+                       grid=f"{cfg.nxprob}x{cfg.nyprob}", steps=cfg.steps)
+
     try:
-        solver = Heat2DSolver(cfg)
+        solver = Heat2DSolver(cfg, telemetry=telemetry)
     except (ConfigError, ValueError) as e:
         print(f"{e}\nQuitting...", file=sys.stderr)
         return 1
@@ -445,7 +505,8 @@ def main(argv=None) -> int:
                   f"{cfg.nyprob}\nQuitting...", file=sys.stderr)
             return 1
         remaining = max(cfg.steps - start_step, 0)
-        solver = Heat2DSolver(cfg.replace(steps=remaining))
+        solver = Heat2DSolver(cfg.replace(steps=remaining),
+                              telemetry=telemetry)
         u0 = solver.place(grid)
     else:
         u0 = solver.init_state()
@@ -538,15 +599,38 @@ def main(argv=None) -> int:
                     u_host = grid_to_host(result.u)
                 save_checkpoint(u_host, total_steps, cfg, args.checkpoint)
 
+        # Unified run record (obs/record.py): to_record() carries the
+        # shared envelope (schema, timestamp, device, world) + the
+        # compile/warmup metric; the CLI adds its mode-specific extras.
         record = result.to_record()
         record["total_steps_including_resume"] = total_steps
-        # SURVEY.md §5.5: the structured run record carries the execution
-        # context the reference only printf'd (or didn't record at all).
-        from heat2d_tpu.utils.device import device_summary
-        record["device"] = device_summary()
         if solver.mesh is not None:
             from heat2d_tpu.parallel.mesh import mesh_devices_summary
             record["mesh"] = mesh_devices_summary(solver.mesh)
+        if telemetry is not None:
+            # Resumed runs count engine steps from 0 (the solver is
+            # rebuilt with steps=remaining) — shift the streamed steps
+            # to ABSOLUTE step numbers so the trajectory lines up with
+            # total_steps_including_resume.
+            record["residual_trajectory"] = [
+                {"step": p["step"] + start_step,
+                 "residual": p["residual"]}
+                for p in telemetry.trajectory()]
+        if registry is not None:
+            registry.gauge("steps_done", result.steps_done)
+            registry.gauge("elapsed_s", result.elapsed)
+            if result.warmup_s is not None:
+                # Compile+warmup time — measured and KEPT now
+                # (utils/timing.TimedCall), the setup cost the timed
+                # span excludes.
+                registry.gauge("warmup_compile_s", result.warmup_s)
+            # Cluster-wide rank-max/mean/min (the mpiP table columns);
+            # a collective when multi-process, so every rank calls it.
+            record["metrics_aggregate"] = registry.aggregate_multihost()
+            if primary:
+                registry.write_jsonl(
+                    args.metrics_out,
+                    extra_records=[{"event": "run_record", **record}])
         if args.run_record and primary:
             with open(args.run_record, "w") as f:
                 json.dump(record, f, indent=2)

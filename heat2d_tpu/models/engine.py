@@ -21,14 +21,33 @@ MPI_Allreduce; here the psum/sum stays in the carry).
 or a shard-local step with ppermute halo exchange inside ``shard_map``);
 ``residual_fn`` is ``(u_new, u_old) -> scalar`` and performs its own psum
 when running sharded.
+
+In-loop telemetry: every convergence loop takes an optional ``tap`` — a
+host callback ``tap(steps_done, residual)`` invoked at each residual
+check via ``jax.debug.callback`` (fire-and-forget: the carry never syncs
+to the host). ``tap=None`` (the default) adds ZERO equations to the
+traced program, so the timed hot path is byte-identical with telemetry
+disabled — obs/stream.TelemetryStream is the standard collector.
 """
 
 from __future__ import annotations
 
-from typing import Callable
+from typing import Callable, Optional
 
+import jax
 import jax.numpy as jnp
 from jax import lax
+
+
+def _emit(tap: Optional[Callable], k, res) -> None:
+    """Stream one (steps_done, residual) pair to the host collector.
+    Python-level guard: no tap, no trace-time footprint at all. Call
+    sites must pass values that already exist in the trace (or guard
+    derived arguments themselves) — evaluating a fresh ``k + n`` in the
+    argument list would leave a dead equation in the tapless jaxpr and
+    break the byte-identical-hot-path contract the tests pin."""
+    if tap is not None:
+        jax.debug.callback(tap, k, res, ordered=False)
 
 
 def run_fixed(step_fn: Callable, u0, steps: int):
@@ -38,7 +57,8 @@ def run_fixed(step_fn: Callable, u0, steps: int):
 
 
 def run_convergence(step_fn: Callable, residual_fn: Callable, u0,
-                    steps: int, interval: int, sensitivity: float):
+                    steps: int, interval: int, sensitivity: float,
+                    tap: Optional[Callable] = None):
     """Run up to ``steps`` steps, checking the global residual every
     ``interval`` steps and stopping early once it falls below
     ``sensitivity``. Returns (u_final, steps_done).
@@ -60,7 +80,9 @@ def run_convergence(step_fn: Callable, residual_fn: Callable, u0,
 
         u_prev, u = lax.fori_loop(0, n, body, (u_prev, u))
         res = residual_fn(u, u_prev).astype(jnp.float32)
-        return (u_prev, u, k + n, res)
+        k = k + n
+        _emit(tap, k, res)
+        return (u_prev, u, k, res)
 
     def cond(carry):
         _, _, k, res = carry
@@ -73,7 +95,8 @@ def run_convergence(step_fn: Callable, residual_fn: Callable, u0,
 
 
 def run_convergence_fused(chunk_resid_fn, multi_step_fn, u0,
-                          steps: int, interval: int, sensitivity: float):
+                          steps: int, interval: int, sensitivity: float,
+                          tap: Optional[Callable] = None):
     """run_convergence_chunked for engines whose multi-step primitive can
     emit the residual itself: ``chunk_resid_fn(u, n) -> (u, residual)``
     advances n steps and returns Σ(Δu)² of the final plane pair — the
@@ -83,7 +106,15 @@ def run_convergence_fused(chunk_resid_fn, multi_step_fn, u0,
     chunk's last band sweep). Schedule and early-exit semantics
     are identical to run_convergence_chunked; only the residual's
     summation order differs (per-band partials), an f32-ulp deviation of
-    the same class as the FMA step form such engines already use."""
+    the same class as the FMA step form such engines already use.
+
+    Telemetry note: the trailing ``steps % interval`` remainder runs
+    UNCHECKED (no residual is computed for it — the intended reference
+    schedule), so a tap streams one point per full INTERVAL chunk only;
+    on non-converging runs the trajectory ends ``steps % interval``
+    short of steps_done. The unfused run_convergence checks (and
+    streams) its final partial chunk, so trajectory shapes differ
+    between engine routes for non-multiple step budgets."""
     if steps:
         interval = max(1, min(interval, steps))
     n_chunks = steps // interval if interval else 0
@@ -92,7 +123,11 @@ def run_convergence_fused(chunk_resid_fn, multi_step_fn, u0,
     def body(carry):
         u, c, _ = carry
         u, res = chunk_resid_fn(u, interval)
-        return (u, c + 1, res.astype(jnp.float32))
+        res = res.astype(jnp.float32)
+        c = c + 1
+        if tap is not None:   # guard: c * interval is telemetry-only
+            _emit(tap, c * interval, res)
+        return (u, c, res)
 
     def cond(carry):
         _, c, res = carry
@@ -111,7 +146,8 @@ def run_convergence_fused(chunk_resid_fn, multi_step_fn, u0,
 
 
 def run_convergence_chunked(multi_step_fn, step_fn, residual_fn, u0,
-                            steps: int, interval: int, sensitivity: float):
+                            steps: int, interval: int, sensitivity: float,
+                            tap: Optional[Callable] = None):
     """Convergence loop for engines with an efficient *static* multi-step
     primitive (e.g. the VMEM-resident Pallas kernel, where N steps run in
     one kernel invocation): each full INTERVAL chunk is ``interval-1``
@@ -127,4 +163,4 @@ def run_convergence_chunked(multi_step_fn, step_fn, residual_fn, u0,
         return u_new, residual_fn(u_new, u_prev)
 
     return run_convergence_fused(chunk_resid, multi_step_fn, u0,
-                                 steps, interval, sensitivity)
+                                 steps, interval, sensitivity, tap=tap)

@@ -425,8 +425,29 @@ def _ens_resid_bm(m_pad, bm, row_bytes, t):
     return None
 
 
+def _emit_members(tap, chunk, chunks, res, done) -> None:
+    """Chunk-progress stream for the batched convergence loops: one
+    ``jax.debug.callback`` per chunk with the per-member state vectors
+    (steps-done, residuals, done flags) — obs/stream.TelemetryStream.
+    tap_members is the standard collector. Python-level guard: tap=None
+    adds zero equations (the no-overhead guarantee the tests pin), so
+    call sites guard any argument computed only for telemetry (e.g.
+    ``chunks * interval``) behind their own ``tap is not None``."""
+    if tap is not None:
+        jax.debug.callback(tap, chunk, chunks, res, done, ordered=False)
+
+
+def _flush_taps() -> None:
+    """Drain queued ``jax.debug.callback`` work so a collector read
+    immediately after a run sees every chunk (the callbacks are
+    fire-and-forget and may still be in flight when the outputs are
+    ready)."""
+    from heat2d_tpu.obs.stream import flush_taps
+    flush_taps()
+
+
 def _run_batch_conv_window(u0, cxs, cys, *, steps, interval, sensitivity,
-                           bm, m_pad, t, resid_bm):
+                           bm, m_pad, t, resid_bm, tap=None):
     """Fused-residual convergence for window-routed HBM members: each
     chunk's residual folds into its last sweep (the C2R schedule,
     member-wise) instead of the pair-tracked chunk(n-1)+chunk(1)+
@@ -473,7 +494,10 @@ def _run_batch_conv_window(u0, cxs, cys, *, steps, interval, sensitivity,
         # un-converge them (done is a monotone union).
         chunks = jnp.where(done, chunks, chunks + 1)
         done = done | (res < sensitivity)
-        return (u, i + 1, chunks, done)
+        i = i + 1
+        if tap is not None:   # chunks * iv is telemetry-only
+            _emit_members(tap, i, chunks * iv, res, done)
+        return (u, i, chunks, done)
 
     def cond(carry):
         _, i, _, done = carry
@@ -490,7 +514,8 @@ def _run_batch_conv_window(u0, cxs, cys, *, steps, interval, sensitivity,
     return u[:, :nx], k
 
 
-def _band_conv_runner(u0, cxs, cys, *, steps, interval, sensitivity):
+def _band_conv_runner(u0, cxs, cys, *, steps, interval, sensitivity,
+                      tap=None):
     """Convergence runner for method='band': the fused window path when
     its gates hold (TPU, lane-aligned width, on-table batched envelope;
     any interval >= 1 since the chunk-tail resid schedule), else the
@@ -511,11 +536,11 @@ def _band_conv_runner(u0, cxs, cys, *, steps, interval, sensitivity):
                 return _run_batch_conv_window(
                     u0, cxs, cys, steps=steps, interval=interval,
                     sensitivity=sensitivity, bm=bm, m_pad=m_pad, t=t,
-                    resid_bm=rbm)
+                    resid_bm=rbm, tap=tap)
     return _run_batch_conv_kernel(u0, cxs, cys, steps=steps,
                                   interval=interval,
                                   sensitivity=sensitivity,
-                                  runner=_run_batch_band)
+                                  runner=_run_batch_band, tap=tap)
 
 
 def _run_batch_window(u0, cxs, cys, *, steps, bm, m_pad, t):
@@ -600,7 +625,7 @@ def _run_batch_conv_jnp(u0, cxs, cys, *, steps, interval, sensitivity):
 
 
 def _run_batch_conv_kernel(u0, cxs, cys, *, steps, interval, sensitivity,
-                           runner):
+                           runner, tap=None):
     """Batched engine.run_convergence_chunked over the kernel runners:
     each chunk is ``interval-1`` fused steps plus one tracked step; the
     residual is per-member; converged members freeze (their stored plane
@@ -632,7 +657,10 @@ def _run_batch_conv_kernel(u0, cxs, cys, *, steps, interval, sensitivity,
         u = jnp.where(done[:, None, None], u, u_new)
         chunks = jnp.where(done, chunks, chunks + 1)
         done = done | (res < sensitivity)
-        return (u, i + 1, chunks, done)
+        i = i + 1
+        if tap is not None:   # chunks * interval is telemetry-only
+            _emit_members(tap, i, chunks * interval, res, done)
+        return (u, i, chunks, done)
 
     def cond(carry):
         _, i, _, done = carry
@@ -650,10 +678,15 @@ def _run_batch_conv_kernel(u0, cxs, cys, *, steps, interval, sensitivity,
     return u, k
 
 
-def _conv_runner(method, steps, interval, sensitivity):
+def _conv_runner(method, steps, interval, sensitivity, tap=None):
     """The jitted (u0, cxs, cys) -> (u, steps_done) convergence runner
     for a method — vmap'd engine loop for 'jnp', the batched chunked
-    loop over the corresponding kernel runner otherwise."""
+    loop over the corresponding kernel runner otherwise.
+
+    ``tap``: optional chunk-progress stream (_emit_members). The 'jnp'
+    method ignores it: its while_loop is vmapped per member, and a
+    callback under vmap would not see the batch coherently — the batched
+    kernel loops are the streaming routes."""
     if method == "jnp":
         return functools.partial(_run_batch_conv_jnp, steps=steps,
                                  interval=interval,
@@ -661,25 +694,34 @@ def _conv_runner(method, steps, interval, sensitivity):
     if method == "band":
         return functools.partial(_band_conv_runner, steps=steps,
                                  interval=interval,
-                                 sensitivity=sensitivity)
+                                 sensitivity=sensitivity, tap=tap)
     return functools.partial(_run_batch_conv_kernel, steps=steps,
                              interval=interval, sensitivity=sensitivity,
-                             runner=_BATCH_RUNNERS[method])
+                             runner=_BATCH_RUNNERS[method], tap=tap)
 
 
 def run_ensemble_convergence(nx: int, ny: int, steps: int, interval: int,
                              sensitivity: float, cxs, cys, u0=None,
-                             method: str = "auto"):
+                             method: str = "auto", tap=None):
     """Ensemble with per-member convergence early-exit — the intended
     grad1612_mpi_heat.c:262-271 residual schedule applied member-wise
     (the reference could only run one instance per launch; SURVEY.md
     §2.3). Returns (batch, steps_done): converged members froze at
     their exit plane; ``steps_done[i]`` is member i's iteration count,
-    a multiple of ``interval`` unless the step budget ran out first."""
+    a multiple of ``interval`` unless the step budget ran out first.
+
+    ``tap``: optional chunk-progress telemetry stream (see
+    obs/stream.TelemetryStream.tap_members); honored by the batched
+    kernel methods, ignored by 'jnp' (vmapped loop)."""
     cxs, cys, u0 = _validated_batch(nx, ny, cxs, cys, u0)
     method = _pick_method(method, nx, ny)
-    fn = jax.jit(_conv_runner(method, steps, interval, sensitivity))
-    return fn(u0, cxs, cys)
+    fn = jax.jit(_conv_runner(method, steps, interval, sensitivity,
+                              tap=tap))
+    out = fn(u0, cxs, cys)
+    if tap is not None:
+        out = jax.block_until_ready(out)
+        _flush_taps()
+    return out
 
 
 def _pick_method(method, nx, ny):
@@ -927,7 +969,7 @@ def timed_ensemble(nx: int, ny: int, steps: int, cxs, cys, u0=None,
                    method: str = "auto", sharded: bool = False,
                    devices=None, convergence: bool = False,
                    interval: int = 20, sensitivity: float = 0.1,
-                   spatial_grid=None, halo_depth=None):
+                   spatial_grid=None, halo_depth=None, tap=None):
     """(batch, steps_done, elapsed): one ensemble launch under the
     reference timing protocol (compile/warmup excluded, scalar-readback
     fence) — the CLI entry point. ``sharded=True`` spreads members over
@@ -950,12 +992,18 @@ def timed_ensemble(nx: int, ny: int, steps: int, cxs, cys, u0=None,
                 k[:b] if convergence else None, elapsed)
     method = _pick_method(method, nx, ny)
     if convergence:
-        local = _conv_runner(method, steps, interval, sensitivity)
+        # tap only on the single-process path: under a batch mesh each
+        # device's callback would carry device-local member vectors
+        # (indices no longer meaningful cluster-wide).
+        local = _conv_runner(method, steps, interval, sensitivity,
+                             tap=None if sharded else tap)
         if sharded:
             fn, args, b = _shard_local_fn(local, u0, cxs, cys, devices)
         else:
             fn, args, b = jax.jit(local), (u0, cxs, cys), cxs.shape[0]
         (u, k), elapsed = timed_call(fn, *args)
+        if tap is not None and not sharded:
+            _flush_taps()
         return u[:b], k[:b], elapsed
     if sharded:
         fn, args, b = _build_sharded(steps, method, u0, cxs, cys, devices)

@@ -23,6 +23,7 @@ here steps from the real initial condition and returns the final grid.
 from __future__ import annotations
 
 import dataclasses
+import logging
 from typing import Optional
 
 import jax
@@ -33,6 +34,8 @@ from heat2d_tpu.config import ConfigError, HeatConfig
 from heat2d_tpu.models import engine
 from heat2d_tpu.ops.init import inidat
 from heat2d_tpu.ops.stencil import residual_sq, stencil_step
+
+log = logging.getLogger("heat2d_tpu.solver")
 from heat2d_tpu.parallel.mesh import make_mesh
 from heat2d_tpu.parallel.sharded import make_sharded_runner, sharded_inidat
 from heat2d_tpu.utils.timing import timed_call
@@ -44,6 +47,10 @@ class RunResult:
     steps_done: int
     elapsed: float          # seconds, reference timing protocol
     config: HeatConfig
+    # Compile+warmup wall-clock of the priming run — the setup cost the
+    # timed span excludes (utils/timing.TimedCall); None when untimed or
+    # the warmup was skipped (repeat calls of a warm runner).
+    warmup_s: Optional[float] = None
 
     @property
     def mcells_per_s(self) -> float:
@@ -55,18 +62,26 @@ class RunResult:
         return nx * ny * self.steps_done / self.elapsed / 1e6
 
     def to_record(self) -> dict:
-        """Structured run record (SURVEY.md §5.5)."""
-        return {
-            "config": self.config.to_dict(),
-            "steps_done": int(self.steps_done),
-            "elapsed_s": float(self.elapsed),
-            "mcells_per_s": float(self.mcells_per_s),
-        }
+        """Structured run record — the unified schema (obs/record.py,
+        SURVEY.md §5.5): payload keys unchanged, plus the shared envelope
+        (schema tag, timestamp, device, world) and the compile/warmup
+        metric."""
+        from heat2d_tpu.obs.record import build_record
+        return build_record(
+            "run", config=self.config, steps_done=self.steps_done,
+            elapsed_s=self.elapsed, mcells_per_s=self.mcells_per_s,
+            warmup_s=self.warmup_s)
 
 
 class Heat2DSolver:
-    def __init__(self, config: HeatConfig, devices=None):
+    def __init__(self, config: HeatConfig, devices=None, telemetry=None):
+        """``telemetry``: optional obs.stream.TelemetryStream — wires the
+        convergence loops' residual tap into the compiled program (an
+        extra debug_callback per INTERVAL chunk). None (default) leaves
+        the traced program byte-identical to the untelemetered one, so
+        the timed hot path pays zero cost."""
         self.config = config
+        self.telemetry = telemetry
         if (config.accum_dtype == "float64"
                 and not jax.config.jax_enable_x64):
             # Without x64, astype(float64) silently truncates to f32 and
@@ -127,9 +142,15 @@ class Heat2DSolver:
         if self._runner is not None:
             return self._runner
         cfg = self.config
+        tap = self.telemetry.tap if self.telemetry is not None else None
+        log.debug("building runner: mode=%s %dx%d steps=%d "
+                  "convergence=%s telemetry=%s", cfg.mode, cfg.nxprob,
+                  cfg.nyprob, cfg.steps, cfg.convergence,
+                  tap is not None)
         if self.mesh is not None:
             self._runner, self._sharding = make_sharded_runner(
-                cfg, self.mesh, chunk_kernel=self._chunk_kernel())
+                cfg, self.mesh, chunk_kernel=self._chunk_kernel(),
+                tap=tap)
             return self._runner
 
         accum = jnp.dtype(cfg.accum_dtype)
@@ -141,7 +162,7 @@ class Heat2DSolver:
                 raise ConfigError(
                     f"mode 'pallas' needs the Pallas kernel, which failed "
                     f"to import: {e}") from e
-            self._runner = make_single_chip_runner(cfg)
+            self._runner = make_single_chip_runner(cfg, tap=tap)
             return self._runner
 
         def step(u):
@@ -163,7 +184,7 @@ class Heat2DSolver:
                 # sweep_conv.md round 4).
                 return engine.run_convergence_chunked(
                     multi, step, lambda a, b: residual_sq(a, b, accum),
-                    u, cfg.steps, cfg.interval, cfg.sensitivity)
+                    u, cfg.steps, cfg.interval, cfg.sensitivity, tap=tap)
             return engine.run_fixed(step, u, cfg.steps)
 
         self._runner = jax.jit(run)
@@ -185,16 +206,28 @@ class Heat2DSolver:
         if u0 is None:
             u0 = self.init_state()
         runner = self.make_runner()
+        warmup_s = None
         if timed:
-            (u, k), elapsed = timed_call(runner, u0, warmup=warmup)
+            tc = timed_call(runner, u0, warmup=warmup)
+            (u, k), elapsed = tc
+            warmup_s = tc.warmup_s
         else:
             u, k = jax.block_until_ready(runner(u0))
             elapsed = float("nan")
+        if self.telemetry is not None:
+            # Drain in-flight debug_callback work so the stream is
+            # complete when the caller reads it right after run().
+            from heat2d_tpu.obs.stream import flush_taps
+            flush_taps()
         if gather:
             from heat2d_tpu.parallel.multihost import gather_to_host
             u = gather_to_host(u)
             if u.shape != self.config.shape:
                 # Strip the equal-shard padding (uneven decomposition).
                 u = u[:self.config.nxprob, :self.config.nyprob]
+        log.info("run done: steps_done=%d elapsed_s=%.6g warmup_s=%s",
+                 int(k), elapsed,
+                 f"{warmup_s:.6g}" if warmup_s is not None else None)
         return RunResult(u=u, steps_done=int(k),
-                         elapsed=elapsed, config=self.config)
+                         elapsed=elapsed, config=self.config,
+                         warmup_s=warmup_s)

@@ -1,0 +1,29 @@
+"""Run-telemetry subsystem — the mpiP analogue as a first-class layer.
+
+The reference's authors justified every design decision with a profile
+(Report.pdf p.34-37: per-rank AppTime/MPITime, per-callsite shares —
+File_open 29%, Waitall 21%). This package is that discipline built into
+the framework (SURVEY.md §5.1):
+
+- ``metrics``      — process-local registry (counters, gauges, timing
+                     histograms, labeled series) with JSONL and
+                     Prometheus-text export, plus multihost aggregation
+                     (rank-max/rank-mean, the mpiP table columns).
+- ``stream``       — opt-in residual-trajectory / chunk-progress
+                     streaming out of the compiled convergence loops via
+                     ``jax.debug.callback`` (off by default: the timed
+                     hot path is byte-identical when disabled).
+- ``record``       — the ONE run-record schema every emitter shares
+                     (CLI, bench.py, benchmarks/sweep.py).
+- ``trace_report`` — ``heat2d-tpu-prof``: parse a captured
+                     ``jax.profiler.trace`` logdir into the mpiP-style
+                     digest (top ops by self-time, compute vs collective
+                     vs host shares per device).
+"""
+
+from heat2d_tpu.obs.metrics import MetricsRegistry, get_registry
+from heat2d_tpu.obs.record import RECORD_SCHEMA, attach_context, build_record
+from heat2d_tpu.obs.stream import TelemetryStream, flush_taps
+
+__all__ = ["MetricsRegistry", "get_registry", "TelemetryStream",
+           "flush_taps", "RECORD_SCHEMA", "attach_context", "build_record"]

@@ -1,0 +1,272 @@
+"""Process-local metrics registry — counters, gauges, timing histograms,
+labeled series — with JSONL and Prometheus-text export.
+
+The reference's observability was printf (``Elapsed time: %e sec``) plus
+the external mpiP profiler's per-rank tables (Report.pdf p.34-37). This
+registry is the in-framework replacement: every subsystem records into
+one process-local object, and a multihost run aggregates the registries
+cluster-wide via ``process_allgather`` so the exported numbers are the
+rank-max / rank-mean columns of the mpiP tables rather than whichever
+rank happened to write the file.
+
+Pure host-side Python: nothing here touches a traced value, so recording
+a metric never changes a compiled program (the streaming taps in
+``obs.stream`` are the only telemetry that enters jit, and they are
+opt-in).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import datetime
+import json
+import logging
+import math
+import re
+import threading
+import time
+
+log = logging.getLogger("heat2d_tpu.obs")
+
+
+def _utc_now_iso() -> str:
+    return datetime.datetime.now(datetime.timezone.utc).isoformat(
+        timespec="seconds")
+
+
+def _label_key(labels: dict) -> tuple:
+    return tuple(sorted((str(k), str(v)) for k, v in labels.items()))
+
+
+_PROM_NAME = re.compile(r"[^a-zA-Z0-9_:]")
+
+
+def _prom_name(name: str) -> str:
+    n = _PROM_NAME.sub("_", name)
+    return n if not n[:1].isdigit() else "_" + n
+
+
+def _prom_value(v: str) -> str:
+    """Escape a label value per the Prometheus text-format spec."""
+    return (v.replace("\\", r"\\").replace('"', r'\"')
+            .replace("\n", r"\n"))
+
+
+def _prom_labels(labels: tuple) -> str:
+    if not labels:
+        return ""
+    inner = ",".join(f'{_prom_name(k)}="{_prom_value(v)}"'
+                     for k, v in labels)
+    return "{" + inner + "}"
+
+
+def quantile(sorted_samples: list, q: float) -> float:
+    """Nearest-rank quantile of an already-sorted sample list."""
+    if not sorted_samples:
+        return float("nan")
+    i = max(0, math.ceil(q * len(sorted_samples)) - 1)
+    return float(sorted_samples[i])
+
+
+class MetricsRegistry:
+    """Counters, gauges, timing histograms and labeled series.
+
+    Thread-safe (``jax.debug.callback`` may fire from runtime threads).
+    Identity of a metric is (name, labels): the same name with different
+    labels is a different time series, as in Prometheus.
+    """
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._counters: dict = {}
+        self._gauges: dict = {}
+        self._histograms: dict = {}
+        self._series: dict = {}
+        self._events: list = []
+
+    # -- recording ----------------------------------------------------- #
+
+    def counter(self, name: str, value: float = 1.0, **labels) -> None:
+        """Monotonically add ``value`` to the counter."""
+        k = (name, _label_key(labels))
+        with self._lock:
+            self._counters[k] = self._counters.get(k, 0.0) + float(value)
+
+    def gauge(self, name: str, value: float, **labels) -> None:
+        """Set the gauge to the latest ``value``."""
+        with self._lock:
+            self._gauges[(name, _label_key(labels))] = float(value)
+
+    def observe(self, name: str, value: float, **labels) -> None:
+        """Add one sample to the (timing) histogram."""
+        k = (name, _label_key(labels))
+        with self._lock:
+            self._histograms.setdefault(k, []).append(float(value))
+
+    def series(self, name: str, x, y, **labels) -> None:
+        """Append an (x, y) point to a labeled series — e.g. the residual
+        trajectory (x=step, y=residual) or chunk progress."""
+        k = (name, _label_key(labels))
+        with self._lock:
+            self._series.setdefault(k, []).append((x, y))
+
+    def event(self, kind: str, **fields) -> None:
+        """Append a structured event to the JSONL event log."""
+        with self._lock:
+            self._events.append(
+                {"event": kind, "ts": _utc_now_iso(), **fields})
+
+    @contextlib.contextmanager
+    def timer(self, name: str, **labels):
+        """Time the enclosed block into the ``name`` histogram (seconds)."""
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            self.observe(name, time.perf_counter() - t0, **labels)
+
+    # -- views --------------------------------------------------------- #
+
+    @staticmethod
+    def _hist_summary(samples: list) -> dict:
+        s = sorted(samples)
+        return {
+            "count": len(s),
+            "sum": float(sum(s)),
+            "min": float(s[0]),
+            "max": float(s[-1]),
+            "mean": float(sum(s) / len(s)),
+            "p50": quantile(s, 0.50),
+            "p90": quantile(s, 0.90),
+            "p99": quantile(s, 0.99),
+        }
+
+    def snapshot(self) -> dict:
+        """Point-in-time view: counters/gauges flat, histograms
+        summarized, series as point lists."""
+        with self._lock:
+            return {
+                "counters": {self._fmt(k): v
+                             for k, v in self._counters.items()},
+                "gauges": {self._fmt(k): v
+                           for k, v in self._gauges.items()},
+                "histograms": {self._fmt(k): self._hist_summary(v)
+                               for k, v in self._histograms.items()},
+                "series": {self._fmt(k): [[x, y] for x, y in v]
+                           for k, v in self._series.items()},
+            }
+
+    def events(self) -> list:
+        with self._lock:
+            return list(self._events)
+
+    @staticmethod
+    def _fmt(key: tuple) -> str:
+        name, labels = key
+        if not labels:
+            return name
+        return name + "{" + ",".join(f"{k}={v}" for k, v in labels) + "}"
+
+    # -- export -------------------------------------------------------- #
+
+    def write_jsonl(self, path: str, extra_records=()) -> None:
+        """JSONL event log: every recorded event, a final ``snapshot``
+        line, then any caller-supplied records (e.g. the run record)."""
+        events = self.events()
+        with open(path, "w") as f:
+            for ev in events:
+                f.write(json.dumps(ev) + "\n")
+            f.write(json.dumps({"event": "snapshot",
+                                "ts": _utc_now_iso(),
+                                **self.snapshot()}) + "\n")
+            for rec in extra_records:
+                f.write(json.dumps(rec) + "\n")
+        log.debug("wrote %d events + snapshot + %d records to %s",
+                  len(events), len(tuple(extra_records)), path)
+
+    def prometheus_text(self) -> str:
+        """Prometheus text exposition of counters, gauges, and histogram
+        sum/count (the scrape-friendly view of the same registry)."""
+        lines = []
+        with self._lock:
+            counters = dict(self._counters)
+            gauges = dict(self._gauges)
+            hists = {k: list(v) for k, v in self._histograms.items()}
+        seen = set()
+
+        def typ(name, kind):
+            if name not in seen:
+                seen.add(name)
+                lines.append(f"# TYPE {name} {kind}")
+
+        for (name, labels), v in sorted(counters.items()):
+            n = _prom_name(name)
+            typ(n, "counter")
+            lines.append(f"{n}{_prom_labels(labels)} {v}")
+        for (name, labels), v in sorted(gauges.items()):
+            n = _prom_name(name)
+            typ(n, "gauge")
+            lines.append(f"{n}{_prom_labels(labels)} {v}")
+        for (name, labels), samples in sorted(hists.items()):
+            n = _prom_name(name)
+            typ(n, "summary")
+            lines.append(
+                f"{n}_sum{_prom_labels(labels)} {float(sum(samples))}")
+            lines.append(f"{n}_count{_prom_labels(labels)} {len(samples)}")
+        return "\n".join(lines) + "\n"
+
+    # -- multihost aggregation ----------------------------------------- #
+
+    def aggregate_multihost(self) -> dict:
+        """Cluster-wide view of counters and gauges: rank-max, rank-mean,
+        rank-min over processes via ``process_allgather`` — the shape of
+        the reference's mpiP per-rank AppTime/MPITime table (Report.pdf
+        p.34: the table's value is exactly that it shows the spread over
+        ranks, not one rank's number). Single-process runs return the
+        local values in the same shape so consumers need no branch.
+
+        Every process must call this with the same metric names in the
+        same order (it is a collective when process_count > 1) — the
+        registry enforces a sorted key order for exactly that reason.
+        """
+        import jax
+
+        with self._lock:
+            scalars = {**{("counter",) + k: v
+                          for k, v in self._counters.items()},
+                       **{("gauge",) + k: v
+                          for k, v in self._gauges.items()}}
+        keys = sorted(scalars)
+        values = [scalars[k] for k in keys]
+        if jax.process_count() > 1:
+            import numpy as np
+            from jax.experimental import multihost_utils
+            gathered = multihost_utils.process_allgather(
+                np.asarray(values, dtype=np.float64))
+            gathered = gathered.reshape(jax.process_count(), len(keys))
+        else:
+            gathered = [values]
+        out = {}
+        for i, k in enumerate(keys):
+            col = [row[i] for row in gathered]
+            out[self._fmt(k[1:])] = {
+                "rank_max": float(max(col)),
+                "rank_mean": float(sum(col) / len(col)),
+                "rank_min": float(min(col)),
+            }
+        return out
+
+
+_default_registry = MetricsRegistry()
+
+
+def get_registry() -> MetricsRegistry:
+    """The process-default registry (module-level singleton)."""
+    return _default_registry
+
+
+def reset_registry() -> MetricsRegistry:
+    """Fresh default registry (test isolation); returns the new one."""
+    global _default_registry
+    _default_registry = MetricsRegistry()
+    return _default_registry

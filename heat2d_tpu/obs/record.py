@@ -1,0 +1,68 @@
+"""The ONE run-record schema.
+
+Before this module the repo had three divergent ad-hoc record shapes
+(``RunResult.to_record``, the CLI's JSON record, ``bench.py``'s driver
+line, plus the sweep harness's rows). Every emitter now shares one
+envelope: a ``schema`` tag, the record ``kind``, an ISO timestamp, the
+jax version, the device summary, and the multihost world — the execution
+context the reference only printf'd (SURVEY.md §5.5). Payload keys
+(config, timings, throughput, suite columns) ride beside the envelope so
+existing consumers keep working.
+"""
+
+from __future__ import annotations
+
+import datetime
+
+RECORD_SCHEMA = "heat2d-tpu/run-record/v1"
+
+
+def run_context() -> dict:
+    """The shared envelope: schema tag + execution context."""
+    import jax
+
+    from heat2d_tpu.parallel.multihost import world_summary
+    from heat2d_tpu.utils.device import device_summary
+
+    return {
+        "schema": RECORD_SCHEMA,
+        "timestamp": datetime.datetime.now(
+            datetime.timezone.utc).isoformat(timespec="seconds"),
+        "jax_version": jax.__version__,
+        "device": device_summary(),
+        "world": world_summary(),
+    }
+
+
+def attach_context(rec: dict, kind: str) -> dict:
+    """Add the shared envelope to an existing record IN PLACE (returns it).
+    Keys the record already carries are kept — emitters may pre-fill e.g.
+    ``device`` with something richer."""
+    rec.setdefault("kind", kind)
+    for k, v in run_context().items():
+        rec.setdefault(k, v)
+    return rec
+
+
+def build_record(kind: str, config=None, steps_done=None, elapsed_s=None,
+                 mcells_per_s=None, warmup_s=None, extra=None) -> dict:
+    """Unified run record. ``config`` may be a HeatConfig or a dict;
+    ``warmup_s`` is the compile+warmup time the timed span excludes
+    (utils/timing.py) — a first-class metric here, not a discard.
+    ``extra`` merges payload keys (existing keys win over the envelope,
+    so kind-specific shapes stay stable)."""
+    rec: dict = {}
+    if config is not None:
+        rec["config"] = (config if isinstance(config, dict)
+                         else config.to_dict())
+    if steps_done is not None:
+        rec["steps_done"] = int(steps_done)
+    if elapsed_s is not None:
+        rec["elapsed_s"] = float(elapsed_s)
+    if mcells_per_s is not None:
+        rec["mcells_per_s"] = float(mcells_per_s)
+    if warmup_s is not None:
+        rec["warmup_s"] = float(warmup_s)
+    if extra:
+        rec.update(extra)
+    return attach_context(rec, kind)

@@ -1,0 +1,97 @@
+"""In-loop telemetry streaming — residual trajectories and chunk progress
+out of the COMPILED convergence loops.
+
+The reference could only see its convergence residual by recompiling with
+DEBUG printf; here the compiled ``lax.while_loop`` emits each chunk's
+(step, residual) pair through ``jax.debug.callback`` into a host-side
+collector — without ever syncing the loop itself to the host (the
+callback is fire-and-forget; the carry never leaves the device).
+
+Strictly opt-in: the engine/ensemble/sharded loops take ``tap=None`` by
+default and add ZERO equations to the traced program when no tap is
+given, so the timed hot path is byte-identical with telemetry disabled
+(tests pin the jaxpr). Inside ``shard_map`` the callback fires once per
+shard with the same psum'd residual — the stream dedupes by step, which
+is also why taps must be tolerant of replay (jax may invoke callbacks
+more than once under retracing).
+"""
+
+from __future__ import annotations
+
+import threading
+
+from heat2d_tpu.obs.metrics import MetricsRegistry
+
+
+def flush_taps() -> None:
+    """Drain queued ``jax.debug.callback`` work so a collector read
+    immediately after a run sees every chunk — the callbacks are
+    fire-and-forget and may still be in flight when the run's outputs
+    are already ready. No-op on jax versions without the barrier."""
+    import jax
+
+    barrier = getattr(jax, "effects_barrier", None)
+    if barrier is not None:
+        barrier()
+
+
+class TelemetryStream:
+    """Host-side collector for the compiled loops' telemetry taps.
+
+    ``tap`` is the scalar-residual hook (engine/sharded loops):
+    called as ``tap(step, residual)``. ``tap_members`` is the ensemble
+    hook: ``tap_members(chunk_index, steps_done, residuals, done)`` with
+    per-member vectors. Both dedupe (per step / per chunk) because
+    sharded programs fire the callback once per device with replicated
+    values.
+    """
+
+    def __init__(self, registry: MetricsRegistry | None = None):
+        self._lock = threading.Lock()
+        self._resid: dict = {}          # step -> residual
+        self._chunks: dict = {}         # chunk index -> member snapshot
+        self.registry = registry
+
+    # -- taps (called from jax.debug.callback; args are jax scalars) --- #
+
+    def tap(self, step, residual) -> None:
+        k, r = int(step), float(residual)
+        with self._lock:
+            fresh = k not in self._resid
+            if fresh:
+                self._resid[k] = r
+        if fresh and self.registry is not None:
+            self.registry.series("residual", k, r)
+
+    def tap_members(self, chunk, steps_done, residuals, done) -> None:
+        c = int(chunk)
+        snap = {
+            "chunk": c,
+            "steps_done": [int(s) for s in steps_done],
+            "residuals": [float(r) for r in residuals],
+            "done": [bool(d) for d in done],
+        }
+        with self._lock:
+            fresh = c not in self._chunks
+            if fresh:
+                self._chunks[c] = snap
+        if fresh and self.registry is not None:
+            self.registry.event("ensemble_chunk", **snap)
+
+    # -- views --------------------------------------------------------- #
+
+    def trajectory(self) -> list:
+        """Residual trajectory in step order:
+        ``[{"step": k, "residual": r}, ...]``."""
+        with self._lock:
+            return [{"step": k, "residual": self._resid[k]}
+                    for k in sorted(self._resid)]
+
+    def residuals(self) -> list:
+        """Just the residual values, in step order."""
+        return [p["residual"] for p in self.trajectory()]
+
+    def chunk_progress(self) -> list:
+        """Ensemble chunk-progress snapshots in chunk order."""
+        with self._lock:
+            return [self._chunks[c] for c in sorted(self._chunks)]

@@ -1,0 +1,280 @@
+"""``heat2d-tpu-prof`` — the mpiP-style digest of a captured device trace.
+
+The reference's profiling artifact is an mpiP report (Report.pdf p.34-37):
+per-rank AppTime/MPITime and an aggregate per-callsite table (File_open
+29% of app time, Waitall 21%, ...). The TPU analogue is a
+``jax.profiler.trace`` logdir — rich, but only viewable interactively
+(Perfetto/XProf). This tool turns the logdir into the mpiP tables as
+markdown/JSON:
+
+- **Top ops by self-time** — the per-callsite aggregate table: each HLO
+  op (kernel, collective, copy) with total seconds, share, and count.
+- **Per-device category shares** — the AppTime/MPITime analogue: compute
+  vs collective vs host/transfer vs sync seconds per device lane (mpiP's
+  "MPI%" column maps to the collective share).
+
+Usage::
+
+    heat2d-tpu --profile /tmp/trace --mode dist2d ...   # capture
+    heat2d-tpu-prof /tmp/trace                          # digest (markdown)
+    heat2d-tpu-prof /tmp/trace --format json            # digest (JSON)
+
+Parses the ``*.trace.json.gz`` Chrome-trace export jax writes into the
+logdir; works on both TPU device lanes ("XLA Ops" threads) and the CPU
+backend's thunk-executor lanes (``tf_XLA*`` threads) so the workflow is
+testable without hardware.
+"""
+
+from __future__ import annotations
+
+import argparse
+import collections
+import glob
+import gzip
+import json
+import os
+import sys
+
+DIGEST_SCHEMA = "heat2d-tpu/trace-digest/v1"
+
+#: op-name prefix -> category, first hit wins. The mpiP mapping:
+#: 'collective' is the MPITime analogue; 'host/transfer' covers the
+#: File_open/File_write class (data movement off the compute stream).
+CATEGORIES = [
+    ("all-reduce", "collective"),
+    ("all-gather", "collective"),
+    ("all-to-all", "collective"),
+    ("reduce-scatter", "collective"),
+    ("collective-permute", "collective"),
+    ("collective", "collective"),
+    ("ppermute", "collective"),
+    ("psum", "collective"),
+    ("infeed", "host/transfer"),
+    ("outfeed", "host/transfer"),
+    ("copy", "host/transfer"),
+    ("transfer", "host/transfer"),
+    ("send", "host/transfer"),
+    ("recv", "host/transfer"),
+    ("callback", "host/transfer"),
+    ("Rendezvous", "sync"),
+    ("Wait", "sync"),
+    ("barrier", "sync"),
+]
+
+#: Executor-internal events that are bookkeeping, not op self-time.
+_NOISE_PREFIXES = ("ThreadpoolListener", "ThunkExecutor", "while",
+                   "condition", "branch")
+
+
+def categorize(name: str) -> str:
+    for prefix, cat in CATEGORIES:
+        if name.startswith(prefix):
+            return cat
+    return "compute"
+
+
+def find_trace_files(logdir: str) -> list:
+    return sorted(glob.glob(
+        os.path.join(logdir, "**", "*.trace.json.gz"), recursive=True))
+
+
+def load_events(logdir: str) -> list:
+    """Merged events of the LATEST capture: jax writes one
+    ``<host>.trace.json.gz`` per host into a per-capture run directory,
+    so every file sharing the newest file's directory belongs to the
+    same multihost capture (older captures in a reused logdir are
+    skipped). Each file's pids are namespaced so same-numbered processes
+    on different hosts stay distinct lanes."""
+    paths = find_trace_files(logdir)
+    if not paths:
+        raise FileNotFoundError(
+            f"no *.trace.json.gz under {logdir} — is this a "
+            f"jax.profiler.trace logdir (heat2d-tpu --profile)?")
+    run_dir = os.path.dirname(paths[-1])
+    run_paths = [p for p in paths if os.path.dirname(p) == run_dir]
+    if len(run_paths) < len(paths):
+        print(f"note: digesting the latest capture only "
+              f"({len(run_paths)} of {len(paths)} trace files, "
+              f"under {run_dir})", file=sys.stderr)
+    events = []
+    for i, path in enumerate(run_paths):
+        with gzip.open(path) as f:
+            for e in json.load(f)["traceEvents"]:
+                if len(run_paths) > 1:
+                    if "pid" in e:
+                        e["pid"] = f"h{i}:{e['pid']}"
+                    if (e.get("ph") == "M"
+                            and e.get("name") == "process_name"):
+                        # Hosts name their devices identically
+                        # (/device:TPU:0) — prefix the host so lanes
+                        # stay per-host, like mpiP's per-rank rows.
+                        e.setdefault("args", {})["name"] = (
+                            f"h{i}:{e.get('args', {}).get('name', '')}")
+                events.append(e)
+    return events
+
+
+def _lane_names(events: list) -> tuple:
+    """(pid -> process name, (pid, tid) -> thread name) metadata maps."""
+    pids, tids = {}, {}
+    for e in events:
+        if e.get("ph") != "M":
+            continue
+        if e.get("name") == "process_name":
+            pids[e["pid"]] = e.get("args", {}).get("name", "")
+        elif e.get("name") == "thread_name":
+            tids[(e["pid"], e.get("tid"))] = e.get(
+                "args", {}).get("name", "")
+    return pids, tids
+
+
+def _is_device_lane(pname: str, tname: str) -> bool:
+    """Device-execution lanes: TPU 'XLA Ops' threads, or the CPU
+    backend's XLA executor threads (tf_XLAEigen / tf_XLA*CpuClient)."""
+    if "/device:" in pname and tname == "XLA Ops":
+        return True
+    return tname.startswith("tf_XLA")
+
+
+def digest(events: list, top: int = 25) -> dict:
+    """Aggregate trace events into the mpiP-shaped digest dict."""
+    pids, tids = _lane_names(events)
+    ops: dict = collections.defaultdict(lambda: [0.0, 0])  # name -> [s, n]
+    lanes: dict = collections.defaultdict(
+        lambda: collections.defaultdict(float))            # lane -> cat -> s
+    annotations: dict = collections.defaultdict(lambda: [0.0, 0])
+    for e in events:
+        if e.get("ph") != "X":
+            continue
+        name = e.get("name", "")
+        pname = pids.get(e["pid"], "")
+        tname = tids.get((e["pid"], e.get("tid")), "")
+        dur_s = e.get("dur", 0) / 1e6
+        if not _is_device_lane(pname, tname):
+            # Host-side profile_span annotations (profiling.annotate)
+            # still matter — they are the user's own phase markers.
+            if tname == "python" and dur_s > 0 and not name.startswith(
+                    ("$", "Xla", "PjRt", "Thread")):
+                annotations[name][0] += dur_s
+                annotations[name][1] += 1
+            continue
+        if name.startswith(_NOISE_PREFIXES) or dur_s <= 0:
+            continue
+        ops[name][0] += dur_s
+        ops[name][1] += 1
+        lane = f"{pname}/{tname}" if pname else tname
+        lanes[lane][categorize(name)] += dur_s
+
+    total = sum(s for s, _ in ops.values())
+    top_ops = [
+        {"op": name, "category": categorize(name),
+         "total_s": round(s, 6), "count": n,
+         "share_pct": round(100.0 * s / total, 2) if total else 0.0}
+        for name, (s, n) in sorted(ops.items(), key=lambda kv: -kv[1][0])
+    ][:top]
+
+    cat_totals: dict = collections.defaultdict(float)
+    lane_rows = []
+    for lane in sorted(lanes):
+        cats = lanes[lane]
+        lane_total = sum(cats.values())
+        for c, s in cats.items():
+            cat_totals[c] += s
+        lane_rows.append({
+            "lane": lane,
+            "total_s": round(lane_total, 6),
+            "categories": {c: round(s, 6) for c, s in sorted(cats.items())},
+            # mpiP's MPI% column: collective share of this lane's time.
+            "collective_pct": round(
+                100.0 * cats.get("collective", 0.0) / lane_total, 2)
+            if lane_total else 0.0,
+        })
+
+    return {
+        "schema": DIGEST_SCHEMA,
+        "total_op_s": round(total, 6),
+        "n_lanes": len(lane_rows),
+        "categories": {c: round(s, 6)
+                       for c, s in sorted(cat_totals.items())},
+        "top_ops": top_ops,
+        "lanes": lane_rows,
+        "annotations": [
+            {"name": n, "total_s": round(s, 6), "count": c}
+            for n, (s, c) in sorted(annotations.items(),
+                                    key=lambda kv: -kv[1][0])][:top],
+    }
+
+
+def to_markdown(d: dict, logdir: str = "") -> str:
+    lines = [
+        f"# Trace digest — the mpiP analogue{f' ({logdir})' if logdir else ''}",
+        "",
+        "Aggregated from the captured `jax.profiler.trace` device events "
+        "(Report.pdf p.34-37 reproduced for XLA: per-op self-time shares "
+        "instead of per-MPI-callsite shares; the 'collective' category is "
+        "the MPITime analogue). Seconds sum across "
+        f"{d['n_lanes']} device lane(s) — shares are the meaningful "
+        "column, as in mpiP.", "",
+        "## Per-device category shares (AppTime/MPITime analogue)", "",
+        "| lane | total (s) | collective % | breakdown |",
+        "|---|---|---|---|",
+    ]
+    for row in d["lanes"]:
+        br = ", ".join(f"{c}={s:.4g}s"
+                       for c, s in row["categories"].items())
+        lines.append(f"| {row['lane']} | {row['total_s']:.4g} "
+                     f"| {row['collective_pct']} | {br} |")
+    lines += [
+        "", "## Top ops by self-time (per-callsite analogue)", "",
+        "| op | category | time (s) | share | count |",
+        "|---|---|---|---|---|",
+    ]
+    for op in d["top_ops"]:
+        lines.append(f"| `{op['op']}` | {op['category']} "
+                     f"| {op['total_s']:.4g} | {op['share_pct']}% "
+                     f"| {op['count']} |")
+    if d.get("annotations"):
+        lines += ["", "## Host annotations (profile_span / annotate)", "",
+                  "| span | time (s) | count |", "|---|---|---|"]
+        for a in d["annotations"]:
+            lines.append(
+                f"| {a['name']} | {a['total_s']:.4g} | {a['count']} |")
+    return "\n".join(lines) + "\n"
+
+
+def report(logdir: str, top: int = 25) -> dict:
+    """Load + digest in one call (the library entry point)."""
+    return digest(load_events(logdir), top=top)
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="heat2d-tpu-prof",
+        description="mpiP-style digest of a jax.profiler.trace logdir "
+                    "(capture one with: heat2d-tpu --profile LOGDIR ...)")
+    p.add_argument("logdir", help="profiler logdir to digest")
+    p.add_argument("--top", type=int, default=25,
+                   help="rows in the top-op table (default 25)")
+    p.add_argument("--format", default="md", choices=["md", "json"],
+                   help="stdout format (default markdown)")
+    p.add_argument("--json-out", default=None,
+                   help="also write the JSON digest to this path")
+    args = p.parse_args(argv)
+
+    try:
+        d = report(args.logdir, top=args.top)
+    except FileNotFoundError as e:
+        print(str(e), file=sys.stderr)
+        return 1
+    if args.json_out:
+        with open(args.json_out, "w") as f:
+            json.dump(d, f, indent=2)
+    if args.format == "json":
+        print(json.dumps(d, indent=2))
+    else:
+        print(to_markdown(d, logdir=args.logdir), end="")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -43,6 +43,7 @@ except ImportError:  # pragma: no cover
 from heat2d_tpu.config import ConfigError
 from heat2d_tpu.models import engine
 from heat2d_tpu.ops.stencil import residual_sq
+from heat2d_tpu.utils.profiling import phase
 
 #: Per-core VMEM for device kinds we know; anything else falls back to the
 #: measured v5e envelope. The reference queried its device the same way
@@ -1258,7 +1259,7 @@ def panel_chunk(u, n: int, cx: float, cy: float,
 # Engine integration
 # --------------------------------------------------------------------- #
 
-def make_single_chip_runner(config):
+def make_single_chip_runner(config, tap=None):
     """Compiled ``u0 -> (u_final, steps_done)`` for mode='pallas'.
 
     Fixed-step runs on a VMEM-sized grid execute as ONE kernel invocation;
@@ -1270,6 +1271,11 @@ def make_single_chip_runner(config):
     ``config.bitwise_parity`` selects the literal reference step form
     (bitwise identical to serial mode) over the default FMA factoring —
     the same switch hybrid mode has.
+
+    ``tap``: optional convergence-loop residual stream (engine._emit);
+    None adds nothing to the traced program. The Pallas chunk launches
+    carry ``phase('stencil_chunk')`` scope metadata so XProf and
+    heat2d-tpu-prof attribute kernel time to the chunk phase.
     """
     cx, cy = config.cx, config.cy
     nx, ny = config.nxprob, config.nyprob
@@ -1288,7 +1294,8 @@ def make_single_chip_runner(config):
             return multi_step_vmem(u, 1, cx, cy, step=form)
 
         def chunk(u, n):  # n is a static Python int: baked into the kernel
-            return multi_step_vmem(u, n, cx, cy, step=form)
+            with phase("stencil_chunk"):
+                return multi_step_vmem(u, n, cx, cy, step=form)
     elif use_panels:
         def step(u):
             # The tracked single step (unfused convergence only): the
@@ -1298,13 +1305,16 @@ def make_single_chip_runner(config):
             return band_step(u, cx, cy, step=form)
 
         def chunk(u, n):
-            return panel_chunk(u, n, cx, cy, panels=pP, bm=pbm, step=form)
+            with phase("stencil_chunk"):
+                return panel_chunk(u, n, cx, cy, panels=pP, bm=pbm,
+                                   step=form)
     else:
         def step(u):
             return band_step(u, cx, cy, step=form)
 
         def chunk(u, n):  # temporally-blocked sweeps (~T x less HBM traffic)
-            return band_chunk(u, n, cx, cy, step=form)
+            with phase("stencil_chunk"):
+                return band_chunk(u, n, cx, cy, step=form)
 
     # Fused-residual convergence (C2R): on the streaming C2 route the
     # chunk's tracked step + residual reduction fold into the last
@@ -1334,14 +1344,16 @@ def make_single_chip_runner(config):
                 # full fast one (round-5: cut conv overhead ~in half).
                 d = n % tw or tw
                 cs = multi_c3(cs, n - d)
-                return _panel_sweep_all(cs, tw, cx, cy, pbm, nx, form,
-                                        nsub=d, resid=True)
+                with phase("residual_reduction"):
+                    return _panel_sweep_all(cs, tw, cx, cy, pbm, nx,
+                                            form, nsub=d, resid=True)
 
             def fused(u):
                 cs = _panel_split(u, pP, pbm, tw)
                 cs, k = engine.run_convergence_fused(
                     chunk_resid_c3, multi_c3, cs,
-                    config.steps, config.interval, config.sensitivity)
+                    config.steps, config.interval, config.sensitivity,
+                    tap=tap)
                 return _panel_join(cs, nx), k
         else:
             bm_w, m_pad_w = plan_window_band(nx, ny, DEFAULT_TSTEPS)
@@ -1354,25 +1366,29 @@ def make_single_chip_runner(config):
                     # Chunk-tail resid schedule (see chunk_resid_c3).
                     d = n % tw or tw
                     up = multi_p(up, n - d)
-                    return _window_resid_sweep(up, tw, cx, cy, bm_w, nx,
-                                               form, nsub=d)
+                    with phase("residual_reduction"):
+                        return _window_resid_sweep(up, tw, cx, cy, bm_w,
+                                                   nx, form, nsub=d)
 
                 def fused(u):
                     up = jnp.pad(u, ((0, m_pad_w - nx + tw), (0, 0)))
                     up, k = engine.run_convergence_fused(
                         chunk_resid_p, multi_p, up,
                         config.steps, config.interval,
-                        config.sensitivity)
+                        config.sensitivity, tap=tap)
                     return up[:nx], k
 
     def run(u):
-        residual = lambda a, b: residual_sq(a, b)  # noqa: E731
+        def residual(a, b):
+            with phase("residual_reduction"):
+                return residual_sq(a, b)
         if config.convergence:
             if fused is not None:
                 return fused(u)
             return engine.run_convergence_chunked(
                 chunk, step, residual, u,
-                config.steps, config.interval, config.sensitivity)
+                config.steps, config.interval, config.sensitivity,
+                tap=tap)
         # Fixed-step: resident grids run as ONE kernel invocation;
         # HBM grids as temporally-blocked sweeps.
         u = chunk(u, config.steps)

@@ -41,22 +41,25 @@ def make_mesh(gridx: int, gridy: int = 1, devices=None,
 
 
 def shard_map_compat(f, mesh, in_specs, out_specs, check_vma=None):
-    """``shard_map`` across jax versions — the ONE place the two version
-    quirks live: jax>=0.6 moved it to the top level, and older versions
-    lack the ``check_vma`` kwarg (needed as False wherever a pallas_call
-    runs inside the shard: kernel out_shapes carry no
-    varying-across-mesh-axes info). Every call site uses this so all have
-    identical version tolerance."""
+    """``shard_map`` across jax versions — the ONE place the version
+    quirks live: jax>=0.6 moved it to the top level, and the replication-
+    check kwarg was renamed ``check_rep`` -> ``check_vma`` along the way.
+    The check must be disableable wherever a pallas_call or a telemetry
+    debug_callback runs inside the shard (neither has a replication
+    rule). Every call site uses this so all have identical version
+    tolerance."""
     try:
         shard_map = jax.shard_map
     except AttributeError:  # pragma: no cover
         from jax.experimental.shard_map import shard_map
     if check_vma is not None:
-        try:
-            return shard_map(f, mesh=mesh, in_specs=in_specs,
-                             out_specs=out_specs, check_vma=check_vma)
-        except TypeError:  # older jax: no check_vma kwarg
-            pass
+        for kw in ("check_vma", "check_rep"):
+            try:
+                return shard_map(f, mesh=mesh, in_specs=in_specs,
+                                 out_specs=out_specs,
+                                 **{kw: check_vma})
+            except TypeError:  # this jax spells the kwarg the other way
+                continue
     return shard_map(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs)
 
 

@@ -31,6 +31,7 @@ from heat2d_tpu.ops.stencil import residual_sq, stencil_step_padded
 from heat2d_tpu.parallel.halo import (exchange_halo_2d_wide,
                                       exchange_halo_strips)
 from heat2d_tpu.parallel.mesh import shard_map_compat
+from heat2d_tpu.utils.profiling import phase
 
 #: Default wide-halo depth (config.halo_depth=None): 8 steps per exchange,
 #: clamped to the shard size in make_local_chunk.
@@ -127,12 +128,18 @@ def make_local_chunk(config, mesh: Mesh, chunk_kernel=None, axes=None,
                          "(chunk kernels bake their diffusivities)")
 
     def chunk(u, t):
+        # phase() spans: metadata-only HLO scope names so XProf/Perfetto
+        # (and heat2d-tpu-prof) attribute ops to halo-exchange vs
+        # interior-stencil — the per-callsite flavor of the mpiP tables.
         x0 = lax.axis_index(ax) * bm
         y0 = lax.axis_index(ay) * bn
         if chunk_kernel is not None:
-            strips = exchange_halo_strips(u, ax, ay, gx, gy, t)
-            return chunk_kernel(u, strips, t, x0, y0)
-        ext = exchange_halo_2d_wide(u, ax, ay, gx, gy, t)
+            with phase("halo_exchange"):
+                strips = exchange_halo_strips(u, ax, ay, gx, gy, t)
+            with phase("stencil_chunk"):
+                return chunk_kernel(u, strips, t, x0, y0)
+        with phase("halo_exchange"):
+            ext = exchange_halo_2d_wide(u, ax, ay, gx, gy, t)
         keep = _keep_mask((bm + 2 * t, bn + 2 * t), nx, ny, x0 - t, y0 - t)
 
         def one(_, v):
@@ -142,7 +149,8 @@ def make_local_chunk(config, mesh: Mesh, chunk_kernel=None, axes=None,
             full = jnp.concatenate([v[:1, :], mid, v[-1:, :]], axis=0)
             return jnp.where(keep, v, full)
 
-        ext = lax.fori_loop(0, t, one, ext, unroll=False)
+        with phase("interior_stencil"):
+            ext = lax.fori_loop(0, t, one, ext, unroll=False)
         return ext[t:-t, t:-t]
 
     return chunk
@@ -213,8 +221,9 @@ def make_window_multi(config, mesh: Mesh):
 
     def sweep(ue, nsub=None, resid=False):
         core = ue[:bm]
-        north, south, west, east = exchange_halo_strips(
-            core, ax, ay, gx, gy, t)
+        with phase("halo_exchange"):
+            north, south, west, east = exchange_halo_strips(
+                core, ax, ay, gx, gy, t)
         ue = lax.dynamic_update_slice(ue, south, (bm, 0))
         if with_cols:
             if pad_rows:
@@ -233,10 +242,11 @@ def make_window_multi(config, mesh: Mesh):
         scalars = jnp.stack(
             [(lax.axis_index(ax) * bm).astype(jnp.int32),
              (lax.axis_index(ay) * bn).astype(jnp.int32)])
-        return ps.shard_window_sweep(ue, north, wwin, ewin, scalars,
-                                     rb=rb, tsteps=t, nx=nx, ny=ny,
-                                     cx=cx, cy=cy, nsub=nsub,
-                                     resid=resid, valid_rows=bm)
+        with phase("stencil_chunk"):
+            return ps.shard_window_sweep(ue, north, wwin, ewin, scalars,
+                                         rb=rb, tsteps=t, nx=nx, ny=ny,
+                                         cx=cx, cy=cy, nsub=nsub,
+                                         resid=resid, valid_rows=bm)
 
     def multi(ue, n):
         full, rem = divmod(n, t)
@@ -262,7 +272,8 @@ def make_window_multi(config, mesh: Mesh):
         d = n % t or t
         ue = multi(ue, n - d)
         ue, part = sweep(ue, nsub=d, resid=True)
-        return ue, lax.psum(part, (ax, ay))
+        with phase("residual_reduction"):
+            return ue, lax.psum(part, (ax, ay))
 
     def extend(u):
         return jnp.concatenate(
@@ -273,11 +284,16 @@ def make_window_multi(config, mesh: Mesh):
         strip=(lambda ue: ue[:bm]), chunk_resid=chunk_resid, depth=t)
 
 
-def make_sharded_runner(config, mesh: Mesh, chunk_kernel=None):
+def make_sharded_runner(config, mesh: Mesh, chunk_kernel=None, tap=None):
     """Returns (runner, sharding): ``runner(u_sharded) -> (u, steps_done)``,
     jit-compiled over the mesh. The full loop (and convergence psum over
     both mesh axes — the MPI_Allreduce analogue, grad1612_mpi_heat.c:268)
-    runs device-side in one program."""
+    runs device-side in one program.
+
+    ``tap``: optional in-loop residual stream (engine._emit). Inside
+    shard_map the callback fires once per shard with the replicated
+    psum'd residual — TelemetryStream dedupes by step. None keeps the
+    traced program identical to the untelemetered one."""
     ax, ay = mesh.axis_names
     accum = jnp.dtype(config.accum_dtype)
     local_step = make_local_step(config, mesh, chunk_kernel=chunk_kernel)
@@ -304,39 +320,50 @@ def make_sharded_runner(config, mesh: Mesh, chunk_kernel=None):
                     ue, k = engine.run_convergence_fused(
                         window.chunk_resid, window.multi, ue,
                         config.steps, config.interval,
-                        config.sensitivity)
+                        config.sensitivity, tap=tap)
                 else:
                     def residual_w(u_new, u_old):
-                        return lax.psum(
-                            residual_sq(window.strip(u_new),
-                                        window.strip(u_old), accum),
-                            (ax, ay))
+                        with phase("residual_reduction"):
+                            return lax.psum(
+                                residual_sq(window.strip(u_new),
+                                            window.strip(u_old), accum),
+                                (ax, ay))
                     ue, k = engine.run_convergence_chunked(
                         window.multi, window.step, residual_w, ue,
                         config.steps, config.interval,
-                        config.sensitivity)
+                        config.sensitivity, tap=tap)
             else:
                 ue = window.multi(ue, config.steps)
                 k = jnp.asarray(config.steps, jnp.int32)
             return window.strip(ue), k
         if config.convergence:
             def residual(u_new, u_old):
-                return lax.psum(residual_sq(u_new, u_old, accum),
-                                (ax, ay))
+                with phase("residual_reduction"):
+                    return lax.psum(residual_sq(u_new, u_old, accum),
+                                    (ax, ay))
             u, k = engine.run_convergence_chunked(
                 local_multi, local_step, residual, u, config.steps,
-                config.interval, config.sensitivity)
+                config.interval, config.sensitivity, tap=tap)
         else:
             u = local_multi(u, config.steps)
             k = jnp.asarray(config.steps, jnp.int32)
         return u, k
 
-    # check_vma off in hybrid mode: pallas_call out_shapes carry no
-    # varying-across-mesh-axes info.
+    # check_vma off in hybrid mode (pallas_call out_shapes carry no
+    # varying-across-mesh-axes info), when a telemetry tap is wired in
+    # (debug_callback has no replication rule, which poisons the whole
+    # while loop's check), and on convergence runs under LEGACY jax
+    # only (experimental shard_map's check_rep has no replication rule
+    # for while; the top-level jax.shard_map vma check handles it, so
+    # modern jax keeps the check and still catches un-psum'd leaks).
+    legacy_rep_check = not hasattr(jax, "shard_map")
     mapped = shard_map_compat(local_run, mesh,
                               in_specs=P(ax, ay),
                               out_specs=(P(ax, ay), P()),
-                              check_vma=chunk_kernel is None)
+                              check_vma=(chunk_kernel is None
+                                         and tap is None
+                                         and not (config.convergence
+                                                  and legacy_rep_check)))
     runner = jax.jit(mapped)
     return runner, sharding
 

@@ -1,4 +1,6 @@
-from heat2d_tpu.utils.timing import Stopwatch, timed_call, max_over_processes
+from heat2d_tpu.utils.timing import (Stopwatch, TimedCall, timed_call,
+                                     max_over_processes)
 from heat2d_tpu.utils.device import device_summary
 
-__all__ = ["Stopwatch", "timed_call", "max_over_processes", "device_summary"]
+__all__ = ["Stopwatch", "TimedCall", "timed_call", "max_over_processes",
+           "device_summary"]

@@ -38,3 +38,21 @@ def annotate(name: str):
 
     with jax.profiler.TraceAnnotation(name):
         yield
+
+
+@contextlib.contextmanager
+def phase(name: str):
+    """Phase attribution usable INSIDE jit-traced code.
+
+    ``jax.named_scope`` prefixes the scope name onto every HLO op traced
+    under it, so XProf/Perfetto group the op timeline by phase (halo
+    exchange vs interior stencil vs residual reduction — the per-callsite
+    flavor of the reference's mpiP tables, Report.pdf p.35-37) and
+    ``heat2d-tpu-prof`` can attribute them. Metadata only: the compiled
+    computation is unchanged, so annotated hot paths cost nothing.
+    ``TraceAnnotation`` additionally marks the span when entered outside
+    a trace (eager host-side phases)."""
+    import jax
+
+    with jax.named_scope(name), jax.profiler.TraceAnnotation(name):
+        yield

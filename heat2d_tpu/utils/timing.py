@@ -59,20 +59,46 @@ def _fence(tree) -> None:
     jax.device_get(probes)
 
 
+class TimedCall(tuple):
+    """``(outputs, elapsed_seconds)`` — unpacks exactly like the 2-tuple
+    every existing call site expects — with the setup cost the reference's
+    clock placement excludes carried as an attribute instead of discarded:
+
+    - ``warmup_s``: wall-clock of the untimed priming execution
+      (compile + program load + first-run transfer), or None when the
+      caller skipped the warmup. A first-class metric now (the run
+      record and --metrics-out surface it); previously measured nowhere.
+    """
+
+    warmup_s: float | None = None
+
+    @property
+    def out(self):
+        return self[0]
+
+    @property
+    def elapsed(self) -> float:
+        return self[1]
+
+
 def timed_call(fn, *args, warmup: bool = True):
     """Run ``fn(*args)`` with the reference's timing protocol.
 
-    Returns (outputs, elapsed_seconds). ``warmup=True`` runs once first so
-    compilation (the analogue of MPI setup, excluded by the reference's
-    clock placement) is not measured.
+    Returns a ``TimedCall`` — an ``(outputs, elapsed_seconds)`` 2-tuple
+    whose ``warmup_s`` attribute carries the compile/warmup wall-clock.
+    ``warmup=True`` runs once first so compilation (the analogue of MPI
+    setup, excluded by the reference's clock placement) is not measured.
     """
+    warmup_s = None
     if warmup:
         # Warm up by *executing*, not just AOT-compiling: first execution
         # also pays program load / remote-device transfer, which belongs to
         # setup (the reference starts its clock after init). AOT compile
         # alone leaves that cost inside the timed region (measured: 15x
         # inflation through the remote-TPU tunnel).
+        w0 = time.perf_counter()
         _fence(fn(*args))
+        warmup_s = time.perf_counter() - w0
     for a in args:
         jax.block_until_ready(a)
     if jax.process_count() > 1:
@@ -83,4 +109,6 @@ def timed_call(fn, *args, warmup: bool = True):
     jax.block_until_ready(out)
     _fence(out)
     elapsed = time.perf_counter() - t0
-    return out, max_over_processes(elapsed)
+    result = TimedCall((out, max_over_processes(elapsed)))
+    result.warmup_s = warmup_s
+    return result

@@ -1,0 +1,159 @@
+"""obs/metrics registry + obs/record unified schema + timing satellite
+(warmup/compile time captured instead of discarded)."""
+
+import json
+
+import jax
+import jax.numpy as jnp
+
+from heat2d_tpu.obs.metrics import MetricsRegistry, get_registry
+from heat2d_tpu.obs.record import (RECORD_SCHEMA, attach_context,
+                                   build_record)
+from heat2d_tpu.utils.timing import TimedCall, timed_call
+
+
+def test_counters_gauges_histograms_series():
+    r = MetricsRegistry()
+    r.counter("steps_total", 10)
+    r.counter("steps_total", 5)
+    r.counter("steps_total", 1, mode="pallas")   # distinct labeled series
+    r.gauge("vmem_budget_mib", 16)
+    for v in (1.0, 2.0, 3.0, 4.0):
+        r.observe("chunk_s", v)
+    r.series("residual", 20, 0.5)
+    r.series("residual", 40, 0.25)
+    snap = r.snapshot()
+    assert snap["counters"]["steps_total"] == 15
+    assert snap["counters"]["steps_total{mode=pallas}"] == 1
+    assert snap["gauges"]["vmem_budget_mib"] == 16
+    h = snap["histograms"]["chunk_s"]
+    assert h["count"] == 4 and h["min"] == 1.0 and h["max"] == 4.0
+    assert h["mean"] == 2.5 and h["p50"] == 2.0
+    assert snap["series"]["residual"] == [[20, 0.5], [40, 0.25]]
+
+
+def test_timer_contextmanager():
+    r = MetricsRegistry()
+    with r.timer("span_s", phase="halo"):
+        pass
+    h = r.snapshot()["histograms"]["span_s{phase=halo}"]
+    assert h["count"] == 1 and h["min"] >= 0.0
+
+
+def test_jsonl_export_roundtrips(tmp_path):
+    r = MetricsRegistry()
+    r.event("run_start", mode="serial")
+    r.counter("steps_total", 100)
+    path = tmp_path / "metrics.jsonl"
+    r.write_jsonl(str(path), extra_records=[{"event": "run_record",
+                                             "steps_done": 100}])
+    lines = [json.loads(l) for l in path.read_text().splitlines()]
+    kinds = [l["event"] for l in lines]
+    assert kinds == ["run_start", "snapshot", "run_record"]
+    assert lines[1]["counters"]["steps_total"] == 100
+
+
+def test_prometheus_text():
+    r = MetricsRegistry()
+    r.counter("steps_total", 7, mode="serial")
+    r.gauge("elapsed_s", 1.5)
+    r.observe("chunk_s", 0.25)
+    text = r.prometheus_text()
+    assert "# TYPE steps_total counter" in text
+    assert 'steps_total{mode="serial"} 7.0' in text
+    assert "# TYPE elapsed_s gauge" in text
+    assert "chunk_s_sum 0.25" in text
+    assert "chunk_s_count 1" in text
+
+
+def test_prometheus_label_values_escaped():
+    r = MetricsRegistry()
+    r.counter("io_errors", 1, path='grid "final"\\x\n.dat')
+    line = [l for l in r.prometheus_text().splitlines()
+            if l.startswith("io_errors{")][0]
+    assert line == r'io_errors{path="grid \"final\"\\x\n.dat"} 1.0'
+
+
+def test_aggregate_multihost_single_process():
+    r = MetricsRegistry()
+    r.gauge("elapsed_s", 2.0)
+    r.counter("steps_total", 50)
+    agg = r.aggregate_multihost()
+    assert agg["elapsed_s"] == {"rank_max": 2.0, "rank_mean": 2.0,
+                                "rank_min": 2.0}
+    assert agg["steps_total"]["rank_max"] == 50
+
+
+def test_default_registry_singleton():
+    assert get_registry() is get_registry()
+
+
+# -- unified run-record schema (obs/record.py) ------------------------- #
+
+def test_build_record_envelope():
+    rec = build_record("run", steps_done=10, elapsed_s=0.5,
+                       warmup_s=1.25, extra={"custom": 1})
+    assert rec["schema"] == RECORD_SCHEMA
+    assert rec["kind"] == "run"
+    assert rec["steps_done"] == 10 and rec["warmup_s"] == 1.25
+    assert rec["custom"] == 1
+    assert rec["jax_version"] == jax.__version__
+    assert rec["device"]["n_devices"] >= 1
+    assert rec["world"]["process_count"] >= 1
+    assert "T" in rec["timestamp"]    # ISO 8601
+
+
+def test_attach_context_keeps_existing_keys():
+    rec = {"device": {"custom": True}, "value": 1.0}
+    out = attach_context(rec, "bench")
+    assert out is rec
+    assert rec["device"] == {"custom": True}   # emitter's richer value wins
+    assert rec["kind"] == "bench" and rec["schema"] == RECORD_SCHEMA
+
+
+def test_all_emitters_share_the_envelope():
+    """The three formerly-divergent shapes all carry the shared schema."""
+    import sys, os
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    import bench
+    from heat2d_tpu.config import HeatConfig
+    from heat2d_tpu.models.solver import Heat2DSolver
+
+    b = bench.build_record(100.0, "two-point", 1.0, nx=640, ny=512,
+                           steps=10)
+    r = Heat2DSolver(HeatConfig(steps=2)).run(timed=False).to_record()
+    assert b["schema"] == r["schema"] == RECORD_SCHEMA
+    assert b["kind"] == "bench" and r["kind"] == "run"
+    # bench driver-contract keys unchanged by the envelope
+    assert b["unit"] == "Mcells/s" and "vs_baseline" in b
+
+
+# -- timing satellite: warmup/compile time kept, 2-tuple compatible ---- #
+
+def test_timed_call_returns_warmup_and_unpacks_as_pair():
+    f = jax.jit(lambda x: x * 2.0)
+    tc = timed_call(f, jnp.ones((8, 8)))
+    assert isinstance(tc, TimedCall)
+    out, elapsed = tc                    # existing call-site contract
+    assert out.shape == (8, 8) and elapsed > 0
+    assert tc.out is tc[0] and tc.elapsed == tc[1]
+    assert tc.warmup_s is not None and tc.warmup_s > 0
+
+
+def test_timed_call_no_warmup_reports_none():
+    f = jax.jit(lambda x: x + 1.0)
+    x = jnp.ones((4, 4))
+    jax.block_until_ready(f(x))
+    tc = timed_call(f, x, warmup=False)
+    assert tc.warmup_s is None
+
+
+def test_run_result_surfaces_warmup():
+    from heat2d_tpu.config import HeatConfig
+    from heat2d_tpu.models.solver import Heat2DSolver
+
+    result = Heat2DSolver(HeatConfig(nxprob=16, nyprob=16, steps=5)).run(
+        timed=True)
+    assert result.warmup_s is not None and result.warmup_s > 0
+    assert result.to_record()["warmup_s"] == result.warmup_s

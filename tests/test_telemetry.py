@@ -1,0 +1,215 @@
+"""In-loop telemetry streaming (obs/stream + engine taps): residual
+trajectories out of the COMPILED convergence loops, the no-overhead
+guarantee when disabled, and the --metrics-out CLI flow."""
+
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from heat2d_tpu.config import HeatConfig
+from heat2d_tpu.models import engine
+from heat2d_tpu.models.solver import Heat2DSolver
+from heat2d_tpu.obs import MetricsRegistry, TelemetryStream
+from heat2d_tpu.ops.stencil import residual_sq, stencil_step
+
+
+CFG = dict(nxprob=24, nyprob=24, steps=200, convergence=True,
+           interval=20, sensitivity=1e-6)
+
+
+def test_serial_stream_trajectory_monotone_and_sized():
+    stream = TelemetryStream()
+    cfg = HeatConfig(**CFG)
+    result = Heat2DSolver(cfg, telemetry=stream).run(timed=False)
+    traj = stream.trajectory()
+    # One point per INTERVAL chunk, in step order.
+    assert [p["step"] for p in traj] == list(
+        range(cfg.interval, result.steps_done + 1, cfg.interval))
+    assert len(traj) == result.steps_done // cfg.interval
+    # Diffusion decays: the residual trajectory is monotone decreasing.
+    resid = [p["residual"] for p in traj]
+    assert all(a > b for a, b in zip(resid, resid[1:]))
+    assert all(r >= 0 for r in resid)
+
+
+def test_stream_registry_series_mirror():
+    reg = MetricsRegistry()
+    stream = TelemetryStream(registry=reg)
+    Heat2DSolver(HeatConfig(**CFG), telemetry=stream).run(timed=False)
+    series = reg.snapshot()["series"]["residual"]
+    assert series == [[p["step"], p["residual"]]
+                      for p in stream.trajectory()]
+
+
+def test_disabled_streaming_adds_nothing_to_the_program():
+    """The no-overhead guarantee: telemetry off (the default) leaves the
+    compiled convergence loop free of any callback machinery — jaxpr and
+    lowered HLO — while the enabled program carries the tap."""
+    cfg = HeatConfig(**CFG)
+    u0 = Heat2DSolver(cfg).init_state()
+
+    off = Heat2DSolver(cfg).make_runner()
+    on = Heat2DSolver(cfg, telemetry=TelemetryStream()).make_runner()
+    jaxpr_off = jax.make_jaxpr(off)(u0)
+    jaxpr_on = jax.make_jaxpr(on)(u0)
+    assert "debug_callback" not in str(jaxpr_off)
+    assert "debug_callback" in str(jaxpr_on)
+    assert "callback" not in off.lower(u0).as_text()
+    # A second telemetry-free solver traces to the identical program
+    # (determinism of the disabled path).
+    again = jax.make_jaxpr(Heat2DSolver(cfg).make_runner())(u0)
+    assert str(jaxpr_off) == str(again)
+
+
+def test_tapless_engine_loop_is_the_seed_loop():
+    """engine.run_convergence with tap=None must trace to EXACTLY the
+    pre-telemetry loop (replicated here verbatim from the seed) — the
+    byte-identical-hot-path contract."""
+    from jax import lax
+
+    def seed_run_convergence(step_fn, residual_fn, u0, steps, interval,
+                             sensitivity):
+        interval = min(interval, steps) if steps else interval
+
+        def chunk_body(carry):
+            u_prev, u, k, _ = carry
+            n = jnp.minimum(interval, steps - k)
+
+            def body(_, pu):
+                p, c = pu
+                del p
+                return (c, step_fn(c))
+
+            u_prev, u = lax.fori_loop(0, n, body, (u_prev, u))
+            res = residual_fn(u, u_prev).astype(jnp.float32)
+            return (u_prev, u, k + n, res)
+
+        def cond(carry):
+            _, _, k, res = carry
+            return jnp.logical_and(k < steps, res >= sensitivity)
+
+        init = (u0, u0, jnp.asarray(0, jnp.int32),
+                jnp.asarray(jnp.inf, jnp.float32))
+        _, u, k, _ = lax.while_loop(cond, chunk_body, init)
+        return u, k
+
+    step = lambda u: stencil_step(u, 0.1, 0.1)          # noqa: E731
+    u0 = jnp.ones((12, 12), jnp.float32)
+    ours = jax.make_jaxpr(
+        lambda u: engine.run_convergence(step, residual_sq, u,
+                                         100, 10, 0.1))(u0)
+    seed = jax.make_jaxpr(
+        lambda u: seed_run_convergence(step, residual_sq, u,
+                                       100, 10, 0.1))(u0)
+    assert str(ours) == str(seed)
+
+
+def test_streaming_does_not_change_results():
+    cfg = HeatConfig(**CFG)
+    off = Heat2DSolver(cfg).run(timed=False)
+    on = Heat2DSolver(cfg, telemetry=TelemetryStream()).run(timed=False)
+    np.testing.assert_array_equal(off.u, on.u)
+    assert off.steps_done == on.steps_done
+
+
+def test_sharded_stream_dedupes_across_shards():
+    """dist2d: the callback fires once per shard with the replicated
+    psum'd residual — the stream must report ONE point per chunk."""
+    stream = TelemetryStream()
+    cfg = HeatConfig(nxprob=16, nyprob=16, steps=100, mode="dist2d",
+                     gridx=2, gridy=2, convergence=True, interval=10,
+                     sensitivity=1e-9)
+    result = Heat2DSolver(cfg, telemetry=stream).run(timed=False)
+    traj = stream.trajectory()
+    assert len(traj) == result.steps_done // cfg.interval
+    assert [p["step"] for p in traj] == list(
+        range(cfg.interval, result.steps_done + 1, cfg.interval))
+    # and the sharded trajectory tracks the serial one: the GRID is
+    # pinned bitwise to serial, but the residual is a psum of per-shard
+    # partial sums — a different summation order than serial's single
+    # full-grid reduce, so it deviates at f32 ulp.
+    serial = TelemetryStream()
+    Heat2DSolver(HeatConfig(nxprob=16, nyprob=16, steps=100,
+                            convergence=True, interval=10,
+                            sensitivity=1e-9),
+                 telemetry=serial).run(timed=False)
+    np.testing.assert_allclose(
+        [p["residual"] for p in traj],
+        [p["residual"] for p in serial.trajectory()], rtol=1e-5)
+
+
+def test_ensemble_chunk_progress_stream():
+    from heat2d_tpu.models.ensemble import run_ensemble_convergence
+
+    stream = TelemetryStream()
+    batch, steps_done = run_ensemble_convergence(
+        16, 16, 60, 10, 1e-7, [0.1, 0.05], [0.1, 0.05],
+        method="pallas", tap=stream.tap_members)
+    prog = stream.chunk_progress()
+    assert len(prog) == 6        # 60 steps / interval 10, none converge
+    assert [p["chunk"] for p in prog] == list(range(1, 7))
+    for p in prog:
+        assert len(p["residuals"]) == 2 == len(p["done"])
+    # per-member residuals decrease chunk over chunk
+    r0 = [p["residuals"][0] for p in prog]
+    assert all(a > b for a, b in zip(r0, r0[1:]))
+    assert list(prog[-1]["steps_done"]) == [int(s) for s in steps_done]
+
+
+def test_cli_metrics_out_writes_unified_jsonl(tmp_path):
+    """Acceptance flow: --metrics-out writes a JSONL whose run_record
+    line carries the unified schema, the residual trajectory, and the
+    compile/warmup metric."""
+    from heat2d_tpu.cli import main
+    from heat2d_tpu.obs.record import RECORD_SCHEMA
+
+    out = tmp_path / "run.jsonl"
+    rc = main(["--mode", "serial", "--convergence", "--nxprob", "24",
+               "--nyprob", "24", "--steps", "100", "--interval", "20",
+               "--outdir", str(tmp_path), "--metrics-out", str(out)])
+    assert rc == 0
+    lines = [json.loads(l) for l in out.read_text().splitlines()]
+    rec = next(l for l in lines if l["event"] == "run_record")
+    assert rec["schema"] == RECORD_SCHEMA
+    assert rec["warmup_s"] > 0
+    assert len(rec["residual_trajectory"]) == rec["steps_done"] // 20
+    assert rec["metrics_aggregate"]["warmup_compile_s"]["rank_max"] > 0
+    snap = next(l for l in lines if l["event"] == "snapshot")
+    assert snap["gauges"]["steps_done"] == rec["steps_done"]
+
+
+def test_cli_resume_trajectory_uses_absolute_steps(tmp_path):
+    """Resumed runs count engine steps from 0 — the emitted trajectory
+    must be shifted to absolute step numbers."""
+    from heat2d_tpu.cli import main
+
+    ck = tmp_path / "ck.bin"
+    rc = main(["--mode", "serial", "--nxprob", "24", "--nyprob", "24",
+               "--steps", "60", "--checkpoint", str(ck),
+               "--outdir", str(tmp_path), "--dat-layout", "none"])
+    assert rc == 0
+    out = tmp_path / "resume.jsonl"
+    rc = main(["--mode", "serial", "--convergence", "--nxprob", "24",
+               "--nyprob", "24", "--steps", "120", "--interval", "20",
+               "--resume", str(ck), "--outdir", str(tmp_path),
+               "--dat-layout", "none", "--metrics-out", str(out)])
+    assert rc == 0
+    rec = next(json.loads(l) for l in out.read_text().splitlines()
+               if json.loads(l)["event"] == "run_record")
+    # 60 checkpointed + 60 streamed-in-segment steps at interval 20:
+    # absolute steps 80, 100, 120 — not segment-local 20, 40, 60.
+    assert [p["step"] for p in rec["residual_trajectory"]] == [80, 100,
+                                                               120]
+    assert rec["total_steps_including_resume"] == 120
+
+
+def test_cli_without_metrics_out_is_untelemetered(tmp_path):
+    """Default path: no --metrics-out, no telemetry object anywhere —
+    the solver's runner stays callback-free."""
+    cfg = HeatConfig(**CFG)
+    s = Heat2DSolver(cfg)
+    assert s.telemetry is None
+    u0 = s.init_state()
+    assert "debug_callback" not in str(jax.make_jaxpr(s.make_runner())(u0))

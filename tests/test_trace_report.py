@@ -1,0 +1,172 @@
+"""heat2d-tpu-prof (obs/trace_report): the mpiP-style digest of a
+captured jax.profiler.trace logdir — synthetic-event units plus an
+end-to-end CPU capture through profile_span."""
+
+import gzip
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from heat2d_tpu.obs import trace_report
+from heat2d_tpu.utils.profiling import annotate, profile_span
+
+
+# -- synthetic Chrome-trace events: deterministic digest units --------- #
+
+def _meta(pid, pname, tid, tname):
+    return [
+        {"ph": "M", "pid": pid, "name": "process_name",
+         "args": {"name": pname}},
+        {"ph": "M", "pid": pid, "tid": tid, "name": "thread_name",
+         "args": {"name": tname}},
+    ]
+
+
+def _op(pid, tid, name, dur_us, ts=0):
+    return {"ph": "X", "pid": pid, "tid": tid, "name": name,
+            "ts": ts, "dur": dur_us}
+
+
+def _tpu_style_events():
+    """Two device lanes (TPU-flavored) + one python host lane."""
+    ev = _meta(1, "/device:TPU:0", 10, "XLA Ops")
+    ev += _meta(2, "/device:TPU:1", 10, "XLA Ops")
+    ev += _meta(3, "python-host", 20, "python")
+    ev += [
+        _op(1, 10, "fusion.1", 600_000),
+        _op(1, 10, "fusion.1", 200_000, ts=700_000),
+        _op(1, 10, "all-reduce.3", 150_000),
+        _op(1, 10, "collective-permute.2", 50_000),
+        _op(2, 10, "fusion.1", 700_000),
+        _op(2, 10, "all-reduce.3", 300_000),
+        _op(2, 10, "copy.5", 100_000),
+        # executor bookkeeping lines must not count as op self-time
+        _op(1, 10, "while", 999_000),
+        # host-side user annotation (profiling.annotate span)
+        _op(3, 20, "halo_exchange", 42_000),
+    ]
+    return ev
+
+
+def test_categorize_prefix_table():
+    assert trace_report.categorize("all-reduce.1") == "collective"
+    assert trace_report.categorize("collective-permute.7") == "collective"
+    assert trace_report.categorize("copy.2") == "host/transfer"
+    assert trace_report.categorize("infeed.0") == "host/transfer"
+    assert trace_report.categorize("fusion.9") == "compute"
+
+
+def test_digest_synthetic_shares_and_lanes():
+    d = trace_report.digest(_tpu_style_events())
+    assert d["schema"] == trace_report.DIGEST_SCHEMA
+    assert d["n_lanes"] == 2
+    # fusion.1: 0.6+0.2+0.7 s over 3 invocations, the top op
+    top = d["top_ops"][0]
+    assert top["op"] == "fusion.1" and top["count"] == 3
+    assert top["total_s"] == pytest.approx(1.5)
+    assert top["category"] == "compute"
+    # total excludes the 'while' bookkeeping event
+    assert d["total_op_s"] == pytest.approx(2.1)
+    assert d["categories"]["collective"] == pytest.approx(0.5)
+    assert d["categories"]["host/transfer"] == pytest.approx(0.1)
+    # per-lane MPI%-analogue: lane 1 collective share = 0.2/1.0
+    lane1 = next(r for r in d["lanes"] if "TPU:0" in r["lane"])
+    assert lane1["collective_pct"] == pytest.approx(20.0)
+    # shares sum to ~100
+    assert sum(o["share_pct"] for o in d["top_ops"]) == pytest.approx(
+        100.0, abs=0.1)
+    # the host annotation is surfaced separately, not as op time
+    assert any(a["name"] == "halo_exchange" for a in d["annotations"])
+
+
+def test_markdown_rendering():
+    md = trace_report.to_markdown(
+        trace_report.digest(_tpu_style_events()), logdir="/tmp/x")
+    assert "Per-device category shares" in md
+    assert "Top ops by self-time" in md
+    assert "`fusion.1`" in md and "all-reduce.3" in md
+
+
+def test_load_events_missing_logdir_raises(tmp_path):
+    with pytest.raises(FileNotFoundError):
+        trace_report.load_events(str(tmp_path))
+
+
+def test_main_reads_synthetic_logdir(tmp_path, capsys):
+    inner = tmp_path / "plugins" / "profile" / "run1"
+    inner.mkdir(parents=True)
+    path = inner / "host.trace.json.gz"
+    with gzip.open(path, "wt") as f:
+        json.dump({"traceEvents": _tpu_style_events()}, f)
+    out_json = tmp_path / "digest.json"
+    rc = trace_report.main([str(tmp_path), "--format", "json",
+                            "--json-out", str(out_json)])
+    assert rc == 0
+    stdout = json.loads(capsys.readouterr().out)   # valid JSON on stdout
+    assert stdout["top_ops"][0]["op"] == "fusion.1"
+    assert json.loads(out_json.read_text()) == stdout
+
+
+def test_main_missing_logdir_rc1(tmp_path, capsys):
+    assert trace_report.main([str(tmp_path)]) == 1
+    assert "trace.json.gz" in capsys.readouterr().err
+
+
+def test_multihost_capture_merges_all_host_files(tmp_path):
+    """A multihost capture writes one trace file per host into ONE run
+    directory — the digest must merge them all, keeping same-numbered
+    pids on different hosts as distinct lanes."""
+    run = tmp_path / "plugins" / "profile" / "run1"
+    run.mkdir(parents=True)
+    for host in ("hostA", "hostB"):    # identical pid namespaces
+        ev = _meta(1, "/device:TPU:0", 10, "XLA Ops")
+        ev.append(_op(1, 10, "fusion.1", 500_000))
+        with gzip.open(run / f"{host}.trace.json.gz", "wt") as f:
+            json.dump({"traceEvents": ev}, f)
+    d = trace_report.digest(trace_report.load_events(str(tmp_path)))
+    assert d["n_lanes"] == 2            # one lane per host, not merged
+    assert d["top_ops"][0]["count"] == 2
+    assert d["total_op_s"] == pytest.approx(1.0)
+
+
+def test_stale_captures_in_reused_logdir_skipped(tmp_path):
+    """Two sequential --profile runs into one logdir: only the latest
+    capture directory is digested (not double-counted)."""
+    for run, dur in (("run1", 900_000), ("run2", 300_000)):
+        d = tmp_path / "plugins" / "profile" / run
+        d.mkdir(parents=True)
+        ev = _meta(1, "/device:TPU:0", 10, "XLA Ops")
+        ev.append(_op(1, 10, "fusion.1", dur))
+        with gzip.open(d / "host.trace.json.gz", "wt") as f:
+            json.dump({"traceEvents": ev}, f)
+    d = trace_report.digest(trace_report.load_events(str(tmp_path)))
+    assert d["total_op_s"] == pytest.approx(0.3)   # run2 only
+
+
+# -- end-to-end: capture a tiny CPU trace, digest it ------------------- #
+
+def test_cpu_capture_digest_nonempty(tmp_path):
+    """The ISSUE acceptance flow: a profile_span capture under
+    JAX_PLATFORMS=cpu digests to a non-empty top-op table and valid
+    JSON — no TPU needed for the whole trace-digest workflow."""
+    logdir = str(tmp_path / "trace")
+    with profile_span(logdir):
+        with annotate("stencil_phase"):
+            x = jnp.ones((64, 64))
+            for _ in range(3):
+                x = jax.block_until_ready(
+                    jax.jit(lambda u: u @ u + 1.0)(x))
+    rep = trace_report.report(logdir)
+    assert rep["n_lanes"] >= 1
+    assert rep["top_ops"], "empty top-op table from a real capture"
+    assert rep["total_op_s"] > 0
+    assert all(o["total_s"] > 0 and o["count"] >= 1
+               for o in rep["top_ops"])
+    # the user's own phase marker survives into the digest
+    assert any(a["name"] == "stencil_phase" for a in rep["annotations"])
+    json.dumps(rep)    # the digest is JSON-serializable as-is
+    md = trace_report.to_markdown(rep, logdir=logdir)
+    assert rep["top_ops"][0]["op"] in md
