@@ -298,10 +298,15 @@ def suspect_rows(records):
     # class) lands far outside AGREE_FACTOR; healthy large-row spreads
     # measure <= ~1.25x. Both rows of a violating pair re-measure (two
     # rows cannot say which is wrong; a healthy row just re-confirms).
+    # Kernel-backed streaming modes only: their per-cell rate really is
+    # flat once HBM-streaming-bound, but serial's XLA whole-grid loop
+    # may legitimately slow per-cell as grids outgrow cache — a genuine
+    # serial row must not re-measure the whole group (advisor r5).
     big = {}
     for i, r in enumerate(records):
         st = r.get("step_time_s")
-        if st is not None and cells(r) >= 1280 * 1024:
+        if (st is not None and cells(r) >= 1280 * 1024
+                and r["mode"] in ("pallas", "hybrid")):
             big.setdefault((r["mode"], mesh(r)), []).append(
                 (i, st / cells(r)))
     for group in big.values():

@@ -19,11 +19,26 @@ the framework (SURVEY.md §5.1):
                      ``jax.profiler.trace`` logdir into the mpiP-style
                      digest (top ops by self-time, compute vs collective
                      vs host shares per device).
+
+Metric families by producer (names are stable; docs/OBSERVABILITY.md
+and docs/SERVING.md carry the full tables):
+
+- solver/CLI:   ``steps_done``, ``elapsed_s``, ``warmup_compile_s``
+                gauges; ``phase`` span histograms.
+- serve/:       ``serve_queue_depth``, ``serve_cache_*`` gauges;
+                ``serve_requests_total{outcome}``,
+                ``serve_rejected_total{reason}``,
+                ``serve_dispatch_total``, ``serve_launches_total``
+                counters; ``serve_batch_occupancy``,
+                ``serve_batch_fill``, ``serve_queue_wait_s``,
+                ``serve_launch_s``, ``serve_e2e_latency_s`` histograms.
 """
 
 from heat2d_tpu.obs.metrics import MetricsRegistry, get_registry
-from heat2d_tpu.obs.record import RECORD_SCHEMA, attach_context, build_record
+from heat2d_tpu.obs.record import (RECORD_KINDS, RECORD_SCHEMA,
+                                   attach_context, build_record)
 from heat2d_tpu.obs.stream import TelemetryStream, flush_taps
 
 __all__ = ["MetricsRegistry", "get_registry", "TelemetryStream",
-           "flush_taps", "RECORD_SCHEMA", "attach_context", "build_record"]
+           "flush_taps", "RECORD_KINDS", "RECORD_SCHEMA",
+           "attach_context", "build_record"]

@@ -16,6 +16,13 @@ import datetime
 
 RECORD_SCHEMA = "heat2d-tpu/run-record/v1"
 
+#: The record kinds emitters currently produce — consumers keying on
+#: ``kind`` can enumerate what exists without grepping call sites.
+#: "run" (CLI solver), "ensemble" (CLI batched sweep), "bench"/"sweep"
+#: (benchmark harnesses), "serve" (heat2d-tpu-serve: launch log +
+#: serving telemetry snapshot rides in the same JSONL).
+RECORD_KINDS = ("run", "ensemble", "bench", "sweep", "serve")
+
 
 def run_context() -> dict:
     """The shared envelope: schema tag + execution context."""

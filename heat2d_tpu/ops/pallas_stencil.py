@@ -708,19 +708,31 @@ def _pad_aware_bm(nrows: int, bm_max: int, tsteps: int) -> int:
     bm_max=624 pads 592 rows -> 154k Mcells/s, while bm=320 pads zero
     -> 234k measured via the D2 divisor rule in round 4). Ties prefer
     the taller band (fewer programs)."""
+    def cost(b):
+        return (-(-nrows // b)) * (b + 2 * tsteps)
+
+    env = bm_max                   # the ext envelope as handed in
     if bm_max >= nrows:
         bm = max(8, nrows // 8 * 8)
         if nrows % bm == 0:
             return bm              # exact single band, zero pad
-        bm_max = bm                # else scan: the single band would
-        #                            pad nearly a whole band of rows
+        bm_max = bm                # else scan: the rounded-DOWN single
+        #                            band pads nearly a whole band
     bm = bm_max
     # Range stop 2T + 8 keeps every candidate > 2T (the window-viability
     # floor) without a redundant in-loop guard (advisor r4).
     for b in range(bm_max, 2 * tsteps + 8, -8):
-        if (-(-nrows // b)) * (b + 2 * tsteps) \
-                < (-(-nrows // bm)) * (bm + 2 * tsteps):
+        if cost(b) < cost(bm):
             bm = b
+    # Also weigh the single TALL band ceil(nrows/8)*8 when it fits the
+    # ext envelope: one (tall + 2T)-row sweep can beat every multi-band
+    # candidate (e.g. nrows=100, T=8: bm=104 sweeps 120 ext rows vs
+    # bm=96's 2x112), and the scan above tops out at the rounded-DOWN
+    # height so it never sees it (advisor r5). <=: on a cost tie the
+    # taller band wins (fewer programs), matching the scan's preference.
+    tall = -(-nrows // 8) * 8
+    if tall != bm and 2 * tsteps < tall <= env and cost(tall) <= cost(bm):
+        bm = tall
     return bm
 
 

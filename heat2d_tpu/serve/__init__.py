@@ -1,0 +1,33 @@
+"""Solve-serving subsystem — the inference-stack front half over the
+batched ensemble engine (ROADMAP north star: admit heavy concurrent
+traffic and amortize it onto the hardware).
+
+The reference (and the repo until this package) could only run one-shot
+CLI/bench launches. This package adds the serving trio that turns the
+ensemble layer's one-launch-many-members capability into a service:
+
+- ``schema``  — ``SolveRequest``/``SolveResult`` with a canonical
+                content hash (cache/dedup key) and a compiled signature
+                (batching key), plus structured ``Rejected`` errors.
+- ``cache``   — bounded content-addressed LRU result cache +
+                single-flight in-flight deduplication.
+- ``batcher`` — async admission queue, shape-bucketed micro-batching
+                (``max_delay``/``max_batch``), queue-depth load
+                shedding, per-request timeouts.
+- ``engine``  — bucket -> ONE ``run_ensemble`` launch through the
+                per-signature compile cache (models/ensemble.
+                batch_runner): warm signatures never retrace; batch
+                shapes pad to power-of-two capacities so each signature
+                compiles O(log max_batch) programs total.
+- ``server``  — ``SolveServer`` composing the above + the synchronous
+                ``Client``; every stage exports counters/gauges/
+                histograms through obs/metrics (docs/SERVING.md).
+- ``cli``     — ``heat2d-tpu-serve`` (``--selftest`` smoke +
+                ``--requests`` file serving).
+"""
+
+from heat2d_tpu.serve.schema import Rejected, SolveRequest, SolveResult
+from heat2d_tpu.serve.server import Client, SolveServer
+
+__all__ = ["Rejected", "SolveRequest", "SolveResult", "Client",
+           "SolveServer"]

@@ -1,0 +1,249 @@
+"""``heat2d-tpu-serve`` — the serving subsystem's driver.
+
+Two modes:
+
+- ``--selftest``: start an in-process server, fire a small mixed
+  workload through the synchronous client (same-shape coalescing,
+  mixed-shape bucketing, duplicate single-flight, a cache-hit repeat),
+  then assert the serving invariants: fewer launches than requests, a
+  nonzero batch-occupancy histogram, at least one cache hit, and
+  bitwise-identical cached results. Exit 0 iff every check holds —
+  the CI smoke job runs exactly this on CPU.
+- ``--requests FILE.jsonl``: serve a file of request dicts (one JSON
+  object per line), writing one result/rejection summary line each to
+  stdout or ``--results-out``.
+
+``--metrics-out PATH`` writes the run's telemetry as JSONL (registry
+events + snapshot + a ``kind="serve"`` run record), the same envelope
+as the solver CLI's ``--metrics-out``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="heat2d-tpu-serve",
+        description="solve-serving subsystem: async queue, shape-"
+                    "bucketed micro-batching onto the ensemble engine, "
+                    "content-addressed result cache")
+    p.add_argument("--selftest", action="store_true",
+                   help="run the in-process mixed-workload smoke test "
+                        "(CPU unless --platform tpu) and exit nonzero "
+                        "on any serving-invariant failure")
+    p.add_argument("--requests", default=None, metavar="JSONL",
+                   help="serve a file of request dicts, one JSON object "
+                        "per line")
+    p.add_argument("--results-out", default=None, metavar="PATH",
+                   help="with --requests: write result summaries here "
+                        "instead of stdout")
+    s = p.add_argument_group("scheduler tuning (docs/SERVING.md)")
+    s.add_argument("--max-batch", type=int, default=8,
+                   help="members per ensemble launch (bucket dispatches "
+                        "when full)")
+    s.add_argument("--max-delay", type=float, default=0.005, metavar="S",
+                   help="longest a bucket's oldest request waits before "
+                        "dispatching a partial batch")
+    s.add_argument("--queue-depth", type=int, default=256,
+                   help="admission limit across all buckets; excess "
+                        "load is shed with a structured rejection")
+    s.add_argument("--cache-size", type=int, default=256,
+                   help="result-cache entries (content-addressed LRU)")
+    s.add_argument("--timeout", type=float, default=30.0,
+                   help="per-request queue timeout in seconds")
+    p.add_argument("--metrics-out", default=None, metavar="PATH",
+                   help="write telemetry JSONL (events + snapshot + the "
+                        "kind='serve' run record)")
+    p.add_argument("--platform", default=None, choices=["cpu", "tpu"],
+                   help="force a JAX platform (selftest defaults to cpu)")
+    p.add_argument("--log-level", default=None,
+                   choices=["debug", "info", "warning", "error"])
+    return p
+
+
+def _selftest_workload(client):
+    """The mixed workload: returns (requests_fired, failures) and leaves
+    its fingerprints in the registry/engine for the invariant checks."""
+    from heat2d_tpu.serve.schema import SolveRequest
+
+    a = [SolveRequest(nx=24, ny=32, steps=6, cx=0.05 + 0.01 * i, cy=0.1,
+                      method="jnp") for i in range(6)]
+    b = [SolveRequest(nx=16, ny=48, steps=6, cx=0.1, cy=0.05 + 0.01 * i,
+                      method="jnp") for i in range(3)]
+    dup = SolveRequest(nx=24, ny=32, steps=6, cx=0.2, cy=0.2,
+                       method="jnp")
+
+    failures = []
+    # Same-shape coalescing + mixed shapes in separate buckets + two
+    # identical in-flight duplicates, all submitted before the batcher's
+    # max_delay elapses.
+    futs = [client.submit(r) for r in a + b] + [client.submit(dup),
+                                                client.submit(dup)]
+    results = []
+    for i, f in enumerate(futs):
+        try:
+            results.append(f.result(timeout=120))
+        except Exception as e:  # noqa: BLE001 — report, don't crash
+            failures.append(f"request {i} failed: {e!r}")
+            results.append(None)
+    fired = len(futs)
+
+    if results[0] is not None:
+        # Cache-hit repeat: bitwise-identical to the batched cold solve.
+        import numpy as np
+        again = client.solve(a[0], timeout=60)
+        if not again.cache_hit:
+            failures.append("repeat request was not a cache hit")
+        if np.asarray(again.u).tobytes() != \
+                np.asarray(results[0].u).tobytes():
+            failures.append("cache hit result not bitwise-identical")
+        fired += 1
+    if results[-1] is not None and results[-2] is not None:
+        import numpy as np
+        if np.asarray(results[-1].u).tobytes() != \
+                np.asarray(results[-2].u).tobytes():
+            failures.append("coalesced duplicates returned different "
+                            "grids")
+    return fired, failures
+
+
+def run_selftest(args, registry) -> int:
+    from heat2d_tpu.serve.server import Client, SolveServer
+
+    server = SolveServer(
+        max_batch=args.max_batch, max_delay=max(args.max_delay, 0.05),
+        max_queue=args.queue_depth, cache_size=args.cache_size,
+        default_timeout=args.timeout, registry=registry)
+    with server:
+        fired, failures = _selftest_workload(Client(server))
+
+    snap = registry.snapshot()
+    occ = snap["histograms"].get("serve_batch_occupancy")
+    launches = server.engine.launches
+    if launches >= fired:
+        failures.append(f"no batching: {launches} launches for {fired} "
+                        f"requests")
+    if not occ or occ["count"] < 1 or occ["sum"] < 1:
+        failures.append("batch-occupancy metric is empty")
+    elif occ["max"] < 2:
+        failures.append("no launch held more than one member")
+    if snap["counters"].get("serve_cache_hits_total", 0) < 1:
+        failures.append("no cache hit recorded")
+    if "serve_e2e_latency_s" not in snap["histograms"]:
+        failures.append("no end-to-end latency recorded")
+
+    print(f"selftest: {fired} requests -> {launches} launches, "
+          f"occupancy max {occ['max'] if occ else 0:.0f}, "
+          f"cache hits "
+          f"{snap['counters'].get('serve_cache_hits_total', 0):.0f}")
+    for f in failures:
+        print(f"FAIL: {f}", file=sys.stderr)
+    _write_metrics(args, registry, server,
+                   extra={"selftest_requests": fired,
+                          "selftest_failures": failures})
+    print("selftest " + ("FAILED" if failures else "passed"))
+    return 1 if failures else 0
+
+
+def run_requests(args, registry) -> int:
+    from heat2d_tpu.serve.schema import Rejected, SolveRequest
+    from heat2d_tpu.serve.server import SolveServer
+
+    try:
+        with open(args.requests) as f:
+            dicts = [json.loads(line) for line in f
+                     if line.strip()]
+    except (OSError, json.JSONDecodeError) as e:
+        print(f"bad --requests file: {e}\nQuitting...", file=sys.stderr)
+        return 1
+
+    out = (open(args.results_out, "w") if args.results_out
+           else sys.stdout)
+    server = SolveServer(
+        max_batch=args.max_batch, max_delay=args.max_delay,
+        max_queue=args.queue_depth, cache_size=args.cache_size,
+        default_timeout=args.timeout, registry=registry)
+    rc = 0
+    try:
+        with server:
+            futs = []
+            for d in dicts:
+                try:
+                    futs.append(server.submit(SolveRequest.from_dict(d)))
+                except Rejected as e:   # from_dict validation
+                    futs.append(None)
+                    out.write(json.dumps(e.to_record()) + "\n")
+            for fut in futs:
+                if fut is None:
+                    continue
+                try:
+                    out.write(json.dumps(
+                        fut.result(timeout=args.timeout + 60)
+                        .summary()) + "\n")
+                except Rejected as e:
+                    rc = 1
+                    out.write(json.dumps(e.to_record()) + "\n")
+                except Exception as e:  # noqa: BLE001
+                    rc = 1
+                    out.write(json.dumps(
+                        {"rejected": "error", "message": repr(e)}) + "\n")
+        _write_metrics(args, registry, server,
+                       extra={"requests": len(dicts)})
+    finally:
+        if out is not sys.stdout:
+            out.close()
+    return rc
+
+
+def _write_metrics(args, registry, server, extra=None) -> None:
+    if not args.metrics_out:
+        return
+    from heat2d_tpu.obs.record import build_record
+
+    record = build_record("serve", extra={
+        "launches": server.engine.launches,
+        "launch_log": [
+            {"signature": list(map(str, row["signature"])),
+             "occupancy": row["occupancy"],
+             "capacity": row["capacity"]}
+            for row in server.engine.launch_log],
+        **(extra or {})})
+    registry.write_jsonl(args.metrics_out,
+                         extra_records=[{"event": "run_record", **record}])
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    if args.log_level:
+        import logging
+        logging.basicConfig(
+            format="%(asctime)s %(name)s %(levelname)s %(message)s")
+        logging.getLogger("heat2d_tpu").setLevel(
+            getattr(logging, args.log_level.upper()))
+    platform = args.platform or ("cpu" if args.selftest else None)
+    if platform:
+        os.environ["JAX_PLATFORMS"] = platform
+        import jax
+        jax.config.update("jax_platforms", platform)
+
+    from heat2d_tpu.obs import MetricsRegistry
+    registry = MetricsRegistry()
+
+    if args.selftest:
+        return run_selftest(args, registry)
+    if args.requests:
+        return run_requests(args, registry)
+    print("nothing to do: pass --selftest or --requests FILE.jsonl "
+          "(a network listener is deliberately out of scope — embed "
+          "SolveServer in your process; docs/SERVING.md)",
+          file=sys.stderr)
+    return 2
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,112 @@
+"""Serve-side ensemble engine: one bucket -> one ``run_ensemble`` launch.
+
+A dispatched bucket is a list of same-signature requests whose (cx, cy)
+pairs differ — exactly the heterogeneous-params batch the ensemble
+runners were built for. This module turns the bucket into one launch:
+
+- **Warm executables.** The runner comes from
+  ``models.ensemble.batch_runner``, the per-signature compile cache: the
+  same jitted callable is reused for every launch of a signature, so
+  steady-state traffic never retraces (the one-shot entry points rebuild
+  ``jax.jit(partial(...))`` per call and retrace every time).
+- **Padded batch shapes.** jax re-specializes per batch size; a server
+  seeing occupancies 1..max_batch would compile up to max_batch
+  programs per signature. Launches pad the member axis up to the next
+  power of two (capped at ``max_batch``), replicating the last member's
+  (cx, cy) — an inert duplicate that cannot slow a convergence loop
+  beyond its twin — and crop on return, so a signature compiles
+  O(log max_batch) programs, once each.
+
+Metrics: ``serve_launches_total`` counter, ``serve_launch_s`` histogram,
+``serve_compile_cache_info`` gauges (hits/misses of the runner cache).
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import List, Tuple
+
+log = logging.getLogger("heat2d_tpu.serve")
+
+
+def _pad_capacity(n: int, cap: int) -> int:
+    """Next power of two >= n, capped at ``cap`` (cap wins even when it
+    is not itself a power of two — the bucket never exceeds max_batch)."""
+    p = 1
+    while p < n:
+        p *= 2
+    return min(p, cap)
+
+
+class EnsembleEngine:
+    """Executes buckets through the batched ensemble runners. Holds no
+    queue state of its own — the batcher owns scheduling; this owns the
+    numerics and the launch accounting."""
+
+    def __init__(self, registry=None, max_batch: int = 8):
+        self.registry = registry
+        self.max_batch = max_batch
+        self.launches = 0           # total ensemble launches performed
+        self.launch_log: List[dict] = []   # one row per launch (tests)
+
+    def solve_batch(self, requests) -> List[Tuple["object", int]]:
+        """Solve same-signature ``requests`` in ONE ensemble launch.
+        Returns one (u, steps_done) pair per request, in order."""
+        import numpy as np
+
+        from heat2d_tpu.models import ensemble
+
+        req0 = requests[0]
+        n = len(requests)
+        capacity = _pad_capacity(n, self.max_batch)
+        cxs = [r.cx for r in requests]
+        cys = [r.cy for r in requests]
+        # Pad members replicate the LAST real member: bitwise the same
+        # trajectory as their twin, so a convergence launch's while_loop
+        # exits exactly when the unpadded batch would.
+        cxs += [cxs[-1]] * (capacity - n)
+        cys += [cys[-1]] * (capacity - n)
+
+        cxs, cys, u0 = ensemble._validated_batch(
+            req0.nx, req0.ny, cxs, cys, None)
+        # Canonical schedule: fixed-step requests hand batch_runner
+        # (0, 0.0), never their unused interval/sensitivity, so one
+        # signature maps to exactly one memoized runner.
+        interval, sensitivity = req0.schedule()
+        runner = ensemble.batch_runner(
+            req0.nx, req0.ny, req0.steps, req0.method,
+            convergence=req0.convergence, interval=interval,
+            sensitivity=sensitivity)
+
+        timer = (self.registry.timer("serve_launch_s")
+                 if self.registry is not None else _null_ctx())
+        with timer:
+            out = runner(u0, cxs, cys)
+            if req0.convergence:
+                u, steps_done = out
+                u = np.asarray(u)
+                steps_done = [int(k) for k in np.asarray(steps_done)]
+            else:
+                u = np.asarray(out)
+                steps_done = [req0.steps] * capacity
+
+        self.launches += 1
+        self.launch_log.append({
+            "signature": req0.signature(), "occupancy": n,
+            "capacity": capacity})
+        if self.registry is not None:
+            self.registry.counter("serve_launches_total")
+            self.registry.gauge("serve_compile_cache_size",
+                                ensemble.batch_runner.cache_info().currsize)
+        log.debug("launch %d: %dx%d steps=%d occupancy=%d/%d",
+                  self.launches, req0.nx, req0.ny, req0.steps, n,
+                  capacity)
+        return [(u[i], steps_done[i]) for i in range(n)]
+
+
+class _null_ctx:
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False

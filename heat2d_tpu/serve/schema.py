@@ -1,0 +1,168 @@
+"""Solve-serving wire schema — requests, results, structured rejection.
+
+A ``SolveRequest`` is the FULL problem spec of one solve: grid shape,
+dtype, diffusivities, step/convergence schedule, and kernel method.
+Two derived keys drive the whole serving stack:
+
+- ``content_hash()`` — sha256 over the canonical spec. Two requests with
+  the same hash are the same computation, so they share a result-cache
+  entry and coalesce in flight (serve/cache.py single-flight).
+- ``signature()`` — the spec minus the per-member diffusivities. Two
+  requests with the same signature compile to the SAME executable
+  (cx/cy are traced operands of the batched ensemble runners —
+  models/ensemble.batch_runner), so the micro-batcher buckets by it and
+  dispatches each bucket as one ensemble launch.
+
+Everything here is host-side plain data; nothing imports jax, so schema
+validation and hashing stay cheap on the admission path.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+
+#: dtypes the batched ensemble runners are validated for (the reference
+#: stores f32; accum-dtype promotion is a CLI-solver concern, rejected
+#: at the ensemble entry — cli.py's unsupported-flag check).
+SUPPORTED_DTYPES = ("float32",)
+
+SUPPORTED_METHODS = ("auto", "jnp", "pallas", "band")
+
+
+class Rejected(Exception):
+    """Structured admission/serving rejection — load shedding, queue
+    timeout, shutdown. ``code`` is machine-readable; ``to_record()`` is
+    the JSONL shape the CLI and metrics events emit."""
+
+    def __init__(self, code: str, message: str, **fields):
+        super().__init__(f"{code}: {message}")
+        self.code = code
+        self.message = message
+        self.fields = fields
+
+    def to_record(self) -> dict:
+        return {"rejected": self.code, "message": self.message,
+                **self.fields}
+
+
+@dataclasses.dataclass(frozen=True)
+class SolveRequest:
+    """One solve: the reference's compile-time ``#define`` set as a
+    serving payload. Frozen: the hash/signature of an admitted request
+    must not drift while it sits in the queue."""
+
+    nx: int
+    ny: int
+    steps: int
+    cx: float = 0.1
+    cy: float = 0.1
+    dtype: str = "float32"
+    method: str = "auto"
+    convergence: bool = False
+    interval: int = 20
+    sensitivity: float = 0.1
+
+    def validate(self) -> "SolveRequest":
+        if self.nx < 3 or self.ny < 3:
+            raise Rejected("invalid", f"grid must be at least 3x3, got "
+                           f"{self.nx}x{self.ny}")
+        if self.steps < 0:
+            raise Rejected("invalid", f"steps must be >= 0, got "
+                           f"{self.steps}")
+        if self.dtype not in SUPPORTED_DTYPES:
+            raise Rejected("invalid", f"dtype {self.dtype!r} not in "
+                           f"{SUPPORTED_DTYPES}")
+        if self.method not in SUPPORTED_METHODS:
+            raise Rejected("invalid", f"method {self.method!r} not in "
+                           f"{SUPPORTED_METHODS}")
+        if self.convergence and self.interval < 1:
+            raise Rejected("invalid", f"interval must be >= 1, got "
+                           f"{self.interval}")
+        return self
+
+    def schedule(self) -> tuple:
+        """The (interval, sensitivity) pair as COMPUTED: canonicalized
+        to (0, 0.0) on fixed-step runs, where the convergence knobs are
+        unused — they must not fragment cache entries, batch buckets,
+        or compiled runners."""
+        if self.convergence:
+            return int(self.interval), float(self.sensitivity)
+        return 0, 0.0
+
+    def spec(self) -> dict:
+        """The canonical spec dict (all hashed fields, fixed order).
+        ``method`` hashes UNRESOLVED on purpose: resolving ``auto``
+        needs jax (and is device-dependent — two hosts can pick
+        different kernels), so the spec stays plain data and 'auto'
+        is its own cache/bucket key."""
+        interval, sensitivity = self.schedule()
+        return {
+            "nx": int(self.nx), "ny": int(self.ny),
+            "steps": int(self.steps),
+            "cx": float(self.cx), "cy": float(self.cy),
+            "dtype": self.dtype, "method": self.method,
+            "convergence": bool(self.convergence),
+            "interval": interval,
+            "sensitivity": sensitivity,
+        }
+
+    def content_hash(self) -> str:
+        """sha256 over the canonical JSON spec. repr-exact floats: two
+        requests hash equal iff they are the same computation bit-for-
+        bit (0.1 and 0.1000000001 are different cache entries)."""
+        blob = json.dumps(self.spec(), sort_keys=True,
+                          separators=(",", ":"))
+        return hashlib.sha256(blob.encode()).hexdigest()
+
+    def signature(self) -> tuple:
+        """The compiled-signature bucket key: every spec field EXCEPT
+        (cx, cy), which ride as traced operands through one executable.
+        Requests sharing a signature batch into one ensemble launch."""
+        return (self.nx, self.ny, self.steps, self.dtype, self.method,
+                self.convergence) + self.schedule()
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "SolveRequest":
+        known = {f.name for f in dataclasses.fields(cls)}
+        bad = set(d) - known
+        if bad:
+            raise Rejected("invalid",
+                           f"unknown request fields: {sorted(bad)}")
+        try:
+            return cls(**d).validate()
+        except TypeError as e:
+            raise Rejected("invalid", str(e)) from None
+
+
+@dataclasses.dataclass
+class SolveResult:
+    """One served solve. ``u`` is the final (nx, ny) grid (host numpy);
+    ``steps_done`` is the per-member iteration count on convergence runs
+    (== steps on fixed-step). ``cache_hit`` / ``coalesced`` say how the
+    request was served; ``batch_size`` is the occupancy of the launch
+    that computed it (1 for a cache hit's original cold solve)."""
+
+    u: "object"
+    steps_done: int
+    content_hash: str
+    cache_hit: bool = False
+    coalesced: bool = False
+    batch_size: int = 1
+
+    def summary(self) -> dict:
+        """JSON-safe row for the CLI's results stream (the grid itself
+        stays out — final_m<i>.dat-style dumps are the CLI's job)."""
+        import numpy as np
+        u = np.asarray(self.u)
+        return {
+            "content_hash": self.content_hash,
+            "steps_done": int(self.steps_done),
+            "cache_hit": bool(self.cache_hit),
+            "coalesced": bool(self.coalesced),
+            "batch_size": int(self.batch_size),
+            "shape": list(u.shape),
+            "max_temperature": float(u.max()),
+            "total_heat": float(u.sum()),
+        }

@@ -1,0 +1,208 @@
+"""The solve server: admission -> cache -> single-flight -> micro-batch
+-> ensemble launch, instrumented end to end.
+
+Request lifecycle (``SolveServer.submit``):
+
+1. **Validate** — malformed specs get ``Rejected("invalid")`` before
+   touching any shared state.
+2. **Cache** — a content-hash hit returns a completed future
+   immediately (the stored grid is the cold solve's output, bitwise).
+3. **Single-flight** — an identical request already in flight attaches
+   to the leader's future (one compute, N answers).
+4. **Queue** — the leader enters the micro-batcher's signature bucket;
+   over-depth load is shed at the door, queued requests can time out.
+5. **Launch** — the scheduler thread dispatches the bucket as one
+   ensemble launch through the per-signature compile cache; results
+   fill the cache, resolve futures, and record latency.
+
+``submit`` returns a ``concurrent.futures.Future[SolveResult]`` and
+never raises (rejections arrive AS the future's exception, uniformly,
+so async callers have one error path). ``Client`` is the synchronous
+wrapper tests and the CLI use.
+
+Metrics: ``serve_requests_total{outcome}`` counter and the
+``serve_e2e_latency_s`` histogram here, plus everything the cache /
+batcher / engine layers record (docs/SERVING.md has the full table).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from concurrent.futures import Future
+from typing import Optional
+
+from heat2d_tpu.serve.batcher import MicroBatcher
+from heat2d_tpu.serve.cache import ResultCache, SingleFlight
+from heat2d_tpu.serve.engine import EnsembleEngine
+from heat2d_tpu.serve.schema import Rejected, SolveRequest, SolveResult
+
+
+class SolveServer:
+    """In-process serving front end over the batched ensemble engine."""
+
+    def __init__(self, *, max_batch: int = 8, max_delay: float = 0.005,
+                 max_queue: int = 256, cache_size: int = 256,
+                 default_timeout: Optional[float] = 30.0,
+                 registry=None):
+        if registry is None:
+            from heat2d_tpu.obs import get_registry
+            registry = get_registry()
+        self.registry = registry
+        self.default_timeout = default_timeout
+        self.cache = ResultCache(cache_size, registry=registry)
+        self.flight = SingleFlight(registry=registry)
+        self.engine = EnsembleEngine(registry=registry,
+                                     max_batch=max_batch)
+        self.batcher = MicroBatcher(self._dispatch, max_batch=max_batch,
+                                    max_delay=max_delay,
+                                    max_queue=max_queue,
+                                    registry=registry)
+        self._started = False
+
+    # -- lifecycle ----------------------------------------------------- #
+
+    def start(self) -> "SolveServer":
+        self.batcher.start()
+        self._started = True
+        return self
+
+    def stop(self) -> None:
+        self._started = False
+        self.batcher.stop()
+
+    def __enter__(self) -> "SolveServer":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    # -- serving ------------------------------------------------------- #
+
+    def submit(self, req: SolveRequest,
+               timeout: Optional[float] = None) -> Future:
+        """Admit one request; the returned future resolves to a
+        ``SolveResult`` or fails with a structured ``Rejected``."""
+        t0 = time.monotonic()
+        timeout = self.default_timeout if timeout is None else timeout
+        try:
+            req.validate()
+        except Rejected as e:
+            self._count("rejected_invalid")
+            return _failed(e)
+        key = req.content_hash()
+
+        hit = self.cache.get(key)
+        if hit is not None:
+            self._count("cache_hit")
+            self._latency(t0)
+            fut = Future()
+            fut.set_result(SolveResult(
+                u=hit.u, steps_done=hit.steps_done, content_hash=key,
+                cache_hit=True, batch_size=hit.batch_size))
+            return fut
+
+        fut, leader = self.flight.claim(key)
+        if not leader:
+            self._count("coalesced")
+            # A derived future: the leader's result re-labeled
+            # coalesced=True (the grid itself is shared, not copied),
+            # so the caller can see HOW it was served.
+            out = Future()
+
+            def _relabel(f: Future) -> None:
+                exc = f.exception()
+                if exc is not None:
+                    out.set_exception(exc)
+                else:
+                    out.set_result(dataclasses.replace(
+                        f.result(), coalesced=True))
+
+            fut.add_done_callback(_relabel)
+            out.add_done_callback(lambda _f: self._latency(t0))
+            return out
+
+        def fail(exc: BaseException) -> None:
+            self._count("rejected_" + exc.code
+                        if isinstance(exc, Rejected) else "error")
+            self.flight.fail(key, exc)
+
+        try:
+            self.batcher.submit(req, key, fail, timeout=timeout)
+        except Rejected as e:
+            fail(e)
+        else:
+            self._count("admitted")
+        fut.add_done_callback(lambda _f: self._latency(t0))
+        return fut
+
+    def solve(self, req: SolveRequest,
+              timeout: Optional[float] = None) -> SolveResult:
+        """Synchronous convenience: submit + wait. Raises ``Rejected``."""
+        wait = self.default_timeout if timeout is None else timeout
+        # The queue deadline already bounds the wait; the extra slack
+        # only guards against a wedged scheduler thread.
+        return self.submit(req, timeout=timeout).result(
+            None if wait is None else wait + 60)
+
+    # -- dispatch (scheduler thread) ----------------------------------- #
+
+    def _dispatch(self, sig, batch) -> None:
+        """Bucket -> one launch -> per-request results. Any engine error
+        fails every member's flight entry (the batcher already guards
+        the thread)."""
+        try:
+            results = self.engine.solve_batch([p.req for p in batch])
+        except BaseException as e:  # noqa: BLE001 — routed, not dropped
+            for p in batch:
+                self.flight.fail(p.key, e)
+                self._count("error")
+            return
+        for p, (u, steps_done) in zip(batch, results):
+            res = SolveResult(u=u, steps_done=steps_done,
+                              content_hash=p.key,
+                              batch_size=len(batch))
+            self.cache.put(p.key, res)
+            self.flight.resolve(p.key, res)
+            self._count("completed")
+
+    # -- metrics ------------------------------------------------------- #
+
+    def _count(self, outcome: str) -> None:
+        if self.registry is not None:
+            self.registry.counter("serve_requests_total", outcome=outcome)
+
+    def _latency(self, t0: float) -> None:
+        if self.registry is not None:
+            self.registry.observe("serve_e2e_latency_s",
+                                  time.monotonic() - t0)
+
+
+class Client:
+    """Synchronous client for tests and the CLI. Requests may be given
+    as ``SolveRequest`` objects or keyword fields."""
+
+    def __init__(self, server: SolveServer):
+        self.server = server
+
+    def solve(self, req: Optional[SolveRequest] = None,
+              timeout: Optional[float] = None, **fields) -> SolveResult:
+        if req is None:
+            req = SolveRequest.from_dict(fields)
+        elif fields:
+            raise ValueError("pass a SolveRequest or fields, not both")
+        return self.server.solve(req, timeout=timeout)
+
+    def submit(self, req: Optional[SolveRequest] = None,
+               timeout: Optional[float] = None, **fields) -> Future:
+        if req is None:
+            req = SolveRequest.from_dict(fields)
+        elif fields:
+            raise ValueError("pass a SolveRequest or fields, not both")
+        return self.server.submit(req, timeout=timeout)
+
+
+def _failed(exc: BaseException) -> Future:
+    fut = Future()
+    fut.set_exception(exc)
+    return fut

@@ -1,0 +1,295 @@
+"""Solve-serving subsystem (heat2d_tpu/serve/): micro-batch coalescing,
+content-addressed caching with single-flight, admission control, and the
+serve-path telemetry contract (ISSUE 2 acceptance criteria)."""
+
+import json
+
+import numpy as np
+import pytest
+
+from heat2d_tpu.models import ensemble
+from heat2d_tpu.obs import MetricsRegistry
+from heat2d_tpu.serve import (Client, Rejected, SolveRequest, SolveResult,
+                              SolveServer)
+
+NX, NY, STEPS = 20, 24, 8
+
+
+def make_server(**kw):
+    kw.setdefault("registry", MetricsRegistry())
+    kw.setdefault("max_batch", 8)
+    kw.setdefault("max_delay", 0.1)
+    return SolveServer(**kw)
+
+
+def req(cx=0.1, cy=0.1, **kw):
+    kw.setdefault("nx", NX)
+    kw.setdefault("ny", NY)
+    kw.setdefault("steps", STEPS)
+    kw.setdefault("method", "jnp")
+    return SolveRequest(cx=cx, cy=cy, **kw)
+
+
+# --------------------------------------------------------------------- #
+# schema
+# --------------------------------------------------------------------- #
+
+def test_content_hash_and_signature():
+    a, b = req(cx=0.1), req(cx=0.2)
+    assert a.content_hash() == req(cx=0.1).content_hash()
+    assert a.content_hash() != b.content_hash()
+    # Different diffusivities, SAME compiled signature (one bucket).
+    assert a.signature() == b.signature()
+    # A different shape/steps-class is a different signature.
+    assert a.signature() != req(nx=NX + 8).signature()
+    assert a.signature() != req(steps=STEPS + 1).signature()
+
+
+def test_fixed_step_ignores_convergence_knobs():
+    """interval/sensitivity are unused on fixed-step runs — they must
+    not fragment cache entries, batch buckets, or compiled runners."""
+    a, b = req(interval=20), req(interval=7, sensitivity=9.9)
+    assert a.content_hash() == b.content_hash()
+    assert a.signature() == b.signature()
+    # On convergence runs they ARE the computation.
+    c = req(convergence=True, interval=7)
+    d = req(convergence=True, interval=8)
+    assert c.signature() != d.signature()
+    assert c.content_hash() != d.content_hash()
+
+
+def test_request_validation_is_structured():
+    with pytest.raises(Rejected) as e:
+        SolveRequest(nx=1, ny=1, steps=5).validate()
+    assert e.value.code == "invalid"
+    with pytest.raises(Rejected):
+        SolveRequest.from_dict({"nx": 8, "ny": 8, "steps": 1,
+                                "bogus_field": 3})
+    with pytest.raises(Rejected):
+        SolveRequest(nx=8, ny=8, steps=1, dtype="float64").validate()
+
+
+# --------------------------------------------------------------------- #
+# batching / coalescing (the acceptance-criteria test)
+# --------------------------------------------------------------------- #
+
+def test_n_concurrent_requests_fewer_than_n_launches():
+    """N same-shape concurrent requests are served by STRICTLY fewer
+    than N ensemble launches, and every member's grid is bitwise the
+    grid a standalone ensemble launch of that (cx, cy) produces."""
+    n = 5
+    reqs = [req(cx=0.05 + 0.01 * i) for i in range(n)]
+    with make_server() as server:
+        results = [f.result(timeout=60)
+                   for f in [server.submit(r) for r in reqs]]
+        launches = server.engine.launches
+    assert launches < n                      # strictly fewer: coalesced
+    assert launches == 1                     # same signature, one bucket
+    assert server.engine.launch_log[0]["occupancy"] == n
+    for r, res in zip(reqs, results):
+        assert isinstance(res, SolveResult)
+        assert res.batch_size == n and res.steps_done == STEPS
+        solo = np.asarray(ensemble.run_ensemble(
+            NX, NY, STEPS, [r.cx], [r.cy], method="jnp"))[0]
+        assert np.asarray(res.u).tobytes() == solo.tobytes()
+
+
+def test_duplicate_inflight_requests_coalesce_to_one_member():
+    """Two identical in-flight requests share one compute — one launch,
+    occupancy 1, the same grid, and the follower is labeled
+    coalesced."""
+    registry = MetricsRegistry()
+    with make_server(registry=registry) as server:
+        f1 = server.submit(req(cx=0.17))
+        f2 = server.submit(req(cx=0.17))
+        r1, r2 = f1.result(timeout=60), f2.result(timeout=60)
+        assert server.engine.launches == 1
+        assert server.engine.launch_log[0]["occupancy"] == 1
+    assert r2.u is r1.u          # shared, never recomputed or copied
+    assert r2.coalesced and not r1.coalesced
+    snap = registry.snapshot()
+    assert snap["counters"]["serve_coalesced_total"] == 1
+    assert snap["counters"]["serve_requests_total{outcome=coalesced}"] == 1
+
+
+def test_mixed_shape_traffic_lands_in_separate_buckets():
+    shapes = [(NX, NY), (16, 12)]
+    with make_server() as server:
+        futs = [server.submit(req(nx=nx, ny=ny, cx=0.05 + 0.01 * i))
+                for i, (nx, ny) in enumerate(shapes * 2)]
+        results = [f.result(timeout=60) for f in futs]
+    assert server.engine.launches == 2
+    sigs = {row["signature"] for row in server.engine.launch_log}
+    assert len(sigs) == 2
+    for res, (nx, ny) in zip(results, shapes * 2):
+        assert np.asarray(res.u).shape == (nx, ny)
+
+
+def test_convergence_requests_serve_steps_done():
+    r = req(cx=0.1, convergence=True, interval=4, sensitivity=1e30)
+    with make_server() as server:
+        res = Client(server).solve(r, timeout=60)
+    # Infinite sensitivity: converges at the first check.
+    assert res.steps_done == 4
+    u_ref, k_ref = ensemble.run_ensemble_convergence(
+        NX, NY, STEPS, 4, 1e30, [r.cx], [r.cy], method="jnp")
+    assert int(np.asarray(k_ref)[0]) == res.steps_done
+    assert np.asarray(res.u).tobytes() == np.asarray(u_ref)[0].tobytes()
+
+
+# --------------------------------------------------------------------- #
+# cache
+# --------------------------------------------------------------------- #
+
+def test_cache_hit_is_bitwise_identical_to_cold_solve():
+    r = req(cx=0.123)
+    with make_server() as server:
+        client = Client(server)
+        cold = client.solve(r, timeout=60)
+        warm = client.solve(r, timeout=60)
+        assert server.engine.launches == 1   # second one never computed
+    assert not cold.cache_hit and warm.cache_hit
+    assert np.asarray(warm.u).tobytes() == np.asarray(cold.u).tobytes()
+    # And bitwise against a COLD solve on a fresh server too.
+    with make_server() as fresh:
+        cold2 = Client(fresh).solve(r, timeout=60)
+    assert np.asarray(cold2.u).tobytes() == np.asarray(warm.u).tobytes()
+
+
+def test_cache_lru_bound_evicts():
+    from heat2d_tpu.serve.cache import ResultCache
+    c = ResultCache(capacity=2)
+    c.put("a", 1)
+    c.put("b", 2)
+    assert c.get("a") == 1      # refresh a: b is now LRU
+    c.put("c", 3)
+    assert c.get("b") is None and c.get("a") == 1 and c.get("c") == 3
+    assert c.evictions == 1
+
+
+# --------------------------------------------------------------------- #
+# admission control
+# --------------------------------------------------------------------- #
+
+def test_queue_full_sheds_load_with_structured_rejection():
+    registry = MetricsRegistry()
+    # max_delay far beyond the test: nothing dispatches, the queue fills.
+    with make_server(registry=registry, max_queue=2, max_batch=100,
+                     max_delay=60.0) as server:
+        futs = [server.submit(req(cx=0.05 + 0.01 * i)) for i in range(3)]
+        with pytest.raises(Rejected) as e:
+            futs[2].result(timeout=10)
+        assert e.value.code == "queue_full"
+        assert "content_hash" in e.value.to_record()
+        assert not futs[0].done() and not futs[1].done()
+    # stop() rejects whatever was still queued — nobody hangs.
+    for f in futs[:2]:
+        with pytest.raises(Rejected) as e:
+            f.result(timeout=10)
+        assert e.value.code == "shutdown"
+    snap = registry.snapshot()
+    assert snap["counters"][
+        "serve_rejected_total{reason=queue_full}"] == 1
+    assert snap["counters"][
+        "serve_requests_total{outcome=rejected_queue_full}"] == 1
+
+
+def test_ready_buckets_dispatch_oldest_head_first():
+    """A sustained hot signature must not starve other buckets: among
+    ready buckets the scheduler serves the one with the OLDEST head,
+    not the first-inserted (which a non-empty hot bucket keeps being)."""
+    import time
+
+    from heat2d_tpu.serve.batcher import MicroBatcher
+
+    mb = MicroBatcher(lambda sig, batch: None, max_batch=1,
+                      max_delay=0.0)
+    mb._running = True          # admit without starting the thread
+    hot = req(cx=0.1)           # bucket A, inserted first
+    other = req(nx=NX + 8, cx=0.1)   # bucket B
+    hot2 = req(cx=0.2)          # bucket A again — A stays non-empty
+    for r in (hot, other, hot2):
+        mb.submit(r, r.content_hash(), lambda e: None)
+        time.sleep(0.002)       # strictly ordered enqueue stamps
+    now = time.monotonic() + 1.0
+    order = []
+    for _ in range(3):
+        sig, batch = mb._pop_ready_locked(now)
+        order.append(batch[0].req.content_hash())
+    # Insertion-order service would yield hot, hot2, other.
+    assert order == [r.content_hash() for r in (hot, other, hot2)]
+    assert mb.depth() == 0
+
+
+def test_per_request_timeout_returns_structured_rejection():
+    with make_server(max_delay=60.0, max_batch=100) as server:
+        fut = server.submit(req(cx=0.3), timeout=0.05)
+        with pytest.raises(Rejected) as e:
+            fut.result(timeout=10)
+    assert e.value.code == "timeout"
+    rec = e.value.to_record()
+    assert rec["rejected"] == "timeout" and rec["waited_s"] >= 0.05
+
+
+# --------------------------------------------------------------------- #
+# compile cache
+# --------------------------------------------------------------------- #
+
+def test_batch_runner_is_memoized_per_signature():
+    a = ensemble.batch_runner(NX, NY, STEPS, "jnp")
+    b = ensemble.batch_runner(NX, NY, STEPS, "jnp")
+    c = ensemble.batch_runner(NX, NY, STEPS + 1, "jnp")
+    assert a is b           # warm signature: the SAME jitted callable
+    assert a is not c
+
+
+def test_pad_capacity_power_of_two_capped():
+    from heat2d_tpu.serve.engine import _pad_capacity
+    assert [_pad_capacity(n, 8) for n in (1, 2, 3, 5, 8)] == \
+        [1, 2, 4, 8, 8]
+    assert _pad_capacity(5, 6) == 6      # cap wins over the power of 2
+
+
+# --------------------------------------------------------------------- #
+# telemetry contract (--metrics-out JSONL via the CLI selftest)
+# --------------------------------------------------------------------- #
+
+def test_serve_cli_selftest_emits_telemetry_jsonl(tmp_path):
+    from heat2d_tpu.serve.cli import main
+
+    path = tmp_path / "serve.jsonl"
+    assert main(["--selftest", "--metrics-out", str(path)]) == 0
+    lines = [json.loads(l) for l in path.read_text().splitlines()]
+    snap = [l for l in lines if l["event"] == "snapshot"][0]
+    # The acceptance-criteria metric families, in the JSONL snapshot:
+    assert "serve_queue_depth" in snap["gauges"]          # queue depth
+    occ = snap["histograms"]["serve_batch_occupancy"]     # occupancy
+    assert occ["count"] >= 1 and occ["max"] >= 2
+    assert snap["counters"]["serve_cache_hits_total"] >= 1
+    assert snap["gauges"]["serve_cache_hit_rate"] > 0     # hit rate
+    assert snap["histograms"]["serve_e2e_latency_s"]["count"] >= 1
+    rec = [l for l in lines if l["event"] == "run_record"][0]
+    assert rec["kind"] == "serve" and rec["launches"] >= 1
+
+
+def test_serve_cli_requests_file(tmp_path):
+    from heat2d_tpu.serve.cli import main
+
+    reqs = tmp_path / "reqs.jsonl"
+    reqs.write_text("\n".join(json.dumps(d) for d in [
+        {"nx": NX, "ny": NY, "steps": 4, "cx": 0.1, "cy": 0.1,
+         "method": "jnp"},
+        {"nx": NX, "ny": NY, "steps": 4, "cx": 0.2, "cy": 0.1,
+         "method": "jnp"},
+        {"nx": 4, "ny": 4, "steps": -1},        # invalid -> rejection row
+    ]) + "\n")
+    out = tmp_path / "results.jsonl"
+    rc = main(["--requests", str(reqs), "--results-out", str(out),
+               "--max-delay", "0.05"])
+    rows = [json.loads(l) for l in out.read_text().splitlines()]
+    assert rc == 0          # invalid rows are reported, not fatal
+    ok = [r for r in rows if "content_hash" in r]
+    bad = [r for r in rows if r.get("rejected")]
+    assert len(ok) == 2 and len(bad) == 1
+    assert bad[0]["rejected"] == "invalid"
+    assert ok[0]["steps_done"] == 4 and ok[0]["shape"] == [NX, NY]

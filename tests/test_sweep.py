@@ -132,6 +132,15 @@ def test_suspect_rows_guards_largest_large_grid():
         {"mode": "pallas", "grid": "640x512", "step_time_s": 2.4e-6},
     ]
     assert sweep.suspect_rows(recs) == []
+    # Only the kernel-backed STREAMING modes (pallas/hybrid) are held to
+    # the flat-per-cell premise: serial's whole-grid XLA loop may
+    # legitimately slow per-cell as grids outgrow cache, and a genuine
+    # serial row must not re-measure the whole group (advisor r5).
+    recs = [
+        {"mode": "serial", "grid": "4096x4096", "step_time_s": 7.6e-5},
+        {"mode": "serial", "grid": "8192x8192", "step_time_s": 3.3e-3},
+    ]
+    assert sweep.suspect_rows(recs) == []
 
 
 def test_suspect_rows_monotonicity():
