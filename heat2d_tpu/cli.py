@@ -81,15 +81,27 @@ def build_parser() -> argparse.ArgumentParser:
                         "(MPI-IO byte format)")
     o.add_argument("--checkpoint", default=None,
                    help="path to write a loadable checkpoint of the final "
-                        "state")
+                        "state. An existing DIRECTORY (or a path ending "
+                        "in '/') selects managed mode: crash-consistent "
+                        "snapshots under a manifest with retention and "
+                        "torn-entry fallback (resil/, docs/RESILIENCE.md)")
     o.add_argument("--checkpoint-every", type=int, default=None,
                    metavar="K",
-                   help="with --checkpoint: also write it every K steps "
-                        "(periodic restart points for long runs; the "
-                        "failure-recovery hook the reference lacked — "
-                        "SURVEY.md 5.3/5.4)")
+                   help="with --checkpoint: also write a restart point "
+                        "every K steps (periodic failure-recovery hook "
+                        "the reference lacked — SURVEY.md 5.3/5.4). "
+                        "Snapshots are written ASYNC, off the timed "
+                        "segments (resil.AsyncCheckpointer)")
+    o.add_argument("--checkpoint-keep", type=int, default=3, metavar="N",
+                   help="managed (directory) checkpoints retained before "
+                        "old snapshots are garbage-collected (0 = keep "
+                        "all)")
     o.add_argument("--resume", default=None,
-                   help="checkpoint to resume from (remaining steps run)")
+                   help="checkpoint to resume from (remaining steps "
+                        "run): a checkpoint file, or a checkpoint "
+                        "DIRECTORY — resumes from the newest snapshot "
+                        "that loads verified, falling back past "
+                        "torn/corrupt entries")
     o.add_argument("--run-record", default=None,
                    help="path for the JSON run record")
     o.add_argument("--metrics-out", default=None, metavar="PATH",
@@ -167,7 +179,7 @@ def _apply_platform(args) -> None:
 
 
 def _run_with_periodic_checkpoints(solver, u0, cfg, args, start_step,
-                                   primary):
+                                   ckpt):
     """Drive the run in K-step segments, writing a restart point after
     each — the periodic-dump failure-recovery hook SURVEY.md §5.3/5.4
     notes the reference lacked. With convergence on, K must be a multiple
@@ -175,8 +187,15 @@ def _run_with_periodic_checkpoints(solver, u0, cfg, args, start_step,
     residual semantic difference left: convergence landing exactly on a
     segment boundary is only noticed one INTERVAL into the next segment.
     Reported elapsed is the sum of segment timings (host checkpoint I/O
-    excluded, matching the reference's clock placement)."""
-    from heat2d_tpu.io import save_checkpoint
+    excluded, matching the reference's clock placement).
+
+    ``ckpt`` is a ``resil.AsyncCheckpointer``: each restart point is
+    snapshotted to host between segments and written/committed on a
+    background thread while the next segment computes, so checkpoint
+    I/O no longer serializes with the run even in wall-clock terms.
+    Multihost stays collective-safe — the writer keeps every barrier on
+    this (main) thread. The final ``flush`` (in ``close``) makes every
+    snapshot durable before the CLI reports success."""
     from heat2d_tpu.models.solver import Heat2DSolver, RunResult
 
     k = args.checkpoint_every
@@ -188,42 +207,37 @@ def _run_with_periodic_checkpoints(solver, u0, cfg, args, start_step,
             f"({solver.config.interval}) when --convergence is on, so the "
             f"residual-check schedule matches an unsegmented run")
 
-    def write_restart(u, step):
-        """Restart point from the still-device-resident (possibly
-        host-spanning) state: the collective per-shard path when the
-        array spans processes (all ranks participate, no rank
-        materializes the global grid), a rank-0 host write otherwise."""
-        if not getattr(u, "is_fully_addressable", True) or primary:
-            save_checkpoint(u, step, cfg, args.checkpoint,
-                            shape=cfg.shape)
-
     total = solver.config.steps
     seg_solvers = {}
     u, done, elapsed = u0, 0, 0.0
     r = None
-    while done < total:
-        n = min(k, total - done)
-        # Warm up (untimed priming run) only the first time each distinct
-        # segment length executes; repeats reuse the compiled runner.
-        fresh = n not in seg_solvers
-        if fresh:
-            seg_solvers[n] = Heat2DSolver(solver.config.replace(steps=n))
-        seg = seg_solvers[n]
-        # gather=False: the carry stays sharded on-device across
-        # segments — no cross-host allgather + re-place per K steps
-        # (VERDICT r3 weak #5); the next segment consumes r.u directly.
-        r = seg.run(u0=u, warmup=fresh, gather=False)
-        u = r.u
-        done += r.steps_done
-        elapsed += r.elapsed
-        write_restart(u, start_step + done)
-        if r.steps_done < n:  # converged early inside the segment
-            break
-    if r is not None:
-        final_u = u
-    else:  # zero remaining steps: still honor --checkpoint
-        final_u = solver.run(u0=u0, timed=False, gather=False).u
-        write_restart(final_u, start_step)
+    with ckpt:
+        while done < total:
+            n = min(k, total - done)
+            # Warm up (untimed priming run) only the first time each
+            # distinct segment length executes; repeats reuse the
+            # compiled runner.
+            fresh = n not in seg_solvers
+            if fresh:
+                seg_solvers[n] = Heat2DSolver(
+                    solver.config.replace(steps=n))
+            seg = seg_solvers[n]
+            # gather=False: the carry stays sharded on-device across
+            # segments — no cross-host allgather + re-place per K steps
+            # (VERDICT r3 weak #5); the next segment consumes r.u
+            # directly.
+            r = seg.run(u0=u, warmup=fresh, gather=False)
+            u = r.u
+            done += r.steps_done
+            elapsed += r.elapsed
+            ckpt.save_async(u, start_step + done)
+            if r.steps_done < n:  # converged early inside the segment
+                break
+        if r is not None:
+            final_u = u
+        else:  # zero remaining steps: still honor --checkpoint
+            final_u = solver.run(u0=u0, timed=False, gather=False).u
+            ckpt.save_async(final_u, start_step)
     return RunResult(u=final_u, steps_done=done,
                      elapsed=elapsed, config=solver.config)
 
@@ -495,10 +509,48 @@ def main(argv=None) -> int:
                 f"N={row['north']} S={row['south']} "
                 f"W={row['west']} E={row['east']}")
 
+    # Managed-checkpoint mode: an existing directory (or trailing '/')
+    # selects the resil.CheckpointManager — manifest, retention/GC, and
+    # torn-entry fallback on resume (docs/RESILIENCE.md).
+    from heat2d_tpu.io.binary import CheckpointCorruptError
+    from heat2d_tpu.resil import (AsyncCheckpointer, CheckpointManager,
+                                  is_manager_dir)
+    ckpt_manager = None
+    if args.checkpoint and (is_manager_dir(args.checkpoint)
+                            or args.checkpoint.endswith(os.sep)):
+        ckpt_manager = CheckpointManager(
+            args.checkpoint, keep=args.checkpoint_keep or None,
+            registry=registry)
+
     start_step = 0
+    resumed = False
     if args.resume:
-        grid, start_step, ck_cfg = load_checkpoint(args.resume,
-                                                   shape=cfg.shape)
+        try:
+            if is_manager_dir(args.resume):
+                # registry=None: the CLI records the restore below —
+                # the manager would double-count it.
+                found = CheckpointManager(
+                    args.resume, keep=None).latest_valid()
+                if found is None:
+                    print(f"ERROR: no valid checkpoint in "
+                          f"{args.resume} (every manifest entry is "
+                          f"missing or torn)\nQuitting...",
+                          file=sys.stderr)
+                    return 1
+                grid, start_step, ck_cfg = found
+            else:
+                grid, start_step, ck_cfg = load_checkpoint(
+                    args.resume, shape=cfg.shape)
+        except CheckpointCorruptError as e:
+            print(f"ERROR: checkpoint failed integrity verification "
+                  f"({e}); pass a checkpoint DIRECTORY to fall back to "
+                  f"the previous snapshot\nQuitting...", file=sys.stderr)
+            return 1
+        resumed = True
+        say(f"Resuming from step {start_step}")
+        if registry is not None:
+            registry.counter("resil_restore_total")
+            registry.gauge("resil_restore_step", start_step)
         if tuple(grid.shape) != cfg.shape:
             print(f"ERROR: checkpoint grid is {grid.shape[0]}x"
                   f"{grid.shape[1]} but config is {cfg.nxprob}x"
@@ -556,6 +608,7 @@ def main(argv=None) -> int:
             # uneven decompositions / resume re-place is stripped).
             write_dat(grid_to_host(u0, init_bin), "initial.dat")
 
+        ckpt_writer = None
         try:
             from heat2d_tpu.utils.profiling import profile_span
             with profile_span(args.profile):
@@ -564,8 +617,12 @@ def main(argv=None) -> int:
                         raise ConfigError(
                             "--checkpoint-every requires --checkpoint "
                             "(the path the restart points are written to)")
+                    ckpt_writer = AsyncCheckpointer(
+                        ckpt_manager if ckpt_manager is not None
+                        else args.checkpoint,
+                        cfg, shape=cfg.shape, registry=registry)
                     result = _run_with_periodic_checkpoints(
-                        solver, u0, cfg, args, start_step, primary)
+                        solver, u0, cfg, args, start_step, ckpt_writer)
                 else:
                     # gather=False: output is written per-shard when it
                     # spans hosts; the global grid is only assembled (or
@@ -590,7 +647,16 @@ def main(argv=None) -> int:
             write_dat(u_host, "final.dat")
         if args.checkpoint and not args.checkpoint_every:
             # (the periodic path already saved the final restart point)
-            if not getattr(result.u, "is_fully_addressable", True):
+            if ckpt_manager is not None:
+                if not getattr(result.u, "is_fully_addressable", True):
+                    # collective per-shard snapshot (all ranks)
+                    ckpt_manager.save(result.u, total_steps, cfg,
+                                      shape=cfg.shape)
+                elif primary:
+                    if u_host is None:
+                        u_host = grid_to_host(result.u)
+                    ckpt_manager.save(u_host, total_steps, cfg)
+            elif not getattr(result.u, "is_fully_addressable", True):
                 # collective per-shard checkpoint write (all ranks)
                 save_checkpoint(result.u, total_steps, cfg,
                                 args.checkpoint, shape=cfg.shape)
@@ -604,6 +670,10 @@ def main(argv=None) -> int:
         # compile/warmup metric; the CLI adds its mode-specific extras.
         record = result.to_record()
         record["total_steps_including_resume"] = total_steps
+        if resumed:
+            record["resume_from_step"] = start_step
+        if ckpt_writer is not None:
+            record["checkpoints_written"] = ckpt_writer.saves
         if solver.mesh is not None:
             from heat2d_tpu.parallel.mesh import mesh_devices_summary
             record["mesh"] = mesh_devices_summary(solver.mesh)

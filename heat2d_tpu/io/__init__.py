@@ -6,6 +6,9 @@ from heat2d_tpu.io.writers import (
     read_grid_text,
 )
 from heat2d_tpu.io.binary import (
+    CheckpointCorruptError,
+    checkpoint_tmp_path,
+    commit_checkpoint_files,
     write_binary,
     write_binary_sharded,
     read_binary,
@@ -19,6 +22,9 @@ __all__ = [
     "write_grid_baseline",
     "write_grid_rowmajor",
     "read_grid_text",
+    "CheckpointCorruptError",
+    "checkpoint_tmp_path",
+    "commit_checkpoint_files",
     "write_binary",
     "write_binary_sharded",
     "read_binary",

@@ -11,10 +11,37 @@ doubles as a restart point.
 
 from __future__ import annotations
 
+import hashlib
 import json
 import os
 
 import numpy as np
+
+
+class CheckpointCorruptError(ValueError):
+    """A checkpoint failed its integrity checks (sha256 digest mismatch,
+    truncated binary, unreadable sidecar) — a torn write, not a usable
+    restart point. ``resil.CheckpointManager.latest_valid`` catches this
+    and falls back to the previous snapshot."""
+
+
+def _sha256_file(path, chunk: int = 1 << 20) -> str:
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        while True:
+            b = f.read(chunk)
+            if not b:
+                break
+            h.update(b)
+    return h.hexdigest()
+
+
+def _fsync_path(path) -> None:
+    fd = os.open(path, os.O_RDONLY)
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
 
 
 def write_binary(u, path) -> None:
@@ -79,18 +106,72 @@ def read_binary(path, shape) -> np.ndarray:
     return a.reshape(shape)
 
 
+def checkpoint_tmp_path(path) -> str:
+    """The staging file a checkpoint is written to before its atomic
+    commit. Deterministic (not per-pid): on the multihost shared-FS path
+    every rank must target the ONE staging file."""
+    return str(path) + ".tmp"
+
+
+def commit_checkpoint_files(tmp_path, path, step: int, config,
+                            out_shape) -> None:
+    """Atomically promote a fully-written staging binary to a durable
+    checkpoint: digest -> fsync -> ``os.replace`` the binary -> atomic
+    sidecar with the digest. Crash windows (exercised by resil/chaos.py):
+
+    - before the binary replace: only ``tmp_path`` exists — the previous
+      checkpoint pair is untouched and still loads;
+    - between the two replaces: the NEW binary sits beside the OLD (or a
+      missing) sidecar, whose ``sha256`` no longer matches — a torn pair
+      ``load_checkpoint`` rejects as ``CheckpointCorruptError``;
+    - after the sidecar replace: the new checkpoint is complete.
+    """
+    from heat2d_tpu.resil import chaos
+    chaos.checkpoint_point("mid_write")
+    digest = _sha256_file(tmp_path)
+    _fsync_path(tmp_path)
+    os.replace(tmp_path, path)
+    chaos.checkpoint_point("pre_meta")
+    meta = {
+        "step": int(step),
+        "shape": [int(s) for s in out_shape],
+        "dtype": "float32",
+        "sha256": digest,
+        "config": config.to_dict() if hasattr(config, "to_dict")
+                  else dict(config or {}),
+        "format": "heat2d-tpu-checkpoint-v1",
+    }
+    meta_path = str(path) + ".meta.json"
+    meta_tmp = meta_path + ".tmp"
+    with open(meta_tmp, "w") as f:
+        json.dump(meta, f, indent=2)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(meta_tmp, meta_path)
+    # fsync the directory: os.replace orders the renames but does not
+    # make them durable — power loss could otherwise drop both entries
+    # even after the caller was told the checkpoint committed.
+    _fsync_path(os.path.dirname(os.path.abspath(str(path))))
+
+
 def save_checkpoint(u, step: int, config, path, shape=None) -> None:
-    """State dump + sidecar. ``path`` is the binary file; sidecar is
-    ``path + '.meta.json'``.
+    """State dump + sidecar, committed CRASH-CONSISTENTLY: the binary is
+    staged to ``path + '.tmp'`` and promoted with ``os.replace``, then
+    the sidecar (``path + '.meta.json'``, carrying the binary's sha256)
+    is replaced the same way — at every instant the pair on disk either
+    loads verified or is detectably torn, never silently half-new
+    (``commit_checkpoint_files`` documents the crash windows).
 
     Host arrays write locally (call on one rank). A host-spanning
     jax.Array writes via write_binary_sharded — then the call is
-    COLLECTIVE (all processes) and rank 0 writes the sidecar; pass
-    ``shape`` to crop equal-shard padding.
+    COLLECTIVE (all processes): every rank stages into the one shared
+    temp file, and rank 0 commits after the collective write's closing
+    barrier; pass ``shape`` to crop equal-shard padding.
     """
     collective = not getattr(u, "is_fully_addressable", True)
+    tmp = checkpoint_tmp_path(path)
     if collective:
-        write_binary_sharded(u, path, shape=shape)
+        write_binary_sharded(u, tmp, shape=shape)
         import jax
         primary = jax.process_index() == 0
         out_shape = shape if shape is not None else u.shape
@@ -99,37 +180,64 @@ def save_checkpoint(u, step: int, config, path, shape=None) -> None:
         u = np.asarray(u)
         if shape is not None and tuple(u.shape) != tuple(shape):
             u = u[:shape[0], :shape[1]]
-        write_binary(u, path)
+        write_binary(u, tmp)
         out_shape = u.shape
     if primary:
-        meta = {
-            "step": int(step),
-            "shape": [int(s) for s in out_shape],
-            "dtype": "float32",
-            "config": config.to_dict() if hasattr(config, "to_dict") else dict(config or {}),
-            "format": "heat2d-tpu-checkpoint-v1",
-        }
-        with open(str(path) + ".meta.json", "w") as f:
-            json.dump(meta, f, indent=2)
+        commit_checkpoint_files(tmp, path, step, config, out_shape)
     if collective:
         import jax
         if jax.process_count() > 1:
-            # No rank may return before the sidecar exists: a driver that
-            # proceeds on a non-zero rank (e.g. immediately resumes) must
-            # not race a missing/stale sidecar.
+            # No rank may return before the commit is complete: a driver
+            # that proceeds on a non-zero rank (e.g. immediately resumes)
+            # must not race a missing/stale pair.
             from jax.experimental import multihost_utils
             multihost_utils.sync_global_devices(f"checkpoint:meta:{path}")
 
 
-def load_checkpoint(path, shape=None):
+def load_checkpoint(path, shape=None, verify: bool = True):
     """Returns (grid, step, config_dict). If no sidecar exists (e.g. a raw
-    reference ``final_binary.dat``), ``shape`` is required and step=0."""
+    reference ``final_binary.dat``), ``shape`` is required and step=0.
+
+    When the sidecar carries a ``sha256`` digest (every checkpoint since
+    the atomic-commit format) the binary is verified against it;
+    mismatch, truncation, or an unreadable sidecar raise
+    ``CheckpointCorruptError`` — a torn pair must not load as if intact.
+    ``verify=False`` skips the digest check (debugging torn files).
+    """
     meta_path = str(path) + ".meta.json"
     if os.path.exists(meta_path):
-        with open(meta_path) as f:
-            meta = json.load(f)
-        grid = read_binary(path, tuple(meta["shape"]))
-        return grid, int(meta["step"]), meta.get("config", {})
+        try:
+            with open(meta_path) as f:
+                meta = json.load(f)
+            meta_shape = tuple(meta["shape"])
+            step = int(meta["step"])
+            digest = meta.get("sha256")
+        except (json.JSONDecodeError, KeyError, ValueError,
+                TypeError) as e:
+            raise CheckpointCorruptError(f"{path}: {e}") from e
+        # One disk read serves both the digest check and the grid:
+        # latest_valid() walks manifest entries with this, so a resume
+        # never pays double I/O per snapshot tried.
+        try:
+            with open(path, "rb") as f:
+                buf = f.read()
+        except OSError as e:
+            raise CheckpointCorruptError(f"{path}: {e}") from e
+        if verify and digest is not None:
+            actual = hashlib.sha256(buf).hexdigest()
+            if actual != digest:
+                raise CheckpointCorruptError(
+                    f"{path}: sha256 mismatch (sidecar {digest[:12]}…, "
+                    f"file {actual[:12]}…) — torn or corrupt checkpoint")
+        a = np.frombuffer(buf, dtype=np.float32)
+        expected = int(np.prod(meta_shape))
+        if a.size != expected:
+            raise CheckpointCorruptError(
+                f"{path}: expected {expected} float32 values for shape "
+                f"{meta_shape}, found {a.size}")
+        # .copy(): frombuffer is read-only; callers get a writable grid
+        # exactly as np.fromfile used to hand them.
+        return a.reshape(meta_shape).copy(), step, meta.get("config", {})
     if shape is None:
         raise ValueError(f"no sidecar at {meta_path}; pass shape= explicitly")
     return read_binary(path, shape), 0, {}

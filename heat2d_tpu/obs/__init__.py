@@ -32,6 +32,18 @@ and docs/SERVING.md carry the full tables):
                 counters; ``serve_batch_occupancy``,
                 ``serve_batch_fill``, ``serve_queue_wait_s``,
                 ``serve_launch_s``, ``serve_e2e_latency_s`` histograms.
+- resil/:       ``resil_ckpt_saves_total``, ``resil_ckpt_gc_total``,
+                ``resil_ckpt_skipped_torn_total``,
+                ``resil_restore_total``,
+                ``resil_chaos_injected_total{point}`` counters;
+                ``resil_ckpt_retained``, ``resil_ckpt_latest_step``,
+                ``resil_ckpt_pending``, ``resil_restore_step`` gauges;
+                ``resil_ckpt_save_s``, ``resil_ckpt_async_write_s``
+                histograms — plus the serve-side resilience family
+                (``serve_retries_total``, ``serve_launch_failures_
+                total``, ``serve_watchdog_timeouts_total``,
+                ``serve_degraded`` gauge, ``serve_degraded_shed_
+                total``, ``serve_breaker_trips_total``).
 """
 
 from heat2d_tpu.obs.metrics import MetricsRegistry, get_registry

@@ -1,0 +1,63 @@
+"""Resilience subsystem — one failure-domain model through every layer.
+
+The reference's durability story was a collective MPI-IO dump with no
+loader, no atomicity, and no resume orchestration (grad1612_mpi_heat.c:
+178-190, SURVEY.md §5.4): a crash mid-write leaves a torn restart point
+and a long run dies with it. This package is the fault-tolerance layer
+the north star ("serve heavy traffic ... handle as many scenarios as
+you can imagine") requires, threaded through io/, models/, serve/, obs/
+and the CLI:
+
+- ``manager``  — ``CheckpointManager``: crash-consistent snapshots
+                 (temp + ``os.replace`` commit, sha256-verified
+                 sidecars — io/binary.py), a step->file manifest with
+                 retention/GC, and ``latest_valid()`` that skips torn
+                 entries.
+- ``writer``   — ``AsyncCheckpointer``: double-buffered off-hot-loop
+                 checkpoint writes; collectives stay on the main thread
+                 (pipelined commit) so the multihost sharded path is
+                 barrier-safe.
+- ``chaos``    — fault injection (kill mid-checkpoint-write, fail N
+                 launches, inject latency) driven by ``HEAT2D_CHAOS_*``
+                 env vars or ``install()``, so CI exercises REAL
+                 failure paths.
+- ``retry``    — ``RetryPolicy``/``call_with_retries`` (capped
+                 exponential backoff for transients), ``Watchdog``
+                 (deadline -> structured timeout instead of a hang),
+                 ``DegradedMode`` (consecutive-failure circuit breaker:
+                 shed fresh load, keep serving the cache).
+
+Metric families (obs/ registry; docs/RESILIENCE.md has the table):
+``resil_ckpt_*`` (saves, GC, torn-skips, async write timing, pending
+gauge), ``resil_restore_*`` (count + step), ``resil_chaos_injected_
+total{point}``, and the serve-side ``serve_retries_total``,
+``serve_watchdog_timeouts_total``, ``serve_degraded`` gauge,
+``serve_degraded_shed_total``, ``serve_breaker_trips_total``.
+
+Nothing in this package touches a traced value: with chaos disarmed and
+no checkpointing requested, compiled programs are byte-identical to a
+build without it (pinned by tests/test_resil.py).
+"""
+
+from heat2d_tpu.io.binary import CheckpointCorruptError
+from heat2d_tpu.resil.chaos import ChaosConfig, ChaosError
+from heat2d_tpu.resil.manager import CheckpointManager, is_manager_dir
+from heat2d_tpu.resil.retry import (DegradedMode, RetryPolicy,
+                                    TransientError, Watchdog,
+                                    call_with_retries, default_transient)
+from heat2d_tpu.resil.writer import AsyncCheckpointer
+
+__all__ = [
+    "AsyncCheckpointer",
+    "ChaosConfig",
+    "ChaosError",
+    "CheckpointCorruptError",
+    "CheckpointManager",
+    "DegradedMode",
+    "RetryPolicy",
+    "TransientError",
+    "Watchdog",
+    "call_with_retries",
+    "default_transient",
+    "is_manager_dir",
+]

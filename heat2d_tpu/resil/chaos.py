@@ -1,0 +1,202 @@
+"""Fault-injection harness — deliberate failures on demand.
+
+The resilience subsystem's claims (crash-consistent checkpoints, retrying
+serve launches, degraded-mode shedding) are only claims until a real
+failure path executes. This module injects those failures on purpose:
+
+- **kill a checkpoint mid-write** — ``HEAT2D_CHAOS_KILL_CKPT_AT=N``
+  hard-kills the process (``os._exit(137)``, the SIGKILL exit code) at
+  the Nth checkpoint's commit point. ``HEAT2D_CHAOS_KILL_CKPT_PHASE``
+  picks the window: ``mid_write`` (default — only the temp file exists,
+  the previous checkpoint must stay durable) or ``pre_meta`` (the binary
+  was replaced but its sidecar was not — a torn pair the digest check
+  must catch).
+- **fail N launches** — ``HEAT2D_CHAOS_FAIL_LAUNCHES=N`` makes the first
+  N serve-engine launches raise ``ChaosError`` (a transient the retry
+  policy must absorb).
+- **inject latency** — ``HEAT2D_CHAOS_LAUNCH_LATENCY_S`` /
+  ``HEAT2D_CHAOS_CKPT_LATENCY_S`` sleep inside the launch / checkpoint
+  write (drives watchdog-deadline and async-overlap tests).
+
+Config comes from the environment (so CI can chaos a whole CLI
+subprocess without code changes) or programmatically via ``install()``
+(so in-process tests can scope an injection). **Zero overhead when
+idle**: every hook first checks a module-level ``_enabled`` flag that is
+only set by ``install()`` or the presence of ``HEAT2D_CHAOS_*`` env
+vars; nothing here ever touches a traced value, so chaos cannot change
+a compiled program — only the host-side orchestration around it.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import threading
+import time
+from typing import Optional
+
+_ENV_PREFIX = "HEAT2D_CHAOS_"
+
+#: phases of a checkpoint commit where a kill can be injected
+CKPT_PHASES = ("mid_write", "pre_meta")
+
+
+class ChaosError(RuntimeError):
+    """An injected transient failure (``resil.retry`` classifies it as
+    retryable, like the real launch transients it stands in for)."""
+
+
+@dataclasses.dataclass
+class ChaosConfig:
+    """One injection campaign. All fields off by default."""
+
+    kill_ckpt_at: Optional[int] = None      # 1-based checkpoint ordinal
+    kill_ckpt_phase: str = "mid_write"
+    fail_launches: int = 0                  # first N launches raise
+    launch_latency_s: float = 0.0
+    ckpt_latency_s: float = 0.0
+
+    def __post_init__(self):
+        if self.kill_ckpt_phase not in CKPT_PHASES:
+            raise ValueError(
+                f"kill_ckpt_phase must be one of {CKPT_PHASES}, got "
+                f"{self.kill_ckpt_phase!r}")
+
+    @classmethod
+    def from_env(cls, env=os.environ) -> Optional["ChaosConfig"]:
+        """A config iff any HEAT2D_CHAOS_* var is set, else None."""
+        def get(name, cast, default):
+            v = env.get(_ENV_PREFIX + name)
+            return default if v in (None, "") else cast(v)
+
+        cfg = cls(
+            kill_ckpt_at=get("KILL_CKPT_AT", int, None),
+            kill_ckpt_phase=get("KILL_CKPT_PHASE", str, "mid_write"),
+            fail_launches=get("FAIL_LAUNCHES", int, 0),
+            launch_latency_s=get("LAUNCH_LATENCY_S", float, 0.0),
+            ckpt_latency_s=get("CKPT_LATENCY_S", float, 0.0))
+        if (cfg.kill_ckpt_at is None and not cfg.fail_launches
+                and not cfg.launch_latency_s and not cfg.ckpt_latency_s):
+            return None
+        return cfg
+
+    def any_active(self) -> bool:
+        return bool(self.kill_ckpt_at is not None or self.fail_launches
+                    or self.launch_latency_s or self.ckpt_latency_s)
+
+
+class _Controller:
+    """Active campaign + its counters. Thread-safe: checkpoint commits
+    may run on the async writer thread, launches on the scheduler
+    thread."""
+
+    def __init__(self, config: ChaosConfig, registry=None):
+        self.config = config
+        self.registry = registry
+        self._lock = threading.Lock()
+        self.ckpt_count = 0      # checkpoints that reached mid_write
+        self.launch_count = 0
+        self.launches_failed = 0
+
+    def _count(self, point: str) -> None:
+        if self.registry is not None:
+            self.registry.counter("resil_chaos_injected_total",
+                                  point=point)
+
+    # -- hooks --------------------------------------------------------- #
+
+    def checkpoint_point(self, phase: str) -> None:
+        cfg = self.config
+        with self._lock:
+            if phase == "mid_write":
+                self.ckpt_count += 1
+            n = self.ckpt_count
+        if phase == "mid_write" and cfg.ckpt_latency_s:
+            self._count("ckpt_latency")
+            time.sleep(cfg.ckpt_latency_s)
+        if (cfg.kill_ckpt_at is not None and n == cfg.kill_ckpt_at
+                and phase == cfg.kill_ckpt_phase):
+            # Hard kill: no atexit, no finally blocks — the closest a
+            # test harness gets to power loss / SIGKILL preemption.
+            os._exit(137)
+
+    def launch_point(self) -> None:
+        cfg = self.config
+        with self._lock:
+            self.launch_count += 1
+            fail = self.launches_failed < cfg.fail_launches
+            if fail:
+                self.launches_failed += 1
+                n = self.launches_failed
+        if cfg.launch_latency_s:
+            self._count("launch_latency")
+            time.sleep(cfg.launch_latency_s)
+        if fail:
+            self._count("launch_failure")
+            raise ChaosError(
+                f"injected launch failure {n}/{cfg.fail_launches}")
+
+
+_lock = threading.Lock()
+_controller: Optional[_Controller] = None
+_enabled = False        # fast-path guard: False == all hooks are no-ops
+_env_checked = False
+
+
+def install(config: Optional[ChaosConfig], registry=None) -> None:
+    """Activate a campaign programmatically (tests); ``None`` disarms."""
+    global _controller, _enabled, _env_checked
+    with _lock:
+        _env_checked = True     # explicit install overrides env loading
+        if config is None or not config.any_active():
+            _controller, _enabled = None, False
+        else:
+            _controller = _Controller(config, registry=registry)
+            _enabled = True
+
+
+def uninstall() -> None:
+    """Disarm and forget the campaign; env vars are re-read next hook
+    (fresh processes pick their campaign up from the environment)."""
+    global _controller, _enabled, _env_checked
+    with _lock:
+        _controller, _enabled, _env_checked = None, False, False
+
+
+def controller() -> Optional[_Controller]:
+    """The active controller, loading HEAT2D_CHAOS_* on first use."""
+    global _controller, _enabled, _env_checked
+    if not _env_checked:
+        with _lock:
+            if not _env_checked:
+                cfg = ChaosConfig.from_env()
+                if cfg is not None:
+                    _controller = _Controller(cfg)
+                    _enabled = True
+                _env_checked = True
+    return _controller
+
+
+def enabled() -> bool:
+    controller()
+    return _enabled
+
+
+# -- the hooks subsystems call (cheap no-ops when idle) ---------------- #
+
+def checkpoint_point(phase: str) -> None:
+    """Called by the checkpoint commit path at each crash window."""
+    if not _enabled and _env_checked:
+        return
+    c = controller()
+    if c is not None:
+        c.checkpoint_point(phase)
+
+
+def launch_point() -> None:
+    """Called by the serve engine before each ensemble launch."""
+    if not _enabled and _env_checked:
+        return
+    c = controller()
+    if c is not None:
+        c.launch_point()

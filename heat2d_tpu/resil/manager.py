@@ -1,0 +1,208 @@
+"""CheckpointManager — a directory of crash-consistent restart points.
+
+One manager owns one directory:
+
+```
+ckpts/
+  MANIFEST.json               # step -> file, newest last (atomic replace)
+  ckpt_00000040.bin           # raw f32 grid (MPI-IO byte format)
+  ckpt_00000040.bin.meta.json # step/shape/config + sha256 of the binary
+  ckpt_00000080.bin
+  ...
+```
+
+Every snapshot goes through ``io.binary.save_checkpoint``'s staged
+commit (temp + ``os.replace``, digest in the sidecar), then the manifest
+is rewritten atomically and snapshots beyond the retention window are
+garbage-collected. ``latest_valid()`` walks the manifest newest-first,
+skipping any entry that fails to load verified (torn pair, truncated
+binary, missing files) — the fallback that turns "a crash mid-write"
+into "resume from the previous snapshot" instead of a dead run.
+
+Multihost: ``save`` is COLLECTIVE when the array spans processes (the
+per-shard write path needs every rank); manifest/GC bookkeeping is
+rank 0's, bracketed by a closing barrier so no rank resumes against a
+manifest that is still being written. ``latest_valid`` is host-local
+(reads the shared filesystem).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import logging
+import os
+import re
+from typing import Optional
+
+from heat2d_tpu.io.binary import (CheckpointCorruptError, load_checkpoint,
+                                  save_checkpoint)
+
+log = logging.getLogger("heat2d_tpu.resil")
+
+MANIFEST_NAME = "MANIFEST.json"
+MANIFEST_FORMAT = "heat2d-tpu-checkpoint-manifest-v1"
+_CKPT_RE = re.compile(r"^ckpt_(\d{8})\.bin$")
+
+
+def is_manager_dir(path) -> bool:
+    """True when ``path`` names a checkpoint DIRECTORY (existing dir, or
+    a manifest already inside it) rather than a single checkpoint file —
+    how the CLI decides which resume/checkpoint flavor a path means."""
+    p = str(path)
+    return os.path.isdir(p) or os.path.exists(
+        os.path.join(p, MANIFEST_NAME))
+
+
+class CheckpointManager:
+    """Retention + manifest + torn-entry fallback over atomic snapshots.
+
+    ``keep``: number of newest snapshots retained (None/0 = keep all).
+    """
+
+    def __init__(self, directory, keep: Optional[int] = 3, registry=None):
+        if keep is not None and keep < 0:
+            raise ValueError(f"keep must be >= 0 or None, got {keep}")
+        self.directory = str(directory)
+        self.keep = keep or None
+        self.registry = registry
+        os.makedirs(self.directory, exist_ok=True)
+
+    # -- paths --------------------------------------------------------- #
+
+    def path_for(self, step: int) -> str:
+        return os.path.join(self.directory, f"ckpt_{int(step):08d}.bin")
+
+    @property
+    def manifest_path(self) -> str:
+        return os.path.join(self.directory, MANIFEST_NAME)
+
+    # -- manifest ------------------------------------------------------ #
+
+    def manifest(self) -> list:
+        """Entries as recorded, oldest first: ``[{"step", "file"}, ...]``.
+        A missing/corrupt manifest degrades to a directory scan (the
+        manifest is an index, not the source of truth — the verified
+        sidecars are)."""
+        try:
+            with open(self.manifest_path) as f:
+                m = json.load(f)
+            entries = sorted(m["entries"], key=lambda e: int(e["step"]))
+            return [{"step": int(e["step"]), "file": str(e["file"])}
+                    for e in entries]
+        except (OSError, ValueError, KeyError, TypeError):
+            return self._scan()
+
+    def _scan(self) -> list:
+        out = []
+        try:
+            names = os.listdir(self.directory)
+        except OSError:
+            return out
+        for name in names:
+            m = _CKPT_RE.match(name)
+            if m:
+                out.append({"step": int(m.group(1)), "file": name})
+        return sorted(out, key=lambda e: e["step"])
+
+    def steps(self) -> list:
+        return [e["step"] for e in self.manifest()]
+
+    def _write_manifest(self, entries) -> None:
+        from heat2d_tpu.io.binary import _fsync_path
+        tmp = self.manifest_path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump({"format": MANIFEST_FORMAT,
+                       "entries": entries}, f, indent=2)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, self.manifest_path)
+        # directory fsync: the rename must survive power loss, like the
+        # checkpoint pair it indexes (io.binary.commit_checkpoint_files)
+        _fsync_path(self.directory)
+
+    # -- save ---------------------------------------------------------- #
+
+    def save(self, u, step: int, config, shape=None) -> str:
+        """Snapshot ``u`` at ``step`` (atomic commit), index it, GC the
+        retention overflow. Returns the checkpoint path. COLLECTIVE when
+        ``u`` spans processes — every rank must call."""
+        path = self.path_for(step)
+        collective = not getattr(u, "is_fully_addressable", True)
+        timer = (self.registry.timer("resil_ckpt_save_s")
+                 if self.registry is not None else contextlib.nullcontext())
+        with timer:
+            save_checkpoint(u, step, config, path, shape=shape)
+            self.index(step)
+        if collective:
+            import jax
+            if jax.process_count() > 1:
+                from jax.experimental import multihost_utils
+                multihost_utils.sync_global_devices(
+                    f"ckpt-manager:save:{path}")
+        return path
+
+    def index(self, step: int) -> None:
+        """Record a committed snapshot in the manifest and apply the
+        retention policy (rank 0 only — a no-op elsewhere)."""
+        if not self._primary():
+            return
+        entries = [e for e in self.manifest() if e["step"] != int(step)]
+        entries.append({"step": int(step),
+                        "file": os.path.basename(self.path_for(step))})
+        entries.sort(key=lambda e: e["step"])
+        pruned = []
+        if self.keep is not None and len(entries) > self.keep:
+            pruned, entries = (entries[:-self.keep], entries[-self.keep:])
+        self._write_manifest(entries)
+        for e in pruned:
+            self._unlink(os.path.join(self.directory, e["file"]))
+        if self.registry is not None:
+            self.registry.counter("resil_ckpt_saves_total")
+            if pruned:
+                self.registry.counter("resil_ckpt_gc_total", len(pruned))
+            self.registry.gauge("resil_ckpt_retained", len(entries))
+            self.registry.gauge("resil_ckpt_latest_step",
+                                entries[-1]["step"])
+
+    @staticmethod
+    def _primary() -> bool:
+        import jax
+        return jax.process_index() == 0
+
+    def _unlink(self, path) -> None:
+        for p in (path, str(path) + ".meta.json",
+                  str(path) + ".tmp"):
+            try:
+                os.remove(p)
+            except OSError:
+                pass
+
+    # -- restore ------------------------------------------------------- #
+
+    def latest_valid(self, shape=None):
+        """The newest checkpoint that LOADS VERIFIED, as
+        ``(grid, step, config_dict)`` — or ``None`` when no entry
+        survives. Torn/corrupt/missing entries are skipped (counted as
+        ``resil_ckpt_skipped_torn_total``) and the walk falls back to
+        the previous snapshot, so one crash mid-write never strands a
+        resumable run."""
+        for entry in reversed(self.manifest()):
+            path = os.path.join(self.directory, entry["file"])
+            try:
+                grid, step, cfg = load_checkpoint(path, shape=shape)
+            except (CheckpointCorruptError, OSError, ValueError) as e:
+                log.warning("skipping torn checkpoint %s: %s", path, e)
+                if self.registry is not None:
+                    self.registry.counter(
+                        "resil_ckpt_skipped_torn_total")
+                continue
+            if self.registry is not None:
+                self.registry.counter("resil_restore_total")
+                self.registry.gauge("resil_restore_step", step)
+            return grid, step, cfg
+        return None
+
+    def latest_step(self) -> Optional[int]:
+        steps = self.steps()
+        return steps[-1] if steps else None

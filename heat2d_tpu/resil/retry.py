@@ -1,0 +1,231 @@
+"""Retry, watchdog, and degraded-mode policies — transient failures
+absorbed, hangs bounded, overload shed.
+
+Three small host-side mechanisms the serving layer (and any driver)
+composes:
+
+- ``RetryPolicy`` + ``call_with_retries`` — capped exponential backoff
+  for TRANSIENT failures (injected ``ChaosError``, runtime/IO errors).
+  Structured admission decisions (``serve.schema.Rejected``) and
+  programming errors are never retried: a rejection is an answer, not a
+  fault.
+- ``Watchdog`` — a deadline on a block of work; on expiry it fires a
+  callback (the server converts in-flight futures into structured
+  ``Rejected("watchdog_timeout")``) instead of letting callers hang on
+  a wedged launch.
+- ``DegradedMode`` — a consecutive-failure circuit breaker: after
+  ``threshold`` failures it OPENS for ``cooldown`` seconds, during
+  which fresh work is shed at admission (the content-addressed cache
+  keeps answering warm signatures — partial availability instead of a
+  pile-up). After the cooldown one probe is admitted (HALF-OPEN); its
+  success closes the breaker, its failure re-opens it.
+
+Everything here is deterministic (no jitter: reproducibility is a
+project invariant) and registry-instrumented but registry-optional.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import threading
+import time
+from typing import Callable, Optional
+
+from heat2d_tpu.resil.chaos import ChaosError
+
+log = logging.getLogger("heat2d_tpu.resil")
+
+
+class TransientError(RuntimeError):
+    """Marker for failures a caller knows to be retry-safe."""
+
+
+def default_transient(exc: BaseException) -> bool:
+    """Conservative transience classification: injected chaos, explicit
+    transients, OS/IO errors, and accelerator-runtime failures (matched
+    by class name — ``XlaRuntimeError``/``JaxRuntimeError`` move between
+    modules across jax versions). Rejections, config and programming
+    errors are terminal."""
+    if isinstance(exc, (ChaosError, TransientError, OSError,
+                        TimeoutError)):
+        return True
+    name = type(exc).__name__
+    return name in ("XlaRuntimeError", "JaxRuntimeError")
+
+
+@dataclasses.dataclass(frozen=True)
+class RetryPolicy:
+    """Capped exponential backoff: attempt i (0-based re-try index)
+    sleeps ``min(base_delay * backoff**i, max_delay)``."""
+
+    max_attempts: int = 3       # total tries, including the first
+    base_delay: float = 0.05
+    backoff: float = 2.0
+    max_delay: float = 2.0
+
+    def __post_init__(self):
+        if self.max_attempts < 1:
+            raise ValueError(
+                f"max_attempts must be >= 1, got {self.max_attempts}")
+
+    def delay(self, retry_index: int) -> float:
+        return min(self.base_delay * self.backoff ** retry_index,
+                   self.max_delay)
+
+
+def call_with_retries(fn: Callable, policy: RetryPolicy, *,
+                      classify: Callable[[BaseException], bool] = None,
+                      on_retry: Callable[[int, BaseException], None] = None,
+                      sleep: Callable[[float], None] = time.sleep):
+    """Run ``fn()`` under ``policy``. Non-transient failures (per
+    ``classify``, default ``default_transient``) propagate immediately;
+    transients retry with backoff until attempts run out, then the LAST
+    failure propagates. ``on_retry(retry_index, exc)`` fires before each
+    backoff sleep (metrics hook)."""
+    classify = default_transient if classify is None else classify
+    for attempt in range(policy.max_attempts):
+        try:
+            return fn()
+        except BaseException as e:  # noqa: BLE001 — classified below
+            last_try = attempt == policy.max_attempts - 1
+            if last_try or not classify(e):
+                raise
+            if on_retry is not None:
+                on_retry(attempt, e)
+            log.warning("transient failure (attempt %d/%d), retrying "
+                        "in %.3fs: %r", attempt + 1,
+                        policy.max_attempts, policy.delay(attempt), e)
+            sleep(policy.delay(attempt))
+    raise AssertionError("unreachable")  # loop always returns or raises
+
+
+class Watchdog:
+    """Deadline on a block: ``with Watchdog(2.0, on_timeout): work()``.
+    If ``work`` outlives the deadline, ``on_timeout()`` fires ONCE from
+    a timer thread (the block itself keeps running — Python cannot
+    safely preempt it — but its waiters get structured answers instead
+    of a hang). ``fired`` says whether the deadline hit."""
+
+    def __init__(self, deadline_s: Optional[float],
+                 on_timeout: Callable[[], None]):
+        self.deadline_s = deadline_s
+        self.on_timeout = on_timeout
+        self.fired = False
+        self._timer: Optional[threading.Timer] = None
+
+    def _fire(self) -> None:
+        self.fired = True
+        try:
+            self.on_timeout()
+        except Exception:   # broken callback must not kill timer thread
+            log.exception("watchdog on_timeout callback failed")
+
+    def __enter__(self) -> "Watchdog":
+        if self.deadline_s is not None:
+            self._timer = threading.Timer(self.deadline_s, self._fire)
+            self._timer.daemon = True
+            self._timer.start()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        if self._timer is not None:
+            self._timer.cancel()
+
+
+class DegradedMode:
+    """Consecutive-failure circuit breaker (closed -> open -> half-open).
+
+    Thread-safe. ``allow()`` is the admission question: True while
+    CLOSED; False while OPEN (shed); after ``cooldown`` seconds exactly
+    one caller gets True as the HALF-OPEN probe and the rest stay shed
+    until its verdict arrives via ``record_success``/``record_failure``
+    — or until the probe token expires after one more ``cooldown``
+    (a probe that hangs and never reports must not shed forever).
+    """
+
+    def __init__(self, threshold: int = 5, cooldown: float = 5.0,
+                 registry=None, clock: Callable[[], float] = time.monotonic):
+        if threshold < 1:
+            raise ValueError(f"threshold must be >= 1, got {threshold}")
+        self.threshold = threshold
+        self.cooldown = cooldown
+        self.registry = registry
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._failures = 0          # consecutive
+        self._opened_at: Optional[float] = None
+        self._probing = False
+        self._probe_at: Optional[float] = None
+        self.trips = 0
+
+    # -- state --------------------------------------------------------- #
+
+    @property
+    def state(self) -> str:
+        with self._lock:
+            return self._state_locked()
+
+    def _state_locked(self) -> str:
+        if self._opened_at is None:
+            return "closed"
+        if self._probing:
+            return "half_open"
+        if self._clock() - self._opened_at >= self.cooldown:
+            return "half_open"
+        return "open"
+
+    # -- transitions --------------------------------------------------- #
+
+    def allow(self) -> bool:
+        with self._lock:
+            s = self._state_locked()
+            if s == "closed":
+                return True
+            if s == "half_open":
+                now = self._clock()
+                if (self._probing and self._probe_at is not None
+                        and now - self._probe_at < self.cooldown):
+                    return False    # a live probe holds the token
+                # First probe — or the previous probe's verdict never
+                # arrived (a hung launch, exactly the sickness the
+                # breaker guards against): the token expires after one
+                # cooldown, so a wedged probe cannot shed forever.
+                self._probing = True
+                self._probe_at = now
+                self._gauge_locked()
+                return True
+            return False
+
+    def record_success(self) -> None:
+        with self._lock:
+            self._failures = 0
+            if self._opened_at is not None:
+                log.info("degraded mode cleared (probe succeeded)")
+            self._opened_at = None
+            self._probing = False
+            self._gauge_locked()
+
+    def record_failure(self) -> None:
+        with self._lock:
+            self._failures += 1
+            reopen = self._probing
+            self._probing = False
+            if reopen or self._failures >= self.threshold:
+                if self._opened_at is None:
+                    self.trips += 1
+                    log.warning(
+                        "degraded mode TRIPPED after %d consecutive "
+                        "failures (cooldown %.1fs)", self._failures,
+                        self.cooldown)
+                    if self.registry is not None:
+                        self.registry.counter(
+                            "serve_breaker_trips_total")
+                self._opened_at = self._clock()
+            self._gauge_locked()
+
+    def _gauge_locked(self) -> None:
+        if self.registry is not None:
+            self.registry.gauge(
+                "serve_degraded",
+                0.0 if self._opened_at is None else 1.0)

@@ -1,0 +1,248 @@
+"""Async double-buffered checkpoint writer — restart points off the
+hot loop.
+
+The synchronous path serializes host checkpoint I/O between compute
+segments: the device sits idle while sha256 + file write run. This
+writer splits a checkpoint into its two real phases and overlaps the
+expensive one with compute:
+
+1. **Snapshot** (main thread, cheap): the device state is brought to
+   host memory — ``np.asarray`` for a fully-addressable array, a
+   per-shard local copy for a host-spanning one. No file I/O yet.
+2. **Write + commit** (background thread): the snapshot is staged,
+   digested, and atomically promoted (``io.binary``'s temp +
+   ``os.replace`` protocol), then indexed into the ``CheckpointManager``
+   manifest. The NEXT segment's compute overlaps this entirely.
+
+Double-buffered: at most ONE write is in flight; ``save_async`` first
+waits out the previous write (so a slow disk back-pressures to
+checkpoint cadence instead of queueing unbounded snapshots), then
+returns as soon as the new snapshot is captured.
+
+**Collective safety (multihost)**: jax collectives must execute in the
+same order on every rank, so the background thread NEVER runs one. For
+a host-spanning array the collective pieces — pre-sizing the shared
+staging file and the all-ranks-done barrier before rank 0 commits — run
+on the MAIN thread inside ``save_async``/``flush``; only the rank-local
+block writes ride the background thread. The commit of checkpoint N is
+therefore deferred to the next ``save_async`` (or ``flush``): the
+pipelined-commit pattern — checkpoint N becomes durable while N+1
+computes, and the manifest only ever indexes fully-barriered files.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import logging
+import os
+import threading
+from concurrent.futures import Future, ThreadPoolExecutor
+from typing import Optional
+
+import numpy as np
+
+from heat2d_tpu.io.binary import (checkpoint_tmp_path,
+                                  commit_checkpoint_files, write_binary)
+from heat2d_tpu.resil.manager import CheckpointManager
+
+log = logging.getLogger("heat2d_tpu.resil")
+
+
+@dataclasses.dataclass
+class _PendingCommit:
+    """A collective checkpoint whose local writes are in flight; the
+    commit (barrier + rank-0 promote + manifest) is still owed."""
+    step: int
+    tmp: str
+    path: str
+    config: object
+    out_shape: tuple
+
+
+class AsyncCheckpointer:
+    """Write restart points without blocking the run.
+
+    ``target`` is a ``CheckpointManager`` (directory mode: manifest,
+    retention, ``latest_valid``) or a plain path (single-file restart
+    point, overwritten atomically each save).
+    """
+
+    def __init__(self, target, config, shape=None, registry=None):
+        self.manager = target if isinstance(target, CheckpointManager) \
+            else None
+        self.path = None if self.manager is not None else str(target)
+        self.config = config
+        self.shape = tuple(shape) if shape is not None else None
+        self.registry = registry
+        self._pool = ThreadPoolExecutor(
+            max_workers=1, thread_name_prefix="heat2d-ckpt")
+        self._future: Optional[Future] = None
+        self._pending: Optional[_PendingCommit] = None
+        self._closed = False
+        self._lock = threading.Lock()
+        self.saves = 0
+
+    # -- public -------------------------------------------------------- #
+
+    def save_async(self, u, step: int) -> None:
+        """Snapshot ``u`` and schedule its durable commit. Returns once
+        the snapshot is host-resident — file I/O overlaps the caller's
+        next segment. COLLECTIVE when ``u`` spans processes (all ranks
+        call, same order); a fully-addressable array is written by
+        rank 0 only and the call is a no-op elsewhere."""
+        with self._lock:
+            if self._closed:
+                raise RuntimeError("AsyncCheckpointer is closed")
+            self._finish_pending_locked()
+            collective = not getattr(u, "is_fully_addressable", True)
+            if collective:
+                self._save_collective_locked(u, step)
+            else:
+                self._save_local_locked(u, step)
+            self.saves += 1
+            self._gauge_pending()
+
+    def flush(self) -> None:
+        """Wait until every scheduled checkpoint is durable (commit
+        barriers included). COLLECTIVE under multihost, like the saves
+        it drains. Write errors surface here (and on the next
+        ``save_async``), never silently."""
+        with self._lock:
+            self._finish_pending_locked()
+            self._gauge_pending()
+
+    def close(self) -> None:
+        try:
+            self.flush()
+        finally:
+            self._closed = True
+            self._pool.shutdown(wait=True)
+
+    def __enter__(self) -> "AsyncCheckpointer":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    # -- local (fully-addressable) path -------------------------------- #
+
+    def _save_local_locked(self, u, step: int) -> None:
+        import jax
+        if jax.process_index() != 0:
+            return
+        host = np.asarray(u)
+        if self.shape is not None and tuple(host.shape) != self.shape:
+            host = host[:self.shape[0], :self.shape[1]]
+        path = self._path_for(step)
+        self._future = self._pool.submit(
+            self._write_and_commit, host, step, path)
+
+    def _write_and_commit(self, host, step, path) -> None:
+        timer = (self.registry.timer("resil_ckpt_async_write_s")
+                 if self.registry is not None else contextlib.nullcontext())
+        with timer:
+            tmp = checkpoint_tmp_path(path)
+            write_binary(host, tmp)
+            commit_checkpoint_files(tmp, path, step, self.config,
+                                    host.shape)
+            if self.manager is not None:
+                self.manager.index(step)
+            elif self.registry is not None:
+                self.registry.counter("resil_ckpt_saves_total")
+        log.debug("async checkpoint committed: step=%d path=%s",
+                  step, path)
+
+    # -- collective (host-spanning) path ------------------------------- #
+
+    def _save_collective_locked(self, u, step: int) -> None:
+        import jax
+
+        path = self._path_for(step)
+        tmp = checkpoint_tmp_path(path)
+        nx, ny = self.shape if self.shape is not None else u.shape
+        # MAIN-THREAD collective prologue: rank 0 sizes the shared
+        # staging file; the barrier orders it before any rank's writes.
+        if jax.process_index() == 0:
+            with open(tmp, "wb") as f:
+                f.truncate(nx * ny * 4)
+        self._barrier(f"async-ckpt:create:{tmp}")
+        # Rank-local snapshot (device->host copy, no collective).
+        blocks = []
+        for sh in u.addressable_shards:
+            if sh.replica_id != 0:
+                continue
+            rs, cs = sh.index
+            blocks.append((rs.start or 0, cs.start or 0,
+                           np.asarray(sh.data, dtype=np.float32)))
+        self._future = self._pool.submit(
+            self._write_blocks, tmp, blocks, nx, ny)
+        self._pending = _PendingCommit(
+            step=step, tmp=tmp, path=path, config=self.config,
+            out_shape=(nx, ny))
+
+    def _write_blocks(self, tmp, blocks, nx, ny) -> None:
+        timer = (self.registry.timer("resil_ckpt_async_write_s")
+                 if self.registry is not None else contextlib.nullcontext())
+        with timer:
+            mm = np.memmap(tmp, dtype=np.float32, mode="r+",
+                           shape=(nx, ny))
+            try:
+                for r0, c0, blk in blocks:
+                    if r0 >= nx or c0 >= ny:
+                        continue          # shard wholly in the padding
+                    r1 = min(r0 + blk.shape[0], nx)
+                    c1 = min(c0 + blk.shape[1], ny)
+                    mm[r0:r1, c0:c1] = blk[:r1 - r0, :c1 - c0]
+                mm.flush()
+            finally:
+                del mm
+
+    # -- shared internals ---------------------------------------------- #
+
+    def _finish_pending_locked(self) -> None:
+        if self._future is not None:
+            try:
+                self._future.result()
+            except BaseException:
+                # The block write never finished: its staged tmp file
+                # must NOT be committed — a later flush()/close() that
+                # promoted it would digest the PARTIAL data into a
+                # "verified" sidecar. Abandon the pending commit; the
+                # previous checkpoint stays the durable restart point.
+                self._pending = None
+                raise
+            finally:
+                self._future = None
+        if self._pending is not None:
+            import jax
+            p, self._pending = self._pending, None
+            # MAIN-THREAD collective epilogue: every rank's blocks are
+            # on disk before rank 0 promotes and indexes the pair.
+            self._barrier(f"async-ckpt:done:{p.tmp}")
+            if jax.process_index() == 0:
+                commit_checkpoint_files(p.tmp, p.path, p.step, p.config,
+                                        p.out_shape)
+                if self.manager is not None:
+                    self.manager.index(p.step)
+                elif self.registry is not None:
+                    self.registry.counter("resil_ckpt_saves_total")
+            self._barrier(f"async-ckpt:committed:{p.tmp}")
+
+    def _path_for(self, step: int) -> str:
+        if self.manager is not None:
+            return self.manager.path_for(step)
+        return self.path
+
+    @staticmethod
+    def _barrier(name: str) -> None:
+        import jax
+        if jax.process_count() > 1:
+            from jax.experimental import multihost_utils
+            multihost_utils.sync_global_devices(name)
+
+    def _gauge_pending(self) -> None:
+        if self.registry is not None:
+            pending = int(self._future is not None
+                          or self._pending is not None)
+            self.registry.gauge("resil_ckpt_pending", pending)

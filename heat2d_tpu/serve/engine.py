@@ -23,8 +23,11 @@ Metrics: ``serve_launches_total`` counter, ``serve_launch_s`` histogram,
 
 from __future__ import annotations
 
+import contextlib
 import logging
 from typing import List, Tuple
+
+from heat2d_tpu.resil import chaos
 
 log = logging.getLogger("heat2d_tpu.serve")
 
@@ -51,7 +54,12 @@ class EnsembleEngine:
 
     def solve_batch(self, requests) -> List[Tuple["object", int]]:
         """Solve same-signature ``requests`` in ONE ensemble launch.
-        Returns one (u, steps_done) pair per request, in order."""
+        Returns one (u, steps_done) pair per request, in order.
+
+        May raise transients (including injected ``ChaosError`` — the
+        fault-injection point for the whole launch path); the server's
+        retry policy owns absorbing them, this module stays one-shot."""
+        chaos.launch_point()
         import numpy as np
 
         from heat2d_tpu.models import ensemble
@@ -79,7 +87,7 @@ class EnsembleEngine:
             sensitivity=sensitivity)
 
         timer = (self.registry.timer("serve_launch_s")
-                 if self.registry is not None else _null_ctx())
+                 if self.registry is not None else contextlib.nullcontext())
         with timer:
             out = runner(u0, cxs, cys)
             if req0.convergence:
@@ -102,11 +110,3 @@ class EnsembleEngine:
                   self.launches, req0.nx, req0.ny, req0.steps, n,
                   capacity)
         return [(u[i], steps_done[i]) for i in range(n)]
-
-
-class _null_ctx:
-    def __enter__(self):
-        return self
-
-    def __exit__(self, *exc):
-        return False

@@ -20,9 +20,20 @@ never raises (rejections arrive AS the future's exception, uniformly,
 so async callers have one error path). ``Client`` is the synchronous
 wrapper tests and the CLI use.
 
+Resilience (resil/ subsystem): a dispatched launch runs under the
+retry policy (transient failures — injected ``ChaosError``, runtime/IO
+errors — back off and retry instead of surfacing as terminal errors)
+and a deadline ``Watchdog`` (a wedged launch fails its waiters with
+``Rejected("watchdog_timeout")`` instead of hanging them). Repeated
+dispatch failures trip ``DegradedMode``: fresh uncached work is shed at
+admission with ``Rejected("degraded")`` while cache hits keep being
+served — partial availability under a sick backend.
+
 Metrics: ``serve_requests_total{outcome}`` counter and the
-``serve_e2e_latency_s`` histogram here, plus everything the cache /
-batcher / engine layers record (docs/SERVING.md has the full table).
+``serve_e2e_latency_s`` histogram here, plus ``serve_retries_total``,
+``serve_watchdog_timeouts_total``, ``serve_degraded{,_shed_total}``,
+``serve_breaker_trips_total`` and everything the cache / batcher /
+engine layers record (docs/SERVING.md + docs/RESILIENCE.md tables).
 """
 
 from __future__ import annotations
@@ -32,6 +43,8 @@ import time
 from concurrent.futures import Future
 from typing import Optional
 
+from heat2d_tpu.resil.retry import (DegradedMode, RetryPolicy, Watchdog,
+                                    call_with_retries)
 from heat2d_tpu.serve.batcher import MicroBatcher
 from heat2d_tpu.serve.cache import ResultCache, SingleFlight
 from heat2d_tpu.serve.engine import EnsembleEngine
@@ -44,12 +57,21 @@ class SolveServer:
     def __init__(self, *, max_batch: int = 8, max_delay: float = 0.005,
                  max_queue: int = 256, cache_size: int = 256,
                  default_timeout: Optional[float] = 30.0,
-                 registry=None):
+                 registry=None, retry_policy: Optional[RetryPolicy] = None,
+                 launch_deadline: Optional[float] = None,
+                 breaker: Optional[DegradedMode] = None):
         if registry is None:
             from heat2d_tpu.obs import get_registry
             registry = get_registry()
         self.registry = registry
         self.default_timeout = default_timeout
+        self.retry_policy = (RetryPolicy() if retry_policy is None
+                             else retry_policy)
+        #: launch wall-clock deadline; None = no watchdog (hangs bound
+        #: only by the caller's own future timeout)
+        self.launch_deadline = launch_deadline
+        self.breaker = (DegradedMode(registry=registry) if breaker is None
+                        else breaker)
         self.cache = ResultCache(cache_size, registry=registry)
         self.flight = SingleFlight(registry=registry)
         self.engine = EnsembleEngine(registry=registry,
@@ -94,6 +116,8 @@ class SolveServer:
 
         hit = self.cache.get(key)
         if hit is not None:
+            # Cache hits are served even in degraded mode: the breaker
+            # sheds COMPUTE, not answers we already hold.
             self._count("cache_hit")
             self._latency(t0)
             fut = Future()
@@ -103,6 +127,20 @@ class SolveServer:
             return fut
 
         fut, leader = self.flight.claim(key)
+        if leader and not self.breaker.allow():
+            # Shed only work that would COST a launch: cache hits
+            # (above) and coalesced followers of an already-in-flight
+            # leader ride through — the breaker sheds compute, not
+            # answers the server already owes.
+            self._count("rejected_degraded")
+            if self.registry is not None:
+                self.registry.counter("serve_degraded_shed_total")
+            self.flight.fail(key, Rejected(
+                "degraded", "server is in degraded mode after repeated "
+                "launch failures: uncached load is shed while the "
+                "backend recovers", content_hash=key,
+                breaker_state=self.breaker.state))
+            return fut
         if not leader:
             self._count("coalesced")
             # A derived future: the leader's result re-labeled
@@ -148,23 +186,63 @@ class SolveServer:
     # -- dispatch (scheduler thread) ----------------------------------- #
 
     def _dispatch(self, sig, batch) -> None:
-        """Bucket -> one launch -> per-request results. Any engine error
-        fails every member's flight entry (the batcher already guards
-        the thread)."""
+        """Bucket -> one launch (retried, watchdogged) -> per-request
+        results. Transient launch failures retry with capped backoff;
+        a launch that outlives ``launch_deadline`` has its waiters
+        failed with ``Rejected("watchdog_timeout")`` by the watchdog
+        thread (the launch itself keeps running — if it eventually
+        returns, its results still warm the cache). Terminal failures
+        fail every member's flight entry and feed the breaker."""
+        reqs = [p.req for p in batch]
+
+        def on_timeout() -> None:
+            if self.registry is not None:
+                self.registry.counter("serve_watchdog_timeouts_total")
+            exc = Rejected(
+                "watchdog_timeout",
+                f"launch exceeded the {self.launch_deadline}s deadline",
+                signature=str(sig))
+            for p in batch:
+                self.flight.fail(p.key, exc)
+                self._count("rejected_watchdog_timeout")
+            self.breaker.record_failure()
+
+        def on_retry(i: int, exc: BaseException) -> None:
+            if self.registry is not None:
+                self.registry.counter("serve_retries_total")
+                self.registry.counter("serve_launch_failures_total")
+
+        watchdog = Watchdog(self.launch_deadline, on_timeout)
         try:
-            results = self.engine.solve_batch([p.req for p in batch])
+            with watchdog:
+                results = call_with_retries(
+                    lambda: self.engine.solve_batch(reqs),
+                    self.retry_policy, on_retry=on_retry)
         except BaseException as e:  # noqa: BLE001 — routed, not dropped
+            if self.registry is not None:
+                self.registry.counter("serve_launch_failures_total")
+            if not watchdog.fired:
+                # a fired watchdog already charged this launch to the
+                # breaker in on_timeout — one launch, one verdict
+                self.breaker.record_failure()
             for p in batch:
                 self.flight.fail(p.key, e)
                 self._count("error")
             return
+        if not watchdog.fired:
+            # a launch that outlived its deadline is a failure even if
+            # it eventually returned: its waiters were already rejected,
+            # and a success here would reset the breaker a consistently
+            # too-slow backend deserves to trip
+            self.breaker.record_success()
         for p, (u, steps_done) in zip(batch, results):
             res = SolveResult(u=u, steps_done=steps_done,
                               content_hash=p.key,
                               batch_size=len(batch))
             self.cache.put(p.key, res)
             self.flight.resolve(p.key, res)
-            self._count("completed")
+            self._count("completed_late" if watchdog.fired
+                        else "completed")
 
     # -- metrics ------------------------------------------------------- #
 

@@ -48,6 +48,48 @@ def test_resume_equals_straight_run(tmp_path):
     np.testing.assert_array_equal(second.u, full.u)
 
 
+def test_resume_convergence_route(tmp_path):
+    """Resume parity on the CONVERGENCE route: run k fixed steps ->
+    checkpoint -> resume with convergence on must stop at the same
+    global step as the unsegmented convergence run, bitwise. k is a
+    multiple of INTERVAL so the resumed run's residual-check schedule
+    (local steps INTERVAL, 2*INTERVAL, ...) lands on the same global
+    steps as the full run's."""
+    import jax.numpy as jnp
+
+    from heat2d_tpu.ops import stencil_step
+
+    nx = ny = 16
+    interval, k = 4, 8
+    # Σ(Δu)² at each INTERVAL check of a straight run, with the golden
+    # step — so the test can PICK a sensitivity that fires at step 12.
+    u, res = inidat(nx, ny), {}
+    for s in range(1, 17):
+        new = stencil_step(u, 0.1, 0.1)
+        if s % interval == 0:
+            res[s] = float(jnp.sum((new - u) ** 2))
+        u = new
+    assert res[8] > res[12], res
+    sens = (res[8] * res[12]) ** 0.5     # first check below: step 12
+
+    cfg = HeatConfig(nxprob=nx, nyprob=ny, steps=200, convergence=True,
+                     interval=interval, sensitivity=sens)
+    full = Heat2DSolver(cfg).run(timed=False)
+    assert full.steps_done == 12
+
+    first = Heat2DSolver(
+        cfg.replace(steps=k, convergence=False)).run(timed=False)
+    p = tmp_path / "ckpt.bin"
+    save_checkpoint(first.u, k, cfg, p)
+
+    grid, step, _ = load_checkpoint(p)
+    solver = Heat2DSolver(cfg.replace(steps=cfg.steps - step))
+    second = solver.run(u0=solver.place(grid), timed=False)
+
+    assert step + second.steps_done == full.steps_done
+    np.testing.assert_array_equal(second.u, full.u)
+
+
 def test_resume_sharded(tmp_path):
     """Resume a serial checkpoint into a 2x2 sharded run."""
     cfg = HeatConfig(nxprob=16, nyprob=16, steps=80)

@@ -1,0 +1,543 @@
+"""Resilience subsystem tests — crash-consistent checkpoints, fault
+injection, retry/degraded-mode serving (docs/RESILIENCE.md).
+
+The two headline scenarios (ISSUE acceptance criteria):
+
+- a run KILLED mid-checkpoint-write restores from the last durable
+  snapshot and finishes bitwise-identical to an uninterrupted run
+  (``test_chaos_kill_mid_checkpoint_then_resume_bitwise``);
+- a serve request whose first launch is injected to fail still succeeds
+  via retry, with the retry counters visible in the metrics export
+  (``test_serve_retries_injected_launch_failure``).
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from heat2d_tpu.config import HeatConfig
+from heat2d_tpu.io import (CheckpointCorruptError, load_checkpoint,
+                           save_checkpoint)
+from heat2d_tpu.io.binary import checkpoint_tmp_path
+from heat2d_tpu.obs import MetricsRegistry
+from heat2d_tpu.ops import inidat
+from heat2d_tpu.resil import (AsyncCheckpointer, ChaosConfig,
+                              CheckpointManager, DegradedMode,
+                              RetryPolicy, Watchdog, call_with_retries,
+                              is_manager_dir)
+from heat2d_tpu.resil import chaos
+from heat2d_tpu.resil.chaos import ChaosError
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _chaos_disarmed():
+    """Every test starts and ends with no chaos campaign installed."""
+    chaos.uninstall()
+    yield
+    chaos.uninstall()
+
+
+def _cfg(**kw):
+    base = dict(nxprob=16, nyprob=16, steps=12)
+    base.update(kw)
+    return HeatConfig(**base)
+
+
+# --------------------------------------------------------------------- #
+# atomic commit + digest (io/binary.py surgery)
+# --------------------------------------------------------------------- #
+
+def test_sidecar_carries_digest_and_no_tmp_left(tmp_path):
+    u = np.asarray(inidat(12, 8))
+    p = tmp_path / "ck.bin"
+    save_checkpoint(u, 7, _cfg(), p)
+    meta = json.loads((tmp_path / "ck.bin.meta.json").read_text())
+    assert len(meta["sha256"]) == 64
+    assert not os.path.exists(checkpoint_tmp_path(p))
+    grid, step, _ = load_checkpoint(p)
+    assert step == 7
+    np.testing.assert_array_equal(grid, u)
+
+
+def test_corrupt_binary_detected(tmp_path):
+    u = np.asarray(inidat(12, 8))
+    p = tmp_path / "ck.bin"
+    save_checkpoint(u, 7, _cfg(), p)
+    with open(p, "r+b") as f:
+        f.write(b"\xde\xad\xbe\xef")
+    with pytest.raises(CheckpointCorruptError, match="sha256 mismatch"):
+        load_checkpoint(p)
+    # verify=False loads the bytes as-is (forensics escape hatch)
+    grid, step, _ = load_checkpoint(p, verify=False)
+    assert step == 7
+
+
+def test_torn_pair_detected(tmp_path):
+    """Crash between the binary replace and the sidecar replace: the
+    new binary sits beside the OLD sidecar — the digest must refuse."""
+    u = np.asarray(inidat(12, 8))
+    p = tmp_path / "ck.bin"
+    save_checkpoint(u, 7, _cfg(), p)
+    # simulate: a newer state replaced the binary, sidecar never landed
+    (u + 1.0).astype(np.float32).tofile(p)
+    with pytest.raises(CheckpointCorruptError):
+        load_checkpoint(p)
+
+
+def test_truncated_binary_detected(tmp_path):
+    u = np.asarray(inidat(12, 8))
+    p = tmp_path / "ck.bin"
+    save_checkpoint(u, 7, _cfg(), p)
+    with open(p, "r+b") as f:
+        f.truncate(100)
+    with pytest.raises(CheckpointCorruptError):
+        load_checkpoint(p)
+
+
+def test_sidecar_missing_fields_is_corrupt_not_crash(tmp_path):
+    """A sidecar that parses as JSON but lacks required fields must be
+    CheckpointCorruptError (so latest_valid falls back past it), not a
+    bare KeyError that escapes the manifest walk."""
+    u = np.asarray(inidat(12, 8))
+    p = tmp_path / "ck.bin"
+    u.tofile(p)
+    (tmp_path / "ck.bin.meta.json").write_text(
+        json.dumps({"shape": [12, 8]}))        # no "step"
+    with pytest.raises(CheckpointCorruptError):
+        load_checkpoint(p)
+
+
+def test_pre_digest_checkpoints_still_load(tmp_path):
+    """Sidecars written before the digest field (or by hand) load
+    unverified — format v1 stays backward compatible."""
+    u = np.asarray(inidat(12, 8))
+    p = tmp_path / "ck.bin"
+    u.tofile(p)
+    (tmp_path / "ck.bin.meta.json").write_text(json.dumps(
+        {"step": 3, "shape": [12, 8], "dtype": "float32", "config": {},
+         "format": "heat2d-tpu-checkpoint-v1"}))
+    grid, step, _ = load_checkpoint(p)
+    assert step == 3
+    np.testing.assert_array_equal(grid, u)
+
+
+# --------------------------------------------------------------------- #
+# CheckpointManager: manifest, retention, latest_valid fallback
+# --------------------------------------------------------------------- #
+
+def test_manager_retention_gc(tmp_path):
+    reg = MetricsRegistry()
+    m = CheckpointManager(tmp_path / "ck", keep=2, registry=reg)
+    u = np.asarray(inidat(8, 8))
+    for step in (4, 8, 12):
+        m.save(u + step, step, _cfg())
+    assert m.steps() == [8, 12]
+    assert not os.path.exists(m.path_for(4))
+    assert not os.path.exists(m.path_for(4) + ".meta.json")
+    snap = reg.snapshot()
+    assert snap["counters"]["resil_ckpt_saves_total"] == 3
+    assert snap["counters"]["resil_ckpt_gc_total"] == 1
+    assert snap["gauges"]["resil_ckpt_latest_step"] == 12
+
+
+def test_manager_latest_valid_skips_torn(tmp_path):
+    reg = MetricsRegistry()
+    m = CheckpointManager(tmp_path / "ck", keep=None, registry=reg)
+    u = np.asarray(inidat(8, 8))
+    for step in (4, 8, 12):
+        m.save(u + step, step, _cfg())
+    # newest torn (binary corrupted), next-newest missing entirely
+    with open(m.path_for(12), "r+b") as f:
+        f.write(b"\x00" * 16)
+    os.remove(m.path_for(8))
+    grid, step, cfg_dict = m.latest_valid()
+    assert step == 4
+    np.testing.assert_array_equal(grid, u + 4)
+    assert cfg_dict["nxprob"] == 16
+    assert reg.snapshot()["counters"][
+        "resil_ckpt_skipped_torn_total"] == 2
+
+
+def test_manager_latest_valid_empty(tmp_path):
+    m = CheckpointManager(tmp_path / "ck", keep=3)
+    assert m.latest_valid() is None
+    assert m.latest_step() is None
+
+
+def test_manager_survives_lost_manifest(tmp_path):
+    """The manifest is an index, not the source of truth: deleting it
+    degrades to a directory scan over the verified sidecars."""
+    m = CheckpointManager(tmp_path / "ck", keep=None)
+    u = np.asarray(inidat(8, 8))
+    for step in (4, 8):
+        m.save(u + step, step, _cfg())
+    os.remove(m.manifest_path)
+    assert m.steps() == [4, 8]
+    grid, step, _ = m.latest_valid()
+    assert step == 8
+
+
+def test_is_manager_dir(tmp_path):
+    assert is_manager_dir(tmp_path)
+    assert not is_manager_dir(tmp_path / "ck.bin")
+
+
+# --------------------------------------------------------------------- #
+# AsyncCheckpointer: overlap + double buffering
+# --------------------------------------------------------------------- #
+
+def test_async_writer_overlaps_write_with_caller(tmp_path):
+    """With an injected 0.3s write latency, save_async must return well
+    before the write completes (the I/O rides the background thread);
+    flush() then makes it durable."""
+    chaos.install(ChaosConfig(ckpt_latency_s=0.3))
+    m = CheckpointManager(tmp_path / "ck", keep=None)
+    u = np.asarray(inidat(16, 16))
+    w = AsyncCheckpointer(m, _cfg(), shape=(16, 16))
+    t0 = time.monotonic()
+    w.save_async(u, 4)
+    returned_in = time.monotonic() - t0
+    assert returned_in < 0.25, (
+        f"save_async blocked {returned_in:.3f}s — checkpoint I/O is "
+        f"back on the hot path")
+    assert m.latest_valid() is None      # not yet committed
+    w.flush()
+    grid, step, _ = m.latest_valid()
+    assert step == 4
+    np.testing.assert_array_equal(grid, u)
+    w.close()
+
+
+def test_async_writer_double_buffer_backpressure(tmp_path):
+    """At most ONE write in flight: the second save_async waits out the
+    first (slow) write instead of queueing snapshots unbounded."""
+    chaos.install(ChaosConfig(ckpt_latency_s=0.2))
+    m = CheckpointManager(tmp_path / "ck", keep=None)
+    u = np.asarray(inidat(16, 16))
+    with AsyncCheckpointer(m, _cfg(), shape=(16, 16)) as w:
+        t0 = time.monotonic()
+        w.save_async(u, 4)
+        w.save_async(u * 2, 8)
+        assert time.monotonic() - t0 >= 0.2   # waited for ckpt 4
+    assert m.steps() == [4, 8]
+    grid, step, _ = m.latest_valid()
+    assert step == 8
+    np.testing.assert_array_equal(grid, u * 2)
+
+
+def test_async_writer_plain_path_target(tmp_path):
+    p = tmp_path / "ck.bin"
+    u = np.asarray(inidat(16, 16))
+    with AsyncCheckpointer(str(p), _cfg(), shape=(16, 16)) as w:
+        w.save_async(u, 4)
+        w.save_async(u * 3, 8)
+    grid, step, _ = load_checkpoint(p)
+    assert step == 8
+    np.testing.assert_array_equal(grid, u * 3)
+
+
+def test_async_writer_failed_write_never_commits(tmp_path):
+    """A failed background block write must ABANDON its pending commit:
+    a later flush/close must not promote the partial staging file into
+    a 'verified' checkpoint (it would digest the torn data into a
+    matching sidecar)."""
+    from concurrent.futures import Future
+
+    from heat2d_tpu.resil.writer import _PendingCommit
+
+    m = CheckpointManager(tmp_path / "ck", keep=None)
+    w = AsyncCheckpointer(m, _cfg(), shape=(16, 16))
+    path = m.path_for(4)
+    tmp = checkpoint_tmp_path(path)
+    with open(tmp, "wb") as f:
+        f.write(b"\x00" * 64)               # partial staging data
+    fut = Future()
+    fut.set_exception(OSError("disk full"))
+    w._future = fut
+    w._pending = _PendingCommit(step=4, tmp=tmp, path=path,
+                                config=_cfg(), out_shape=(16, 16))
+    with pytest.raises(OSError):
+        w.flush()
+    w.close()                               # must not commit either
+    assert m.latest_valid() is None
+    assert not os.path.exists(path)
+
+
+# --------------------------------------------------------------------- #
+# retry / watchdog / degraded mode
+# --------------------------------------------------------------------- #
+
+def test_retry_policy_delays_capped():
+    p = RetryPolicy(base_delay=0.1, backoff=2.0, max_delay=0.35)
+    assert [p.delay(i) for i in range(4)] == [0.1, 0.2, 0.35, 0.35]
+
+
+def test_call_with_retries_absorbs_transients():
+    calls = []
+
+    def flaky():
+        calls.append(1)
+        if len(calls) < 3:
+            raise ChaosError("injected")
+        return "ok"
+
+    slept = []
+    assert call_with_retries(
+        flaky, RetryPolicy(max_attempts=3, base_delay=0.01),
+        sleep=slept.append) == "ok"
+    assert len(calls) == 3 and slept == [0.01, 0.02]
+
+
+def test_call_with_retries_terminal_not_retried():
+    calls = []
+
+    def broken():
+        calls.append(1)
+        raise ValueError("a bug, not a fault")
+
+    with pytest.raises(ValueError):
+        call_with_retries(broken, RetryPolicy(max_attempts=5,
+                                              base_delay=0.01),
+                          sleep=lambda _s: None)
+    assert len(calls) == 1
+
+
+def test_call_with_retries_exhaustion_raises_last():
+    def always():
+        raise ChaosError("still down")
+
+    with pytest.raises(ChaosError):
+        call_with_retries(always, RetryPolicy(max_attempts=2,
+                                              base_delay=0.0),
+                          sleep=lambda _s: None)
+
+
+def test_watchdog_fires_once_and_cancels():
+    fired = []
+    with Watchdog(0.05, lambda: fired.append(1)) as w:
+        time.sleep(0.15)
+    assert w.fired and fired == [1]
+    with Watchdog(5.0, lambda: fired.append(2)) as w:
+        pass
+    time.sleep(0.05)
+    assert not w.fired and fired == [1]
+
+
+def test_degraded_mode_state_machine():
+    t = [0.0]
+    b = DegradedMode(threshold=2, cooldown=10.0, clock=lambda: t[0])
+    assert b.state == "closed" and b.allow()
+    b.record_failure()
+    assert b.state == "closed"           # below threshold
+    b.record_failure()
+    assert b.state == "open" and not b.allow()
+    t[0] = 11.0
+    assert b.allow()                     # the half-open probe
+    assert b.state == "half_open" and not b.allow()   # others shed
+    b.record_failure()                   # probe failed -> re-open
+    assert b.state == "open"
+    t[0] = 22.0
+    assert b.allow()
+    b.record_success()                   # probe succeeded -> closed
+    assert b.state == "closed" and b.allow()
+    assert b.trips == 1                  # re-open of an open breaker
+    #                                      is not a second trip
+
+
+def test_degraded_probe_token_expires():
+    """A probe that hangs (its verdict never arrives) must not shed
+    traffic forever: the token expires after one cooldown and another
+    caller may probe."""
+    t = [0.0]
+    b = DegradedMode(threshold=1, cooldown=10.0, clock=lambda: t[0])
+    b.record_failure()
+    t[0] = 10.0
+    assert b.allow()                     # probe 1 granted ... and hangs
+    assert not b.allow()                 # token held
+    t[0] = 21.0
+    assert b.allow()                     # token expired -> probe 2
+    b.record_success()
+    assert b.state == "closed"
+
+
+# --------------------------------------------------------------------- #
+# serve integration: retry, watchdog, degraded shedding
+# --------------------------------------------------------------------- #
+
+def _req(**kw):
+    from heat2d_tpu.serve.schema import SolveRequest
+    base = dict(nx=12, ny=12, steps=4, method="jnp")
+    base.update(kw)
+    return SolveRequest(**base)
+
+
+def test_serve_retries_injected_launch_failure(tmp_path):
+    """ISSUE acceptance: first launch injected to fail -> the request
+    still succeeds via retry, and the retry/restore counters land in
+    the metrics JSONL export."""
+    from heat2d_tpu.serve.server import Client, SolveServer
+
+    reg = MetricsRegistry()
+    chaos.install(ChaosConfig(fail_launches=1), registry=reg)
+    with SolveServer(registry=reg,
+                     retry_policy=RetryPolicy(base_delay=0.01)) as s:
+        res = Client(s).solve(_req(), timeout=60)
+    assert res.steps_done == 4
+    out = tmp_path / "metrics.jsonl"
+    reg.write_jsonl(str(out))
+    snap = [json.loads(line) for line in out.read_text().splitlines()
+            if json.loads(line).get("event") == "snapshot"][0]
+    assert snap["counters"]["serve_retries_total"] >= 1
+    assert snap["counters"]["serve_launch_failures_total"] >= 1
+    assert snap["counters"][
+        "resil_chaos_injected_total{point=launch_failure}"] == 1
+    assert snap["counters"]["serve_requests_total{outcome=completed}"] \
+        == 1
+
+
+def test_serve_degraded_sheds_but_cache_answers(tmp_path):
+    """Breaker open: fresh compute is shed with Rejected('degraded'),
+    warm signatures keep answering from the cache."""
+    from heat2d_tpu.serve.schema import Rejected
+    from heat2d_tpu.serve.server import Client, SolveServer
+
+    reg = MetricsRegistry()
+    warm = _req()
+    with SolveServer(registry=reg,
+                     retry_policy=RetryPolicy(max_attempts=1),
+                     breaker=DegradedMode(threshold=1, cooldown=60.0,
+                                          registry=reg)) as s:
+        c = Client(s)
+        cold = c.solve(warm, timeout=60)          # fills the cache
+        chaos.install(ChaosConfig(fail_launches=1000), registry=reg)
+        with pytest.raises(ChaosError):
+            c.solve(_req(steps=5), timeout=30)    # trips the breaker
+        with pytest.raises(Rejected) as ei:
+            c.solve(_req(steps=6), timeout=30)    # shed at the door
+        assert ei.value.code == "degraded"
+        hit = c.solve(warm, timeout=30)           # cache still serves
+        assert hit.cache_hit
+        np.testing.assert_array_equal(np.asarray(hit.u),
+                                      np.asarray(cold.u))
+    snap = reg.snapshot()
+    assert snap["counters"]["serve_breaker_trips_total"] == 1
+    assert snap["counters"]["serve_degraded_shed_total"] >= 1
+    assert snap["gauges"]["serve_degraded"] == 1.0
+
+
+def test_serve_watchdog_converts_hang_to_rejection():
+    """A launch that outlives the deadline fails its waiters with a
+    structured Rejected('watchdog_timeout') instead of hanging them."""
+    from heat2d_tpu.serve.schema import Rejected
+    from heat2d_tpu.serve.server import Client, SolveServer
+
+    reg = MetricsRegistry()
+    with SolveServer(registry=reg,
+                     retry_policy=RetryPolicy(max_attempts=1),
+                     launch_deadline=0.15) as s:
+        c = Client(s)
+        c.solve(_req(), timeout=60)           # warm compile un-hobbled
+        chaos.install(ChaosConfig(launch_latency_s=1.0), registry=reg)
+        t0 = time.monotonic()
+        with pytest.raises(Rejected) as ei:
+            c.solve(_req(steps=5), timeout=30)
+        assert ei.value.code == "watchdog_timeout"
+        assert time.monotonic() - t0 < 5.0
+    assert reg.snapshot()["counters"][
+        "serve_watchdog_timeouts_total"] >= 1
+
+
+# --------------------------------------------------------------------- #
+# hot path unchanged when resilience is off
+# --------------------------------------------------------------------- #
+
+def test_jaxpr_identical_with_chaos_armed():
+    """The resilience layer is host-side orchestration only: arming a
+    chaos campaign (or none) must not change the traced program of the
+    engine loops — pinned here the same way test_telemetry pins the
+    tap-off path."""
+    import jax
+    from heat2d_tpu.models.solver import Heat2DSolver
+
+    cfg = _cfg(convergence=True, interval=4)
+    u0 = inidat(16, 16)
+    before = str(jax.make_jaxpr(Heat2DSolver(cfg).make_runner())(u0))
+    chaos.install(ChaosConfig(fail_launches=3, ckpt_latency_s=0.5,
+                              kill_ckpt_at=99))
+    armed = str(jax.make_jaxpr(Heat2DSolver(cfg).make_runner())(u0))
+    assert before == armed
+    assert "debug_callback" not in before
+
+
+# --------------------------------------------------------------------- #
+# the headline crash/restore scenario, end to end through the CLI
+# --------------------------------------------------------------------- #
+
+def _cli(outdir, extra, env_extra=None, expect_rc=0):
+    env = {k: v for k, v in os.environ.items()
+           if not k.startswith("HEAT2D_CHAOS_")}
+    env["JAX_PLATFORMS"] = "cpu"
+    env.update(env_extra or {})
+    r = subprocess.run(
+        [sys.executable, "-m", "heat2d_tpu.cli", "--mode", "serial",
+         "--nxprob", "16", "--nyprob", "16", "--steps", "12",
+         "--platform", "cpu", "--dat-layout", "none",
+         "--outdir", str(outdir)] + extra,
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=220)
+    assert r.returncode == expect_rc, (r.returncode, r.stdout, r.stderr)
+    return r
+
+
+def test_chaos_kill_mid_checkpoint_then_resume_bitwise(tmp_path):
+    """ISSUE acceptance: a run killed mid-checkpoint-write (hard
+    os._exit, no cleanup) restores from the last durable snapshot and
+    produces a final grid bitwise-identical to an uninterrupted run."""
+    ref = tmp_path / "ref"
+    out = tmp_path / "out"
+    ck = tmp_path / "ck"
+    ref.mkdir(), out.mkdir(), ck.mkdir()
+
+    _cli(ref, ["--binary-dumps"])
+    # Killed at the 2nd checkpoint's mid-write window: step 8's temp
+    # file exists, the manifest's only durable entry is step 4.
+    _cli(tmp_path, ["--checkpoint", str(ck), "--checkpoint-every", "4"],
+         env_extra={"HEAT2D_CHAOS_KILL_CKPT_AT": "2"}, expect_rc=137)
+    m = CheckpointManager(ck, keep=None)
+    assert m.steps() == [4]
+    assert os.path.exists(checkpoint_tmp_path(m.path_for(8)))
+
+    r = _cli(out, ["--resume", str(ck), "--binary-dumps",
+                   "--run-record", str(out / "rec.json")])
+    assert "Resuming from step 4" in r.stdout
+    assert ((out / "final_binary.dat").read_bytes()
+            == (ref / "final_binary.dat").read_bytes())
+    rec = json.loads((out / "rec.json").read_text())
+    assert rec["resume_from_step"] == 4
+    assert rec["total_steps_including_resume"] == 12
+
+
+def test_resume_directory_falls_back_past_torn(tmp_path):
+    """--resume DIR with the newest snapshot torn: the previous one is
+    used and the run still reaches the full-run state bitwise."""
+    ref = tmp_path / "ref"
+    out = tmp_path / "out"
+    ck = tmp_path / "ck"
+    ref.mkdir(), out.mkdir(), ck.mkdir()
+    _cli(ref, ["--binary-dumps"])
+    _cli(tmp_path / "seed", ["--checkpoint", str(ck),
+                             "--checkpoint-every", "4"])
+    m = CheckpointManager(ck, keep=None)
+    assert m.steps() == [4, 8, 12]
+    with open(m.path_for(12), "r+b") as f:   # tear the newest
+        f.write(b"\xff" * 32)
+    r = _cli(out, ["--resume", str(ck), "--binary-dumps"])
+    assert "Resuming from step 8" in r.stdout
+    assert ((out / "final_binary.dat").read_bytes()
+            == (ref / "final_binary.dat").read_bytes())
