@@ -71,59 +71,13 @@ REF_CONV_BEST_S = {(80, 64): 2.06e-1, (160, 128): 2.49e-1,
 #: over up to 100k iterations (Report.pdf p.26).
 MAX_HI_STEPS = 100_000
 
-#: Absolute dt floor: fence variance through the tunnel reaches tens of
-#: ms, so a smaller window can be pure noise even when it clears 5x the
-#: *measured* jitter (a lucky pair of lo runs under-estimates jitter).
-NOISE_FLOOR_S = 0.05
-
-#: Two marginal estimates a decade apart must agree within this factor
-#: for either to be believed (see two_point_estimate).
-AGREE_FACTOR = 1.5
-
-
-def two_point_estimate(timed_run, lo, hi0, max_hi,
-                       floor=NOISE_FLOOR_S, agree=AGREE_FACTOR):
-    """Adaptive two-point marginal step time: (step_time|None, hi, result).
-
-    ``timed_run(n)`` runs n steps and returns an object with ``.elapsed``.
-    The marginal is (t_hi - t_lo)/(hi - lo) with the fixed fence overhead
-    cancelled, hi growing x10 until the window clears the jitter floor.
-
-    Round 2's committed chip sweep carried a physically impossible row
-    (pallas 320x256 at 241.9 Mcells/s — 122x slower than serial on the
-    same grid): a single lucky jitter spike in t_hi can clear any static
-    threshold and produce a confidently wrong marginal. Hence the
-    CONFIRMATION rule: a candidate is only accepted once the estimate
-    from the next decade agrees within ``agree``x — a jitter spike can
-    clear the floor once, but it cannot produce the same wrong marginal
-    at 10x the step count, because the spike's contribution to the
-    marginal shrinks 10x while the true signal stays put. At ``max_hi``
-    (no further decade available) an unconfirmed candidate is accepted
-    only if its window also clears 2x the absolute floor — at the
-    reference's own 100k-iteration amortization span (Report.pdf p.26)
-    noise cannot fake a 100 ms window.
-    """
-    lo_ts = sorted(timed_run(lo).elapsed for _ in range(3))
-    t_lo = lo_ts[0]
-    # Spread of the two best of three: one outlier sample can no longer
-    # fake a tiny jitter estimate (or poison t_lo).
-    jitter = lo_ts[1] - lo_ts[0]
-    prev = None
-    hi = hi0
-    while True:
-        ra, rb = timed_run(hi), timed_run(hi)
-        result = ra if ra.elapsed <= rb.elapsed else rb
-        dt = result.elapsed - t_lo
-        cand = dt / (hi - lo) if dt > max(5 * jitter, floor) else None
-        if cand is not None and prev is not None:
-            if max(cand, prev) <= agree * min(cand, prev):
-                return cand, hi, result      # confirmed across a decade
-        if hi >= max_hi:
-            if cand is not None and dt > max(5 * jitter, 2 * floor):
-                return cand, hi, result      # fully amortized window
-            return None, hi, result
-        prev = cand
-        hi = min(hi * 10, max_hi)
+# The adaptive cross-decade-confirmed estimator and its noise constants
+# live in the tune subsystem now (heat2d_tpu/tune/measure.py) — ONE copy
+# of the two-point protocol, shared with heat2d-tpu-tune and the
+# tune_bands/tune_panels probes. Re-exported here so sweep consumers
+# (tests, notebooks) keep their import path.
+from heat2d_tpu.tune.measure import (AGREE_FACTOR,  # noqa: E402,F401
+                                     NOISE_FLOOR_S, two_point_estimate)
 
 
 def run_point(mode, nx, ny, steps, gridx=1, gridy=1, convergence=False,

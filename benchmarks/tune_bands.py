@@ -1,18 +1,20 @@
 """Band-plan tuning harness: two-point-time band_chunk over (bm, T).
 
-The chip sweep showed the 4096^2 north-star config ~20% below the
-framework's own 2560x2048 best (VERDICT r2 weak #4): plan_bands lands
-bm=128 at 16 KB rows where 8 KB rows get bm=256. This harness measures
-the real frontier on the attached chip so the plan policy is an
-observed number, not a guess. Usage:
+Thin wrapper over the ``heat2d_tpu.tune`` library — the measurement
+protocol (two-point marginal, min-of-reps, probe-mode VMEM lift) lives
+in ``tune/measure.py`` now, shared with ``heat2d-tpu-tune``, the panel
+probe, and ``benchmarks/sweep.py``. This harness keeps the raw
+envelope-probe ergonomics: a fixed (bm, T) grid printed one line per
+config, failures printed as their error class (the point is to probe
+PAST the fast-fail estimate), plus ``--db PATH`` to record every point
+into a persistent tuning database instead of a throwaway table. Usage:
 
-    python benchmarks/tune_bands.py [nx ny]
+    python benchmarks/tune_bands.py [nx ny] [--legacy] [--db PATH]
 
-Prints one line per (bm, T) config: marginal step time and Mcells/s via
-the same two-point protocol as benchmarks/sweep.py (fixed overhead
-cancels between a lo- and hi-step run). Configs that fail to compile
-print the error class instead — the point is to probe past the
-fast-fail estimate, so the hard limit is lifted for the probe.
+Spans follow the round-4 noise study: >=1.2 s marginal spans repeat
+within ~1-3%. ``--legacy`` measures kernel C even where band_chunk
+would route to C2 (mixed tables without route labels let C2 numbers
+masquerade as legacy-C measurements — advisor r4).
 """
 
 from __future__ import annotations
@@ -23,82 +25,48 @@ import sys
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import jax
-import jax.numpy as jnp
 
-import heat2d_tpu.ops.pallas_stencil as ps
+import heat2d_tpu.ops.pallas_stencil as ps  # noqa: F401 (probe target)
 from heat2d_tpu.ops import inidat
-from heat2d_tpu.utils.timing import timed_call
-
-
-def route_for(ny, bm, t, force_legacy):
-    """Which kernel a (bm, T) point measures — band_chunk routes T=8
-    lane-aligned configs to the C2 window kernel, the rest to legacy C,
-    and a mixed table without labels would let C2 numbers masquerade as
-    legacy-C measurements (advisor r4)."""
-    if not force_legacy and ps.window_band_viable(ny, bm, t):
-        return "C2"
-    return "C"
+from heat2d_tpu.tune.measure import measure_band_point, probe_limits
+from heat2d_tpu.tune.space import band_est_bytes, route_for
 
 
 def measure(u, bm, t, lo=4000, hi=20000, reps=4, force_legacy=False):
-    """Two-point marginal step time, min-of-reps at each point. Spans
-    follow the round-4 noise study: ~0.5 s marginal spans swing +-15%
-    through the tunnel fence's heavy tails; >=1.2 s spans repeat within
-    ~1-3%. One warmup per step count covers compile + program load; the
-    reps run warmup-free. ``force_legacy`` measures kernel C even where
-    band_chunk would route to C2."""
-    if force_legacy:
-        # Mirror band_chunk's legacy branch exactly: pad ONCE outside
-        # the sweep loop (domain_rows carries the true row count). A
-        # naive per-call band_multi_step(bm=bm) re-pads and re-slices
-        # every sweep at non-divisor bm, inflating exactly the kernel-C
-        # rows this flag exists to measure fairly.
-        def chunk(v, n):
-            nx_dom = v.shape[0]
-            _, m_pad = ps._resolve_bands(nx_dom, v.shape[1], v.dtype, bm)
-            if m_pad > nx_dom:
-                v = jnp.pad(v, ((0, m_pad - nx_dom), (0, 0)))
-            full, rem = divmod(n, t)
-            if full:
-                v = jax.lax.fori_loop(
-                    0, full,
-                    lambda _, w: ps.band_multi_step(
-                        w, t, 0.1, 0.1, bm=bm, domain_rows=nx_dom),
-                    v, unroll=False)
-            if rem:
-                v = ps.band_multi_step(v, rem, 0.1, 0.1, bm=bm,
-                                       domain_rows=nx_dom)
-            return v[:nx_dom]
-        fn = jax.jit(chunk, static_argnums=1)
-    else:
-        fn = jax.jit(
-            lambda v, n: ps.band_chunk(v, n, 0.1, 0.1, tsteps=t, bm=bm),
-            static_argnums=1)
-
-    def min_of(n):
-        ts = [timed_call(fn, u, n)[1]]          # warms up once
-        ts += [timed_call(fn, u, n, warmup=False)[1]
-               for _ in range(reps - 1)]
-        return min(ts)
-
-    return (min_of(hi) - min_of(lo)) / (hi - lo)
+    """Two-point marginal step time, min-of-reps at each point (the
+    shared library protocol — tune/measure.py)."""
+    return measure_band_point(u, bm, t, lo=lo, hi=hi, reps=reps,
+                              force_legacy=force_legacy)
 
 
 def main(argv):
     force_legacy = "--legacy" in argv
     argv = [a for a in argv if a != "--legacy"]
+    db_path = None
+    for a in list(argv):
+        if a.startswith("--db="):
+            db_path = a.split("=", 1)[1]
+            argv.remove(a)
+    if "--db" in argv:                   # space form: --db PATH
+        i = argv.index("--db")
+        if i + 1 >= len(argv):
+            print(f"usage: {argv[0]} [nx ny] [--legacy] [--db PATH]",
+                  file=sys.stderr)
+            return 1
+        db_path = argv[i + 1]
+        del argv[i:i + 2]
+    db = None
+    if db_path is not None:
+        from heat2d_tpu.tune.db import TuningDB
+        db = TuningDB(db_path)
     if len(argv) == 3:
         nx, ny = int(argv[1]), int(argv[2])
     elif len(argv) == 1:
         nx, ny = 4096, 4096
     else:
-        print(f"usage: {argv[0]} [nx ny] [--legacy]", file=sys.stderr)
+        print(f"usage: {argv[0]} [nx ny] [--legacy] [--db PATH]",
+              file=sys.stderr)
         return 1
-    # Probe past the planner's own ceiling: the envelope is what we are
-    # here to measure. Stamp the origin so a fast-fail inside the probe
-    # reports itself as probe-lifted, not as a --vmem-budget override.
-    ps.VMEM_HARD_LIMIT_BYTES = 10**9
-    ps.VMEM_LIMIT_ORIGIN = "lifted by the tune_bands probe"
     u = inidat(nx, ny)
     jax.block_until_ready(u)
     cells = (nx - 2) * (ny - 2)
@@ -107,29 +75,57 @@ def main(argv):
         for bm in (64, 96, 128, 160, 192, 224, 256):
             if bm > 2 * t:
                 configs.append((bm, t))
-    print(f"# {nx}x{ny} on {jax.devices()[0].device_kind}; "
+    kind = jax.devices()[0].device_kind
+    print(f"# {nx}x{ny} on {kind}; "
           f"two-point 4000->20000 steps, min of 4 per point"
           + (" (forced legacy route)" if force_legacy else ""))
     best = None
-    for bm, t in configs:
-        est = 5 * (bm + 2 * t) * ny * 4 / 2**20
-        route = route_for(ny, bm, t, force_legacy)
-        try:
-            step = measure(u, bm, t, force_legacy=force_legacy)
-        except Exception as e:  # noqa: BLE001 - report and move on
+    # Probe past the planner's own ceiling: the envelope is what we are
+    # here to measure. The context manager stamps the origin (so a
+    # fast-fail inside the probe reports itself as probe-lifted, not as
+    # a --vmem-budget override) and RESTORES the limit on any exit —
+    # the old module-global assignment leaked probe mode on exception.
+    with probe_limits("lifted by the tune_bands probe"):
+        for bm, t in configs:
+            est = band_est_bytes(bm, t, ny, 4) / 2**20
+            route = route_for(ny, bm, t, force_legacy)
+            try:
+                step = measure(u, bm, t, force_legacy=force_legacy)
+            except Exception as e:  # noqa: BLE001 - report and move on
+                print(f"bm={bm:4d} T={t:2d} {route:2s} est={est:6.1f}MB  "
+                      f"FAILED {type(e).__name__}: {str(e)[:90]}")
+                if db is not None:
+                    from heat2d_tpu.tune.measure import classify_failure
+                    db.record_point(kind, f"{nx}x{ny}:float32", {
+                        "route": route, "bm": bm, "tsteps": t,
+                        "status": classify_failure(e),
+                        "error": f"{type(e).__name__}: {str(e)[:200]}"})
+                    db.save()
+                continue
+            mcells = cells / step / 1e6
+            tag = ""
+            if best is None or mcells > best[0]:
+                best = (mcells, bm, t, route)
+                tag = "  <-- best"
             print(f"bm={bm:4d} T={t:2d} {route:2s} est={est:6.1f}MB  "
-                  f"FAILED {type(e).__name__}: {str(e)[:90]}")
-            continue
-        mcells = cells / step / 1e6
-        tag = ""
-        if best is None or mcells > best[0]:
-            best = (mcells, bm, t, route)
-            tag = "  <-- best"
-        print(f"bm={bm:4d} T={t:2d} {route:2s} est={est:6.1f}MB  "
-              f"step={step:.3e}s  {mcells:10.1f} Mcells/s{tag}")
+                  f"step={step:.3e}s  {mcells:10.1f} Mcells/s{tag}")
+            if db is not None:
+                db.record_point(kind, f"{nx}x{ny}:float32", {
+                    "route": route, "bm": bm, "tsteps": t,
+                    "status": "ok", "step_time_s": step,
+                    "mcells_per_s": mcells})
+                db.save()
     if best:
         print(f"# best: bm={best[1]} T={best[2]} ({best[3]}) "
               f"{best[0]:.1f} Mcells/s")
+        if db is not None:
+            from heat2d_tpu.tune.cli import _provenance
+            db.set_best(kind, f"{nx}x{ny}:float32",
+                        {"route": best[3], "bm": best[1],
+                         "tsteps": best[2]}, best[0],
+                        _provenance(None, 4000, 20000, 4))
+            db.save()
+            print(f"# recorded into {db.path}")
     return 0
 
 

@@ -11,7 +11,9 @@ real (P, bm) frontier on the attached chip, including the P=1 baseline
 
 Calls the panel internals directly (bypassing the probed-envelope
 guard): the point is to probe past it. Two-point protocol and spans per
-the round-4 noise study (>=1.2 s marginal spans repeat within ~1-3%).
+the round-4 noise study (>=1.2 s marginal spans repeat within ~1-3%);
+the protocol itself lives in ``heat2d_tpu.tune.measure`` (one copy,
+shared with heat2d-tpu-tune, tune_bands, and sweep.py).
 """
 
 from __future__ import annotations
@@ -25,7 +27,7 @@ import jax
 
 import heat2d_tpu.ops.pallas_stencil as ps
 from heat2d_tpu.ops import inidat
-from heat2d_tpu.utils.timing import timed_call
+from heat2d_tpu.tune.measure import min_of_two_point, probe_limits
 
 
 def measure(u, panels, bm, lo, hi, reps=4):
@@ -38,15 +40,9 @@ def measure(u, panels, bm, lo, hi, reps=4):
         cs = ps._panel_multi(cs, n, 8, 0.1, 0.1, bm, nx, ps._step_value)
         return ps._panel_join(cs, nx)
 
-    fn = jax.jit(chunk, static_argnums=1)
-
-    def min_of(n):
-        ts = [timed_call(fn, u, n)[1]]          # warms up once
-        ts += [timed_call(fn, u, n, warmup=False)[1]
-               for _ in range(reps - 1)]
-        return min(ts)
-
-    return (min_of(hi) - min_of(lo)) / (hi - lo)
+    # The shared two-point min-of-reps protocol (tune/measure.py).
+    return min_of_two_point(jax.jit(chunk, static_argnums=1), u, lo, hi,
+                            reps=reps)
 
 
 def main(argv):
@@ -60,8 +56,6 @@ def main(argv):
         nx, ny = int(argv[1]), int(argv[2])
     else:
         nx, ny = 8192, 8192
-    ps.VMEM_HARD_LIMIT_BYTES = 10**9
-    ps.VMEM_LIMIT_ORIGIN = "lifted by the tune_panels probe"
     u = inidat(nx, ny)
     jax.block_until_ready(u)
     cells = (nx - 2) * (ny - 2)
@@ -82,22 +76,26 @@ def main(argv):
     print(f"# {nx}x{ny} on {jax.devices()[0].device_kind}; "
           f"two-point {lo}->{hi} steps, min of 4 per point")
     best = None
-    for p, bm in configs:
-        if bm is None:
-            bm, _ = ps.plan_window_band(nx, ny, 8)
-        try:
-            step = measure(u, p, bm, lo, hi)
-        except Exception as e:  # noqa: BLE001 - report and move on
-            print(f"P={p} bm={bm:4d}  FAILED {type(e).__name__}: "
-                  f"{str(e)[:90]}")
-            continue
-        mcells = cells / step / 1e6
-        tag = ""
-        if best is None or mcells > best[0]:
-            best = (mcells, p, bm)
-            tag = "  <-- best"
-        print(f"P={p} bm={bm:4d}  step={step:.3e}s  "
-              f"{mcells:10.1f} Mcells/s{tag}", flush=True)
+    # Probe mode as a context manager: the envelope guard is what this
+    # harness probes past, and the limit is restored on ANY exit (the
+    # old module-global assignment leaked probe mode on exception).
+    with probe_limits("lifted by the tune_panels probe"):
+        for p, bm in configs:
+            if bm is None:
+                bm, _ = ps.plan_window_band(nx, ny, 8)
+            try:
+                step = measure(u, p, bm, lo, hi)
+            except Exception as e:  # noqa: BLE001 - report and move on
+                print(f"P={p} bm={bm:4d}  FAILED {type(e).__name__}: "
+                      f"{str(e)[:90]}")
+                continue
+            mcells = cells / step / 1e6
+            tag = ""
+            if best is None or mcells > best[0]:
+                best = (mcells, p, bm)
+                tag = "  <-- best"
+            print(f"P={p} bm={bm:4d}  step={step:.3e}s  "
+                  f"{mcells:10.1f} Mcells/s{tag}", flush=True)
     if best:
         print(f"# best: P={best[1]} bm={best[2]} {best[0]:.1f} Mcells/s")
     return 0

@@ -130,7 +130,9 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--vmem-budget", type=int, default=None, metavar="MiB",
                    help="per-core VMEM size in MiB to plan kernels against, "
                         "overriding the value derived from the detected "
-                        "device kind (v5e: 16)")
+                        "device kind (v5e: 16); HEAT2D_VMEM_BUDGET is the "
+                        "env twin, and the active source (default/flag/"
+                        "env/probe/db) is recorded in the run record")
     p.add_argument("--debug", action="store_true")
     p.add_argument("--device-info", action="store_true",
                    help="print device summary (detailsGPU analogue) and exit")
@@ -374,6 +376,10 @@ def _run_ensemble_cli(args, cfg) -> int:
             # (the 'jnp' method's vmapped loop ignores the tap) — an
             # empty list would read as 'zero chunks ran'.
             record["chunk_progress"] = telemetry.chunk_progress()
+        from heat2d_tpu.tune import runtime as _tune_runtime
+        tuned = _tune_runtime.applied_configs()
+        if tuned:
+            record["tuned_config"] = tuned
         if registry is not None:
             registry.gauge("elapsed_s", float(elapsed))
             registry.gauge("members", len(cxs))
@@ -418,6 +424,16 @@ def main(argv=None) -> int:
         from heat2d_tpu.ops.pallas_stencil import set_vmem_budget
         try:
             set_vmem_budget(args.vmem_budget * 1024 * 1024)
+        except ConfigError as e:
+            print(f"{e}\nQuitting...", file=sys.stderr)
+            return 1
+    elif os.environ.get("HEAT2D_VMEM_BUDGET"):
+        # Validate the env override at startup: modes that never touch
+        # the VMEM planner would otherwise only hit a malformed value
+        # at record-building time, AFTER the whole solve ran.
+        from heat2d_tpu.ops.pallas_stencil import vmem_budget_bytes
+        try:
+            vmem_budget_bytes()
         except ConfigError as e:
             print(f"{e}\nQuitting...", file=sys.stderr)
             return 1
@@ -670,6 +686,17 @@ def main(argv=None) -> int:
         # compile/warmup metric; the CLI adds its mode-specific extras.
         record = result.to_record()
         record["total_steps_including_resume"] = total_steps
+        # Kernel-plan provenance (docs/TUNING.md): which source set the
+        # active VMEM planning budget, and any tuned configs the opt-in
+        # tuning db (HEAT2D_TUNE_DB) supplied to the band planners.
+        from heat2d_tpu.ops import pallas_stencil as _ps
+        record["vmem_budget"] = {
+            "bytes": _ps.vmem_budget_bytes(),
+            "source": _ps.vmem_budget_source()}
+        from heat2d_tpu.tune import runtime as _tune_runtime
+        tuned = _tune_runtime.applied_configs()
+        if tuned:
+            record["tuned_config"] = tuned
         if resumed:
             record["resume_from_step"] = start_step
         if ckpt_writer is not None:

@@ -20,8 +20,9 @@ RECORD_SCHEMA = "heat2d-tpu/run-record/v1"
 #: ``kind`` can enumerate what exists without grepping call sites.
 #: "run" (CLI solver), "ensemble" (CLI batched sweep), "bench"/"sweep"
 #: (benchmark harnesses), "serve" (heat2d-tpu-serve: launch log +
-#: serving telemetry snapshot rides in the same JSONL).
-RECORD_KINDS = ("run", "ensemble", "bench", "sweep", "serve")
+#: serving telemetry snapshot rides in the same JSONL), "tune"
+#: (heat2d-tpu-tune: search summary + tune_* metric families).
+RECORD_KINDS = ("run", "ensemble", "bench", "sweep", "serve", "tune")
 
 
 def run_context() -> dict:

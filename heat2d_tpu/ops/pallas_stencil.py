@@ -29,6 +29,7 @@ the jnp golden model in interpreter mode and on-device.
 from __future__ import annotations
 
 import functools
+import os
 
 import jax
 import jax.numpy as jnp
@@ -74,8 +75,52 @@ VMEM_HARD_LIMIT_BYTES: int | None = None
 #: message (set_vmem_budget and the tune_bands probe each stamp their
 #: own — so a probe failure doesn't misreport as a --vmem-budget issue).
 VMEM_LIMIT_ORIGIN: str | None = None
+#: Which source set the active budget — "default" (derived from the
+#: detected device), "flag" (--vmem-budget), "env" (HEAT2D_VMEM_BUDGET),
+#: "probe" (a tune probe's lifted limit, tune.measure.probe_limits), or
+#: "db" (a tuning db's probed vmem stamp). Surfaced in run records.
+VMEM_BUDGET_SOURCE: str = "default"
+
+#: Env override for the per-core VMEM total, in MiB (the --vmem-budget
+#: flag's units) — applied lazily at the first budget query so library
+#: embedders get it without CLI plumbing.
+_ENV_BUDGET_VAR = "HEAT2D_VMEM_BUDGET"
+_env_budget_checked = False
 
 _detected: tuple[int, str] | None = None
+
+
+def _maybe_env_budget() -> None:
+    """Apply the HEAT2D_VMEM_BUDGET env override once, unless an
+    explicit set_vmem_budget (flag/db/test monkeypatch) already won.
+    A malformed value raises on EVERY query (the checked flag is only
+    set on success): raising once and then silently serving the
+    default would let a typo'd cap masquerade as applied."""
+    global _env_budget_checked
+    if _env_budget_checked or VMEM_BUDGET_BYTES is not None:
+        return
+    v = os.environ.get(_ENV_BUDGET_VAR)
+    if not v:
+        _env_budget_checked = True
+        return
+    try:
+        set_vmem_budget(int(v) * 1024 * 1024, source="env",
+                        origin=f"set by the {_ENV_BUDGET_VAR} env "
+                               f"override")
+    except (ValueError, ConfigError) as e:
+        raise ConfigError(
+            f"{_ENV_BUDGET_VAR}={v!r} is not a valid per-core VMEM "
+            f"size in MiB: {e}") from e
+    _env_budget_checked = True
+
+
+def vmem_budget_source() -> str:
+    """Provenance of the active VMEM planning budget (run records'
+    ``vmem_budget.source``)."""
+    _maybe_env_budget()
+    if VMEM_BUDGET_BYTES is None and VMEM_HARD_LIMIT_BYTES is None:
+        return "default"
+    return VMEM_BUDGET_SOURCE
 
 
 def _vmem_total() -> tuple[int, str]:
@@ -97,6 +142,7 @@ def vmem_budget_bytes() -> int:
     """Working-set budget for the VMEM-resident kernel (carry +
     temporaries): half the core's VMEM, leaving the rest for the
     compiler's own buffers."""
+    _maybe_env_budget()
     if VMEM_BUDGET_BYTES is not None:
         return VMEM_BUDGET_BYTES
     total, _ = _vmem_total()
@@ -108,22 +154,28 @@ def vmem_hard_limit_bytes() -> int:
     refuse to compile: total minus ~2 MB of compiler headroom. On the
     v5e this lands at 14 MB; the largest config proven to compile there
     (4096-wide rows, bm=128, T=8) estimates ~11.8 MB."""
+    _maybe_env_budget()
     if VMEM_HARD_LIMIT_BYTES is not None:
         return VMEM_HARD_LIMIT_BYTES
     total, _ = _vmem_total()
     return total - 2 * 1024 * 1024
 
 
-def set_vmem_budget(total_bytes: int) -> None:
-    """Override the detected per-core VMEM size (the --vmem-budget flag):
-    budget and hard limit re-derive from the given total."""
+def set_vmem_budget(total_bytes: int, source: str = "flag",
+                    origin: str | None = None) -> None:
+    """Override the detected per-core VMEM size (the --vmem-budget flag,
+    the HEAT2D_VMEM_BUDGET env, or a tuning db's probed stamp): budget
+    and hard limit re-derive from the given total; ``source``/``origin``
+    stamp the provenance run records and fast-fail messages report."""
     global VMEM_BUDGET_BYTES, VMEM_HARD_LIMIT_BYTES, VMEM_LIMIT_ORIGIN
+    global VMEM_BUDGET_SOURCE
     if total_bytes < 4 * 1024 * 1024:
         raise ConfigError(
             f"--vmem-budget must be at least 4 MiB, got {total_bytes} bytes")
     VMEM_BUDGET_BYTES = total_bytes // 2
     VMEM_HARD_LIMIT_BYTES = total_bytes - 2 * 1024 * 1024
-    VMEM_LIMIT_ORIGIN = "set by the --vmem-budget override"
+    VMEM_LIMIT_ORIGIN = origin or "set by the --vmem-budget override"
+    VMEM_BUDGET_SOURCE = source
 
 
 def _interpret() -> bool:
@@ -300,11 +352,36 @@ def plan_bands(nrows: int, ny: int, dtype=jnp.float32,
     return bm, -(-nrows // bm) * bm
 
 
+def _tuned_band_config(nrows: int, ny: int, dtype, tsteps=None,
+                       allow_window: bool = True):
+    """Tuned (route, bm, T) from the opt-in tuning db
+    (``HEAT2D_TUNE_DB`` / ``tune.set_tuning_db``), or None — the ONE
+    consultation point the band planners share. Pure host-side lookup,
+    validated against the live resource model by ``tune.runtime``; with
+    no db active it returns None without touching anything, so traced
+    programs are byte-identical to a build without the tune subsystem
+    (the jaxpr-pinned tests hold that line). ``allow_window=False``:
+    the caller can only compile the legacy kernel (parity step form,
+    _resolve_bands consumers), so a C2 answer degrades to route C
+    BEFORE it is recorded — provenance must describe the program that
+    actually compiles."""
+    try:
+        from heat2d_tpu.tune import runtime as _tune_runtime
+    except ImportError:  # pragma: no cover - partial install
+        return None
+    return _tune_runtime.band_config(nrows, ny, dtype, tsteps,
+                                     allow_window=allow_window)
+
+
 def _resolve_bands(m: int, n: int, dtype, bm: int | None) -> tuple[int, int]:
-    """(bm, m_pad) from an explicit bm (ceil m to its multiple) or the
-    plan_bands policy — the one place the padding rule lives."""
+    """(bm, m_pad) from an explicit bm (ceil m to its multiple), the
+    opt-in tuning db, or the plan_bands policy — the one place the
+    padding rule lives."""
     if bm is None:
-        return plan_bands(m, n, dtype)
+        tuned = _tuned_band_config(m, n, dtype, allow_window=False)
+        if tuned is None:
+            return plan_bands(m, n, dtype)
+        bm = tuned.bm
     return bm, -(-m // bm) * bm
 
 
@@ -1023,17 +1100,32 @@ def band_chunk(u, n: int, cx: float, cy: float,
     Legacy route: divisor-poor row counts pad ONCE here for the whole
     loop (the padded shape is a fixed point under the keep-masked
     kernels), not per sweep.
+
+    With a tuning db active (``HEAT2D_TUNE_DB``) and no explicit
+    ``bm``, the measured best (bm, T, route) for this shape replaces
+    the heuristic plan: route "C" pins the legacy kernel even where
+    the window route is viable, route "C2" carries the tuned band
+    height into the window planner. Absent/missing db: the static
+    policy below, unchanged.
     """
     nx, ny = u.shape
+    force_legacy = False
+    if bm is None:
+        tuned = _tuned_band_config(nx, ny, u.dtype, tsteps,
+                                   allow_window=step is _step_value)
+        if tuned is not None:
+            bm, tsteps = tuned.bm, tuned.tsteps
+            force_legacy = tuned.route == "C"
     bm_w = bm
-    if bm_w is None and _on_tpu() and ny % 128 == 0 and tsteps % 8 == 0:
+    if (bm_w is None and _on_tpu() and ny % 128 == 0
+            and tsteps % 8 == 0):
         bm_w, _ = plan_window_band(nx, ny, tsteps, u.dtype)
     # The C2 envelope table was probed with the FMA step form; the
     # literal (bitwise-parity) form carries more live temporaries and
     # OOMs at the same bm (measured: 18.1 MB vs <16 at bm=320, 8 KB
     # rows), so parity runs — correctness runs, not perf runs — keep
     # the legacy route.
-    if (step is _step_value and bm_w is not None
+    if (not force_legacy and step is _step_value and bm_w is not None
             and window_band_viable(ny, bm_w, tsteps)):
         return _window_chunk(u, n, cx, cy, tsteps, bm_w, step)
     bm, m_pad = _resolve_bands(nx, ny, u.dtype, bm)

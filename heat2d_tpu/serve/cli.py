@@ -210,8 +210,13 @@ def _write_metrics(args, registry, server, extra=None) -> None:
         "launch_log": [
             {"signature": list(map(str, row["signature"])),
              "occupancy": row["occupancy"],
-             "capacity": row["capacity"]}
+             "capacity": row["capacity"],
+             "tuned_config": row.get("tuned_config")}
             for row in server.engine.launch_log],
+        # Per-signature tuned-config pre-resolve (docs/TUNING.md):
+        # which signatures run measured kernel configs vs heuristics.
+        "tuned_config": [t for t in server.engine.tuned.values()
+                         if t is not None],
         **(extra or {})})
     registry.write_jsonl(args.metrics_out,
                          extra_records=[{"event": "run_record", **record}])

@@ -51,6 +51,67 @@ class EnsembleEngine:
         self.max_batch = max_batch
         self.launches = 0           # total ensemble launches performed
         self.launch_log: List[dict] = []   # one row per launch (tests)
+        #: signature -> tuned-config dict (or None) resolved BEFORE the
+        #: signature's first compile — warmup provenance for the
+        #: per-signature compile cache (docs/TUNING.md).
+        self.tuned: dict = {}
+
+    def _preresolve_tuned(self, req0):
+        """Resolve the tuning db's answer for this signature once,
+        before its first launch compiles. The band kernels consult the
+        same hook during tracing (ops._resolve_bands); resolving here
+        makes the answer part of the launch record — a serve deployment
+        can see which signatures run measured configs — and warms the
+        db lookup off the dispatch path."""
+        sig = req0.signature()
+        if sig in self.tuned:
+            return self.tuned[sig]
+        tuned = None
+        from heat2d_tpu.models import ensemble
+        if (ensemble._pick_method(req0.method, req0.nx, req0.ny)
+                == "band" and not self._window_route(req0)):
+            from heat2d_tpu.tune import runtime as tune_runtime
+            # allow_window=False: the batched runner's LEGACY band
+            # kernel is what consumes the tuned bm (through
+            # ops._resolve_bands) — a C2-stamped entry is relabeled
+            # route C so the record describes the program that
+            # actually compiles (review r6).
+            cfg = tune_runtime.band_config(req0.nx, req0.ny, "float32",
+                                           allow_window=False)
+            if cfg is not None:
+                tuned = cfg.to_dict()
+        self.tuned[sig] = tuned
+        if self.registry is not None:
+            self.registry.counter("tune_serve_signatures_total",
+                                  tuned=str(tuned is not None).lower())
+        return tuned
+
+    @staticmethod
+    def _window_route(req0) -> bool:
+        """True when the batched band runner would take the
+        _ens_plan_window route — that branch plans from its own probed
+        batched envelope and never consults the tuning db, so claiming
+        a tuned config there would misreport the compiled program."""
+        import jax.numpy as jnp
+
+        from heat2d_tpu.models import ensemble
+        from heat2d_tpu.ops import pallas_stencil as ps
+        t = ps.DEFAULT_TSTEPS
+        if not (ps._on_tpu() and req0.ny % 128 == 0 and t % 8 == 0):
+            return False
+        plan = ensemble._ens_plan_window(req0.nx, req0.ny, t,
+                                         jnp.float32)
+        if plan is None:
+            return False
+        if not req0.convergence:
+            return True
+        # Convergence additionally gates on a viable fused-resid band
+        # (_band_conv_runner): without one it falls back to the legacy
+        # band runner, which DOES consult the db.
+        bm, m_pad = plan
+        return ensemble._ens_resid_bm(
+            m_pad, bm, req0.ny * jnp.dtype(jnp.float32).itemsize,
+            t) is not None
 
     def solve_batch(self, requests) -> List[Tuple["object", int]]:
         """Solve same-signature ``requests`` in ONE ensemble launch.
@@ -65,6 +126,7 @@ class EnsembleEngine:
         from heat2d_tpu.models import ensemble
 
         req0 = requests[0]
+        tuned = self._preresolve_tuned(req0)
         n = len(requests)
         capacity = _pad_capacity(n, self.max_batch)
         cxs = [r.cx for r in requests]
@@ -101,7 +163,7 @@ class EnsembleEngine:
         self.launches += 1
         self.launch_log.append({
             "signature": req0.signature(), "occupancy": n,
-            "capacity": capacity})
+            "capacity": capacity, "tuned_config": tuned})
         if self.registry is not None:
             self.registry.counter("serve_launches_total")
             self.registry.gauge("serve_compile_cache_size",

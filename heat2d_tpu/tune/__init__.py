@@ -1,0 +1,39 @@
+"""Autotune subsystem — on-device kernel search with a persistent
+per-device tuning database (docs/TUNING.md).
+
+The Pallas stencil layer's performance-critical choices — band height
+``bm``, fused step depth ``T``, kernel route (VMEM-resident / legacy-C
+band / C2 window) — started as hardcoded heuristics with "MEASURED
+(tune_bands.py probe...)" comments, and the probe harnesses' findings
+died in markdown tables. This package closes the loop, Triton/XLA-style:
+
+- ``space``   — declarative candidate generation for a (shape, dtype)
+  problem, pruned by the existing VMEM resource model before anything
+  compiles.
+- ``measure`` — the two-point marginal-step-time protocol as a library
+  (single copy; ``benchmarks/sweep.py`` and the ``tune_*`` harnesses
+  import it), with failure-class capture and a deterministic simulated
+  backend so the search logic is testable on CPU.
+- ``db``      — a persistent JSON tuning database keyed by
+  (device kind, problem key, code-version salt), atomic writes, and a
+  three-tier lookup: exact hit -> nearest-shape (flagged) -> None
+  (callers keep today's static heuristics — no behavior cliff when the
+  db is absent).
+- ``runtime`` — the opt-in consultation hook (``HEAT2D_TUNE_DB``) the
+  band planners, the batched ensemble runner, and the serve engine's
+  per-signature pre-resolve all go through.
+- ``cli``     — ``heat2d-tpu-tune``: run/resume a search, print the
+  frontier table, export the db; ``--selftest`` runs the whole loop on
+  the simulated backend.
+"""
+
+from heat2d_tpu.tune.db import TunedConfig, TuningDB, current_salt
+from heat2d_tpu.tune.runtime import (active_db, applied_configs,
+                                     set_tuning_db)
+from heat2d_tpu.tune.space import Candidate, Problem, candidate_space
+
+__all__ = [
+    "Candidate", "Problem", "TunedConfig", "TuningDB", "active_db",
+    "applied_configs", "candidate_space", "current_salt",
+    "set_tuning_db",
+]

@@ -1,0 +1,420 @@
+"""``heat2d-tpu-tune`` — run/resume a kernel search, print the
+frontier, export the db.
+
+The search loop per shape: build the candidate space (pruned by the
+VMEM resource model before anything compiles), skip points the db
+already holds a terminal result for (RESUME — a killed search loses at
+most the point in flight), measure the rest under probe mode (the VMEM
+hard limit lifted and restored by the ``probe_limits`` context
+manager), record every outcome into the db with an atomic save after
+each point, then stamp the best ``(route, bm, T)`` + measured Mcells/s
++ provenance as the entry consumers (``band_chunk``, the serve engine's
+per-signature pre-resolve) look up.
+
+``--selftest`` runs the whole loop twice on the deterministic simulated
+backend (CPU-safe, milliseconds): the first pass must write a db and
+stamp a best config per shape; the second must be a PURE cache hit
+(zero measurements); and the printed frontier table must match the
+stored entries. The CI ``tune-selftest`` job runs exactly this.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+from heat2d_tpu.tune.db import TuningDB, current_salt
+from heat2d_tpu.tune.measure import (TERMINAL_STATUSES, SimulatedBackend,
+                                     measure_candidate, probe_limits)
+from heat2d_tpu.tune.space import Candidate, Problem, candidate_space
+
+DEFAULT_DB = "tune_db.json"
+#: The selftest's shapes: one VMEM-resident (exercises the vmem route),
+#: two streaming (exercise bm/T search, the C2-vs-C split, and —
+#: at 8192 columns — simulated envelope failures).
+SELFTEST_SHAPES = ((640, 512), (4096, 4096), (4096, 8192))
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="heat2d-tpu-tune",
+        description="on-device kernel search with a persistent "
+                    "per-device tuning database (docs/TUNING.md)")
+    p.add_argument("--shapes", default=None, metavar="LIST",
+                   help="comma-separated NXxNY shapes to tune "
+                        "(e.g. 4096x4096,2560x2048)")
+    p.add_argument("--db", default=None, metavar="PATH",
+                   help=f"tuning db path (default: $HEAT2D_TUNE_DB or "
+                        f"./{DEFAULT_DB})")
+    p.add_argument("--routes", default=None, metavar="LIST",
+                   help="restrict the search to these routes "
+                        "(vmem,C,C2; default all)")
+    p.add_argument("--t-ladder", default=None, metavar="LIST",
+                   help="comma-separated fused-step depths "
+                        "(default 4,8,12,16)")
+    p.add_argument("--bm-grid", default=None, metavar="LIST",
+                   help="comma-separated band heights (8-aligned; "
+                        "default the probe ladder + planner picks)")
+    p.add_argument("--lo", type=int, default=4000,
+                   help="two-point low step count")
+    p.add_argument("--hi", type=int, default=20000,
+                   help="two-point high step count")
+    p.add_argument("--reps", type=int, default=4,
+                   help="min-of-reps per point")
+    p.add_argument("--compile-timeout", type=float, default=300.0,
+                   metavar="S",
+                   help="soft compile+warmup wall per point; points "
+                        "over it record status=timeout and are never "
+                        "re-attempted on resume")
+    p.add_argument("--probe-past-envelope", action="store_true",
+                   help="keep resource-model rejects in the search "
+                        "(the envelope-probing mode; failures are the "
+                        "datum)")
+    p.add_argument("--simulate", action="store_true",
+                   help="measure on the deterministic simulated "
+                        "backend instead of the attached device "
+                        "(search-logic testing; CPU-safe)")
+    p.add_argument("--selftest", action="store_true",
+                   help="end-to-end search/db/resume selftest on the "
+                        "simulated backend; exit nonzero on any "
+                        "invariant failure")
+    p.add_argument("--print", dest="print_only", action="store_true",
+                   help="print the frontier table from the stored db "
+                        "without measuring anything")
+    p.add_argument("--export", default=None, metavar="PATH",
+                   help="write the db document (pretty JSON) here "
+                        "after the run")
+    p.add_argument("--metrics-out", default=None, metavar="PATH",
+                   help="write telemetry JSONL (tune_* metric "
+                        "families + a kind='tune' run record)")
+    p.add_argument("--platform", default=None, choices=["cpu", "tpu"],
+                   help="force a JAX platform")
+    return p
+
+
+def _parse_shapes(arg: str):
+    out = []
+    for tok in arg.split(","):
+        nx, ny = tok.lower().split("x")
+        out.append((int(nx), int(ny)))
+    return out
+
+
+def _device_kind(backend) -> str:
+    if backend is not None:
+        return backend.device_kind
+    from heat2d_tpu.ops import pallas_stencil as ps
+    return ps._vmem_total()[1]
+
+
+def search_problem(db: TuningDB, problem: Problem, *, backend=None,
+                   routes=None, bm_grid=None, t_ladder=None, lo=4000,
+                   hi=20000, reps=4, compile_timeout_s=300.0,
+                   probe_past_envelope=False, registry=None,
+                   out=sys.stdout) -> dict:
+    """Search one shape, resuming from the db. Returns the summary
+    {"measured": n, "cached": n, "failed": n, "best": point|None}."""
+    kind = _device_kind(backend)
+    key = problem.key()
+    cands, pruned = candidate_space(
+        problem, routes=routes, bm_grid=bm_grid, t_ladder=t_ladder,
+        probe_past_envelope=probe_past_envelope,
+        assume_tpu=backend is not None)
+    # Never clobber a real measurement with a prune note: a prior
+    # --probe-past-envelope run may hold measured data for points the
+    # conservative model rejects (review r6).
+    measured_already = db.measured_keys(
+        kind, key, ("ok", "oom", "compile_error", "timeout", "error"))
+    wrote_pruned = False
+    for c, reason in pruned:
+        if (c.route, c.bm, c.tsteps) in measured_already:
+            continue
+        db.record_point(kind, key,
+                        {"route": c.route, "bm": c.bm,
+                         "tsteps": c.tsteps, "status": "pruned",
+                         "error": reason})
+        wrote_pruned = True
+    if wrote_pruned:
+        db.save()      # pruned-only shapes still leave their trace
+    # Under --probe-past-envelope a previously-PRUNED point is exactly
+    # what the user asked to measure — only real measurement outcomes
+    # count as terminal then (review r6).
+    terminal = (tuple(s for s in TERMINAL_STATUSES if s != "pruned")
+                if probe_past_envelope else TERMINAL_STATUSES)
+    done = db.measured_keys(kind, key, terminal)
+    measured = failed = cached = 0
+    u = None
+    if backend is None and any(
+            (c.route, c.bm, c.tsteps) not in done for c in cands):
+        import jax
+        from heat2d_tpu.ops import inidat
+        u = jax.block_until_ready(inidat(problem.nx, problem.ny))
+    with probe_limits("lifted by the heat2d-tpu-tune probe"):
+        for c in cands:
+            if (c.route, c.bm, c.tsteps) in done:
+                cached += 1
+                continue
+            outc = measure_candidate(
+                problem, c, u=u, backend=backend, lo=lo, hi=hi,
+                reps=reps, compile_timeout_s=compile_timeout_s,
+                registry=registry)
+            db.record_point(kind, key, outc.to_point())
+            db.save()          # crash-safe resume: one point at risk
+            measured += 1
+            if outc.status != "ok":
+                failed += 1
+                print(f"  {problem.key():>18} {c.label():<18} "
+                      f"{outc.status}: {outc.error}", file=out)
+            else:
+                print(f"  {problem.key():>18} {c.label():<18} "
+                      f"step={outc.step_time_s:.3e}s "
+                      f"{outc.mcells_per_s:10.1f} Mcells/s", file=out)
+    if registry is not None and cached:
+        registry.counter("tune_points_cached_total", value=cached)
+
+    entry = db.entry(kind, key)
+    ok_points = [p for p in (entry or {}).get("points", [])
+                 if p.get("status") == "ok"]
+    best = None
+    if ok_points:
+        best = max(ok_points, key=lambda p: p["mcells_per_s"])
+        db.set_best(
+            kind, key,
+            {"route": best["route"], "bm": best["bm"],
+             "tsteps": best["tsteps"]},
+            best["mcells_per_s"],
+            _provenance(backend, lo, hi, reps))
+        db.save()
+        if registry is not None:
+            registry.gauge("tune_best_mcells_per_s",
+                           best["mcells_per_s"], shape=key)
+    return {"problem": key, "measured": measured, "cached": cached,
+            "failed": failed, "best": best}
+
+
+def _provenance(backend, lo, hi, reps) -> dict:
+    import datetime
+
+    prov = {
+        "protocol": f"two-point {lo}->{hi} steps, min of {reps}",
+        "backend": ("simulated" if backend is not None
+                    else "device"),
+        "salt": current_salt(),
+        "timestamp": datetime.datetime.now(
+            datetime.timezone.utc).isoformat(timespec="seconds"),
+    }
+    if backend is None:
+        import jax
+        prov["jax_version"] = jax.__version__
+    return prov
+
+
+def frontier_table(db: TuningDB, device_kind: str) -> str:
+    """The stored frontier: one row per (shape, measured point), ok
+    points ranked by rate, the stamped best tagged — everything printed
+    comes from the db, so the table doubles as a dump consumers can
+    diff against the entries."""
+    lines = [f"# tuning frontier — {device_kind} "
+             f"(salt {current_salt()})",
+             f"{'shape:dtype':>20} {'route':<5} {'bm':>4} {'T':>3} "
+             f"{'step (s)':>11} {'Mcells/s':>10}  status"]
+    entries = (db.data["devices"].get(device_kind, {})
+               .get("entries", {}))
+    for key in sorted(entries):
+        e = db.entry(device_kind, key)
+        if e is None:
+            continue
+        best = e.get("best") or {}
+        pts = sorted(e.get("points", []),
+                     key=lambda p: -(p.get("mcells_per_s") or 0))
+        for p in pts:
+            is_best = (best and p.get("status") == "ok"
+                       and (p["route"], p["bm"], p["tsteps"])
+                       == (best.get("route"), best.get("bm"),
+                           best.get("tsteps")))
+            st = p.get("step_time_s")
+            mc = p.get("mcells_per_s")
+            lines.append(
+                f"{key:>20} {p.get('route', '?'):<5} "
+                f"{p.get('bm', 0):>4} {p.get('tsteps', 0):>3} "
+                f"{f'{st:.3e}' if st is not None else '—':>11} "
+                f"{f'{mc:.1f}' if mc is not None else '—':>10}  "
+                f"{p.get('status')}{'  <-- best' if is_best else ''}")
+    return "\n".join(lines)
+
+
+def run_search(args, registry=None, out=sys.stdout) -> int:
+    backend = SimulatedBackend() if args.simulate else None
+    db_path = args.db or os.environ.get("HEAT2D_TUNE_DB", DEFAULT_DB)
+    db = TuningDB(db_path)
+    kind = _device_kind(backend)
+    shapes = _parse_shapes(args.shapes) if args.shapes else \
+        [(4096, 4096)]
+    routes = args.routes.split(",") if args.routes else None
+    t_ladder = ([int(v) for v in args.t_ladder.split(",")]
+                if args.t_ladder else None)
+    bm_grid = ([int(v) for v in args.bm_grid.split(",")]
+               if args.bm_grid else None)
+
+    print(f"# search on {kind}; db={db_path} (salt {current_salt()})",
+          file=out)
+    totals = {"measured": 0, "cached": 0, "failed": 0}
+    for nx, ny in shapes:
+        s = search_problem(
+            db, Problem(nx, ny), backend=backend, routes=routes,
+            bm_grid=bm_grid, t_ladder=t_ladder, lo=args.lo, hi=args.hi,
+            reps=args.reps, compile_timeout_s=args.compile_timeout,
+            probe_past_envelope=args.probe_past_envelope,
+            registry=registry, out=out)
+        for k in totals:
+            totals[k] += s[k]
+        b = s["best"]
+        print(f"# {s['problem']}: best "
+              + (f"{b['route']} bm={b['bm']} T={b['tsteps']} "
+                 f"{b['mcells_per_s']:.1f} Mcells/s" if b else "none")
+              + f" (measured {s['measured']}, cached {s['cached']}, "
+                f"failed {s['failed']})", file=out)
+    print(frontier_table(db, kind), file=out)
+    print(f"# totals: measured={totals['measured']} "
+          f"cached={totals['cached']} failed={totals['failed']}",
+          file=out)
+    if args.export:
+        with open(args.export, "w") as f:
+            json.dump(db.data, f, indent=2, sort_keys=True)
+        print(f"# exported db to {args.export}", file=out)
+    _write_metrics(args, registry, totals)
+    return 0
+
+
+def run_selftest(args, registry=None) -> int:
+    """Search -> db -> resume -> frontier, all on the simulated
+    backend. Asserts: a db file is produced with a stamped best per
+    shape; a second run is a PURE cache hit (zero measurements); the
+    frontier table matches the stored entries."""
+    import tempfile
+
+    backend = SimulatedBackend()
+    db_path = args.db or os.path.join(tempfile.mkdtemp("heat2d-tune"),
+                                      "tune_db.json")
+    if os.path.exists(db_path):
+        # The selftest's invariants assume a COLD start (first pass
+        # must measure, second must cache); a warm db from a previous
+        # selftest would fail them spuriously. The path is the
+        # selftest's own artifact — start it fresh.
+        os.remove(db_path)
+        print(f"# selftest: removed pre-existing db at {db_path} "
+              f"(cold-start invariants)")
+    failures = []
+    shapes = (_parse_shapes(args.shapes) if args.shapes
+              else SELFTEST_SHAPES)
+
+    # probe_past_envelope: resource-model rejects are MEASURED (the
+    # simulated backend raises its OOM/compile failures), exercising
+    # the failure-class capture end to end.
+    db = TuningDB(db_path)
+    first = [search_problem(db, Problem(nx, ny), backend=backend,
+                            probe_past_envelope=True,
+                            registry=registry)
+             for nx, ny in shapes]
+    if not os.path.exists(db_path):
+        failures.append(f"no db written at {db_path}")
+    if not any(s["measured"] for s in first):
+        failures.append("first pass measured nothing")
+    if any(s["best"] is None for s in first):
+        failures.append(f"a shape has no best config: {first}")
+    if not any(s["failed"] for s in first):
+        failures.append("no candidate exercised a failure class "
+                        "(envelope model dead?)")
+
+    # Resume: a FRESH db object against the same file must skip every
+    # completed point (the crash-resume contract).
+    db2 = TuningDB(db_path)
+    second = [search_problem(db2, Problem(nx, ny), backend=backend,
+                             probe_past_envelope=True,
+                             registry=registry)
+              for nx, ny in shapes]
+    if any(s["measured"] for s in second):
+        failures.append(f"second run re-measured points: {second}")
+    if not all(s["cached"] for s in second):
+        failures.append("second run reported no cached points")
+
+    # The frontier table is derived from the stored entries alone; each
+    # shape's stamped best must appear as a tagged row.
+    table = frontier_table(db2, backend.device_kind)
+    print(table)
+    for nx, ny in shapes:
+        e = db2.entry(backend.device_kind, Problem(nx, ny).key())
+        b = (e or {}).get("best")
+        if not b:
+            failures.append(f"no stored best for {nx}x{ny}")
+            continue
+        want = (f"{b['route']:<5} {b['bm']:>4} {b['tsteps']:>3}")
+        tagged = [ln for ln in table.splitlines()
+                  if "<-- best" in ln and f"{nx}x{ny}:" in ln]
+        if len(tagged) != 1 or want not in tagged[0]:
+            failures.append(
+                f"frontier best row for {nx}x{ny} does not match the "
+                f"stored entry {b}: {tagged}")
+
+    # Determinism: the simulated backend must reproduce the exact
+    # stored rates (a drifting model would silently break resume).
+    probe = Problem(*shapes[-1])
+    e = db2.entry(backend.device_kind, probe.key())
+    for p in e["points"]:
+        if p["status"] != "ok":
+            continue
+        again = measure_candidate(
+            probe, Candidate(p["route"], p["bm"], p["tsteps"]),
+            backend=backend)
+        if again.step_time_s != p["step_time_s"]:
+            failures.append(f"simulated backend non-deterministic at "
+                            f"{p}")
+            break
+
+    summary = {"measured": sum(s["measured"] for s in first),
+               "cached_on_resume": sum(s["cached"] for s in second),
+               "failures": failures}
+    print(f"# selftest: measured {summary['measured']} points, resume "
+          f"cached {summary['cached_on_resume']}, db at {db_path}")
+    for f in failures:
+        print(f"FAIL: {f}", file=sys.stderr)
+    _write_metrics(args, registry, summary)
+    print("selftest " + ("FAILED" if failures else "passed"),
+          flush=True)
+    return 1 if failures else 0
+
+
+def _write_metrics(args, registry, extra) -> None:
+    if registry is None or not args.metrics_out:
+        return
+    from heat2d_tpu.obs.record import build_record
+    record = build_record("tune", extra=dict(extra))
+    registry.write_jsonl(args.metrics_out,
+                         extra_records=[{"event": "run_record",
+                                         **record}])
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    if args.platform:
+        os.environ["JAX_PLATFORMS"] = args.platform
+    registry = None
+    if args.metrics_out:
+        from heat2d_tpu.obs import MetricsRegistry
+        registry = MetricsRegistry()
+    if args.selftest:
+        return run_selftest(args, registry)
+    if args.print_only:
+        db = TuningDB(args.db
+                      or os.environ.get("HEAT2D_TUNE_DB", DEFAULT_DB))
+        backend = SimulatedBackend() if args.simulate else None
+        for kind in (db.device_kinds() or [_device_kind(backend)]):
+            print(frontier_table(db, kind))
+        return 0
+    return run_search(args, registry)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

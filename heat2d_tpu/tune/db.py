@@ -1,0 +1,248 @@
+"""Persistent per-device tuning database.
+
+A small JSON document, keyed three levels deep:
+
+.. code-block:: text
+
+    devices -> <device_kind> -> entries -> <problem key "NXxNY:dtype">
+
+Each entry carries the best measured config, its measured rate, a
+provenance block (protocol, spans, jax version, timestamp), the
+code-version **salt** it was measured under, and the full list of
+measured points (so a resumed search skips completed work and a
+frontier table can be reprinted without re-measuring anything).
+
+Rules the lookup/write paths enforce:
+
+- **Atomic writes** (the ``resil`` idiom): the document is staged to
+  ``path + ".tmp"``, fsync'd, and promoted with ``os.replace`` — a
+  crash mid-save leaves the previous db intact, never a torn file.
+- **Corrupt/torn files are ignored with a warning, not a crash**: a
+  tuning db is an accelerant, and a damaged one must degrade to "no
+  db", bitwise-identical behavior to an absent file.
+- **Code-version salt**: entries are stamped with a hash of the kernel
+  source (``ops/pallas_stencil.py``); lookups and resume ignore entries
+  whose salt no longer matches — a kernel change silently invalidates
+  stale measurements instead of serving them.
+- **Three-tier lookup**: exact problem-key hit -> nearest-shape match
+  (FLAGGED as ``source="nearest"`` with the matched key; callers
+  re-validate it against the resource model) -> ``None`` (callers keep
+  the static heuristic — no behavior cliff when the db is absent).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+import logging
+import math
+import os
+from typing import Optional
+
+log = logging.getLogger("heat2d_tpu.tune")
+
+DB_SCHEMA = "heat2d-tpu/tune-db/v1"
+
+#: Nearest-shape matches further than this log-distance are not
+#: trusted: a 4x shape gap changes which envelope regime applies.
+_NEAREST_MAX_DIST = math.log(4.0)
+
+_salt_cache: Optional[str] = None
+
+
+def current_salt() -> str:
+    """Code-version salt: a short hash of the Pallas kernel source.
+    Entries measured under a different kernel revision are invisible to
+    lookup/resume — the tuned numbers describe code that no longer
+    exists."""
+    global _salt_cache
+    if _salt_cache is None:
+        from heat2d_tpu.ops import pallas_stencil
+        with open(pallas_stencil.__file__, "rb") as f:
+            _salt_cache = hashlib.sha256(f.read()).hexdigest()[:12]
+    return _salt_cache
+
+
+@dataclasses.dataclass(frozen=True)
+class TunedConfig:
+    """A db answer: the config to use plus where it came from.
+    ``source`` is ``"exact"`` or ``"nearest"`` (``matched_key`` then
+    names the entry actually matched)."""
+    route: str
+    bm: int
+    tsteps: int
+    source: str
+    matched_key: str
+    mcells_per_s: Optional[float] = None
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+def _point_key(p: dict) -> tuple:
+    return (p.get("route"), int(p.get("bm", 0)), int(p.get("tsteps", 0)))
+
+
+class TuningDB:
+    """The persistent store. All mutation goes through ``record_point``
+    / ``set_best`` / ``stamp_device`` + an explicit ``save()`` —
+    callers control write frequency (the search saves after every
+    point, so a killed search resumes)."""
+
+    def __init__(self, path: str):
+        self.path = str(path)
+        self.data: dict = {"schema": DB_SCHEMA, "devices": {}}
+        self.corrupt = False
+        self._load()
+
+    # -- persistence --------------------------------------------------- #
+
+    def _load(self) -> None:
+        if not os.path.exists(self.path):
+            return
+        try:
+            with open(self.path) as f:
+                data = json.load(f)
+            if not isinstance(data, dict) or "devices" not in data:
+                raise ValueError("not a tuning db document")
+            if data.get("schema") != DB_SCHEMA:
+                raise ValueError(
+                    f"schema {data.get('schema')!r} != {DB_SCHEMA!r}")
+            self.data = data
+        except (OSError, ValueError, json.JSONDecodeError) as e:
+            # A torn/corrupt db must degrade to "no db", not crash the
+            # run it was meant to speed up.
+            log.warning("ignoring corrupt tuning db %s (%s) — "
+                        "behaving as if no db exists", self.path, e)
+            self.corrupt = True
+
+    def save(self) -> None:
+        """Atomic commit: temp + fsync + os.replace (the resil
+        checkpoint idiom) — a crash mid-save never tears the db.
+        An unreadable original (corrupt db, or a path that was never a
+        tuning db) is moved aside first, not silently destroyed."""
+        if self.corrupt and os.path.exists(self.path):
+            aside = self.path + ".corrupt"
+            os.replace(self.path, aside)
+            log.warning("moved unreadable tuning db aside to %s before "
+                        "writing a fresh one", aside)
+            self.corrupt = False
+        tmp = self.path + ".tmp"
+        d = os.path.dirname(os.path.abspath(self.path))
+        os.makedirs(d, exist_ok=True)
+        with open(tmp, "w") as f:
+            json.dump(self.data, f, indent=1, sort_keys=True)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, self.path)
+
+    # -- structure accessors ------------------------------------------- #
+
+    def device(self, device_kind: str) -> dict:
+        return self.data["devices"].setdefault(
+            device_kind, {"entries": {}})
+
+    def device_kinds(self) -> list:
+        return sorted(self.data["devices"])
+
+    def entry(self, device_kind: str, problem_key: str,
+              salted: bool = True) -> Optional[dict]:
+        """The entry for an exact problem key, or None. ``salted``
+        filters to the current code version (lookup semantics); pass
+        False to read stale entries (export/inspection)."""
+        e = (self.data["devices"].get(device_kind, {})
+             .get("entries", {}).get(problem_key))
+        if e is None:
+            return None
+        if salted and e.get("salt") != current_salt():
+            return None
+        return e
+
+    def stamp_device(self, device_kind: str, **fields) -> None:
+        """Attach device-level facts (e.g. a probed
+        ``vmem_total_bytes``) consumers may apply at load time."""
+        self.device(device_kind).update(fields)
+
+    # -- search bookkeeping -------------------------------------------- #
+
+    def _entry_for_write(self, device_kind: str, problem_key: str) -> dict:
+        entries = self.device(device_kind)["entries"]
+        e = entries.get(problem_key)
+        if e is None or e.get("salt") != current_salt():
+            # A salt change retires the old points wholesale: resuming
+            # onto measurements of dead code would be worse than
+            # starting over.
+            e = entries[problem_key] = {"salt": current_salt(),
+                                        "points": []}
+        return e
+
+    def record_point(self, device_kind: str, problem_key: str,
+                     point: dict) -> None:
+        """Insert-or-replace one measured point (keyed by
+        (route, bm, tsteps))."""
+        e = self._entry_for_write(device_kind, problem_key)
+        k = _point_key(point)
+        e["points"] = [p for p in e["points"] if _point_key(p) != k]
+        e["points"].append(point)
+
+    def measured_keys(self, device_kind: str, problem_key: str,
+                      terminal_statuses) -> set:
+        """(route, bm, tsteps) triples a resumed search may skip."""
+        e = self.entry(device_kind, problem_key)
+        if e is None:
+            return set()
+        return {_point_key(p) for p in e.get("points", [])
+                if p.get("status") in terminal_statuses}
+
+    def set_best(self, device_kind: str, problem_key: str, best: dict,
+                 mcells_per_s: float, provenance: dict) -> None:
+        e = self._entry_for_write(device_kind, problem_key)
+        e["best"] = best
+        e["mcells_per_s"] = mcells_per_s
+        e["provenance"] = provenance
+
+    # -- the lookup ladder --------------------------------------------- #
+
+    def lookup(self, device_kind: str, nx: int, ny: int,
+               dtype: str = "float32") -> Optional[TunedConfig]:
+        """Tier 1: exact (shape, dtype) hit. Tier 2: nearest measured
+        shape of the same dtype within a 4x log-distance, flagged
+        ``source="nearest"`` (row width dominates the distance — the
+        compile envelope is a function of ny, so a same-ny neighbor
+        beats a same-nx one). Tier 3 is the caller's: ``None`` means
+        'use the static heuristic'."""
+        entries = (self.data["devices"].get(device_kind, {})
+                   .get("entries", {}))
+        key = f"{nx}x{ny}:{dtype}"
+        e = self.entry(device_kind, key)
+        if e is not None and e.get("best"):
+            return self._config(e, "exact", key)
+
+        best_k, best_d = None, None
+        for k, cand in entries.items():
+            if cand.get("salt") != current_salt() or not cand.get("best"):
+                continue
+            try:
+                shape, dt = k.split(":")
+                cnx, cny = (int(v) for v in shape.split("x"))
+            except ValueError:
+                continue
+            if dt != dtype:
+                continue
+            d = (2.0 * abs(math.log(cny / ny))
+                 + abs(math.log(cnx / nx)))
+            if d <= _NEAREST_MAX_DIST and (best_d is None or d < best_d):
+                best_k, best_d = k, d
+        if best_k is not None:
+            return self._config(entries[best_k], "nearest", best_k)
+        return None
+
+    @staticmethod
+    def _config(entry: dict, source: str, key: str) -> TunedConfig:
+        b = entry["best"]
+        return TunedConfig(route=b.get("route", "C"),
+                           bm=int(b.get("bm", 0)),
+                           tsteps=int(b.get("tsteps", 0)),
+                           source=source, matched_key=key,
+                           mcells_per_s=entry.get("mcells_per_s"))

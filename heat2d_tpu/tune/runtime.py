@@ -1,0 +1,186 @@
+"""Runtime consultation hook — how tuned configs reach the kernels.
+
+Opt-in, two ways:
+
+- ``HEAT2D_TUNE_DB=/path/to/db.json`` in the environment, or
+- ``set_tuning_db(path_or_db)`` in-process (tests, embedding apps).
+
+With neither, every hook returns ``None`` instantly and the planners
+behave **bitwise-identically** to a build without this subsystem (the
+jaxpr-pinned tests hold that line). With a db, ``band_config`` answers
+the planners' "what bm/T/route here?" question through the db's lookup
+ladder, RE-VALIDATED against the live resource model (a nearest-shape
+or stale-envelope answer must degrade to the heuristic, never to a
+compile OOM), and every applied config is recorded so run records can
+surface ``tuned_config`` provenance.
+
+Loading a db whose device section carries a probed
+``vmem_total_bytes`` stamp also applies it as the VMEM planning budget
+(source ``"db"``) — unless an explicit ``--vmem-budget`` flag or
+``HEAT2D_VMEM_BUDGET`` env override already won.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+from typing import Optional
+
+from heat2d_tpu.tune.db import TunedConfig, TuningDB
+
+log = logging.getLogger("heat2d_tpu.tune")
+
+ENV_VAR = "HEAT2D_TUNE_DB"
+
+_lock = threading.Lock()
+_explicit: Optional[TuningDB] = None
+_explicit_set = False
+#: (env value, loaded db) — re-resolved whenever the env var changes,
+#: so tests (and long-lived processes) can flip it without reloads.
+_env_cache: tuple = (None, None)
+_applied: dict = {}
+
+
+def set_tuning_db(db) -> None:
+    """Install a db explicitly (a ``TuningDB``, a path, or ``None`` to
+    clear back to env-var resolution). Resets applied-config
+    provenance."""
+    global _explicit, _explicit_set, _env_cache
+    with _lock:
+        if db is None:
+            _explicit, _explicit_set = None, False
+        else:
+            _explicit = db if isinstance(db, TuningDB) else TuningDB(db)
+            _explicit_set = True
+            _apply_device_stamps(_explicit)
+        _env_cache = (None, None)
+        _applied.clear()
+
+
+def active_db() -> Optional[TuningDB]:
+    """The db in force, or None (the default — zero cost, zero behavior
+    change)."""
+    global _env_cache
+    if _explicit_set:
+        return _explicit
+    env = os.environ.get(ENV_VAR)
+    if not env:
+        return None
+    with _lock:
+        cached_env, cached_db = _env_cache
+        if cached_env != env:
+            db = TuningDB(env)
+            if db.corrupt and not db.data["devices"]:
+                log.warning("%s=%s is unreadable; tuning disabled for "
+                            "this process", ENV_VAR, env)
+            _apply_device_stamps(db)
+            _env_cache = (env, db)
+            return db
+        return cached_db
+
+
+def _apply_device_stamps(db: TuningDB) -> None:
+    """Device-level stamps: a probed ``vmem_total_bytes`` becomes the
+    planning budget (source \"db\") unless an explicit flag/env
+    override already set one."""
+    from heat2d_tpu.ops import pallas_stencil as ps
+
+    kind = ps._vmem_total()[1]
+    total = db.data["devices"].get(kind, {}).get("vmem_total_bytes")
+    if total and ps.VMEM_BUDGET_BYTES is None \
+            and not os.environ.get("HEAT2D_VMEM_BUDGET"):
+        try:
+            ps.set_vmem_budget(int(total), source="db",
+                               origin="set by the tuning db's probed "
+                                      "vmem stamp")
+        except Exception as e:  # noqa: BLE001 — a bad stamp never fatal
+            log.warning("ignoring tuning-db vmem stamp %r: %s", total, e)
+
+
+def band_config(nrows: int, ny: int, dtype="float32",
+                tsteps_hint: Optional[int] = None,
+                allow_window: bool = True) -> Optional[TunedConfig]:
+    """Tuned (route, bm, T) for a band-kernel problem, or None.
+    ``allow_window=False``: the caller compiles the legacy kernel only
+    (parity step form, legacy-planner consumers), so a C2 answer is
+    relabeled route C before recording — applied-config provenance
+    must describe the program that actually compiles.
+
+    The db's answer is re-validated against the LIVE resource model
+    before it is allowed to steer a plan — a nearest-shape match or an
+    entry probed on other code must fall back to the heuristic rather
+    than hand the compiler an over-envelope window:
+
+    - bm must be 8-aligned with bm > 2T (the sublane/amortization
+      rules);
+    - the working-set estimate must clear the active VMEM hard limit;
+    - a C2 answer must pass ``window_band_viable`` and the probed
+      ext-row envelope — otherwise it DEGRADES to route C with the
+      same (bm, T) when that is itself valid (off-TPU test runs of a
+      TPU-tuned db), else to None.
+    """
+    db = active_db()
+    if db is None:
+        return None
+    import jax.numpy as jnp
+
+    from heat2d_tpu.ops import pallas_stencil as ps
+    from heat2d_tpu.tune.space import band_est_bytes
+
+    dt = jnp.dtype(dtype)
+    kind = ps._vmem_total()[1]
+    cfg = db.lookup(kind, nrows, ny, str(dt))
+    if cfg is None or cfg.route == "vmem":
+        # The vmem route has no runtime knobs — residency routing
+        # already picks it; band planners have nothing to apply.
+        return None
+    bm, t = cfg.bm, cfg.tsteps or ps.DEFAULT_TSTEPS
+    # Validate at the DEEPEST T this answer can end up running under:
+    # _resolve_bands callers apply their own sweep depth (band_multi_step
+    # and the batched ensemble runner default to DEFAULT_TSTEPS), so a
+    # bm validated only against the db's shallower T could fast-fail
+    # _check_band_vmem downstream — a crash cliff where the heuristic
+    # would have planned a fitting band (review r6).
+    t_eff = max(t, tsteps_hint or ps.DEFAULT_TSTEPS)
+    if not bm or bm % 8 or bm <= 2 * t_eff:
+        return None
+    if band_est_bytes(bm, t_eff, ny, dt.itemsize) \
+            > ps.vmem_hard_limit_bytes():
+        return None
+    route = cfg.route
+    if route == "C2":
+        cap = ps._probed_ext_rows(ny * dt.itemsize)
+        if (not allow_window
+                or (cap is not None and bm + 2 * t > cap)
+                or not ps.window_band_viable(ny, bm, t)):
+            route = "C"
+    out = TunedConfig(route=route, bm=bm, tsteps=t, source=cfg.source,
+                      matched_key=cfg.matched_key,
+                      mcells_per_s=cfg.mcells_per_s)
+    _record_applied(nrows, ny, str(dt), out)
+    return out
+
+
+def _record_applied(nrows: int, ny: int, dtype: str,
+                    cfg: TunedConfig) -> None:
+    key = (nrows, ny, dtype)
+    with _lock:
+        if key not in _applied:
+            _applied[key] = {"shape": f"{nrows}x{ny}", "dtype": dtype,
+                             **cfg.to_dict()}
+            log.info("tuned config applied for %dx%d: route=%s bm=%d "
+                     "T=%d (%s via %s)", nrows, ny, cfg.route, cfg.bm,
+                     cfg.tsteps, cfg.source, cfg.matched_key)
+
+
+def applied_configs() -> list:
+    """Every tuned config applied by this process so far (deduped by
+    shape) — the run records' ``tuned_config`` provenance block."""
+    with _lock:
+        return [dict(v) for v in _applied.values()]
+
+
+def reset_applied() -> None:
+    with _lock:
+        _applied.clear()

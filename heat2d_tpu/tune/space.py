@@ -1,0 +1,179 @@
+"""Candidate generation for the kernel search.
+
+A (shape, dtype) problem maps to a list of ``Candidate`` configs over
+the three single-chip kernel routes:
+
+- ``vmem`` — the whole-grid-resident multi-step kernel (kernel A); one
+  candidate, viable only when the grid passes ``fits_vmem``.
+- ``C``    — the legacy gathered-strip temporally-blocked band kernel;
+  knobs (bm, T).
+- ``C2``   — the gather-free window kernel; knobs (bm, T), plus the
+  Mosaic alignment gates (lane-aligned width, 8-aligned bm and T).
+
+The bm grid respects the ``plan_bands`` sublane/padding rules (bm is
+8-aligned, bm > 2T so a band can amortize its halo) and always includes
+the heuristic planners' own picks, so the search can only ever match or
+beat the static policy. Candidates whose estimated working set exceeds
+the VMEM resource model (``_check_band_vmem`` / the probed C2 envelope)
+are pruned BEFORE anything compiles — the search measures the plausible
+frontier, not the compiler's failure modes. Probing past the envelope
+(what ``benchmarks/tune_bands.py`` exists for) is an explicit flag.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax.numpy as jnp
+
+from heat2d_tpu.ops import pallas_stencil as ps
+
+#: The probe ladders the round-3/4 chip campaigns used — the default
+#: search axes (tune_bands.py's grid, now shared).
+DEFAULT_T_LADDER = (4, 8, 12, 16)
+DEFAULT_BM_GRID = (32, 48, 64, 96, 128, 160, 192, 224, 256, 320)
+
+ROUTES = ("vmem", "C", "C2")
+
+
+@dataclasses.dataclass(frozen=True)
+class Problem:
+    """A tuning problem: one single-chip stencil workload shape."""
+    nx: int
+    ny: int
+    dtype: str = "float32"
+
+    def key(self) -> str:
+        """The db problem key — shape and dtype; the route rides in the
+        candidate/entry, not the key (one frontier per shape)."""
+        return f"{self.nx}x{self.ny}:{self.dtype}"
+
+    @property
+    def itemsize(self) -> int:
+        return jnp.dtype(self.dtype).itemsize
+
+    @property
+    def cells(self) -> int:
+        return self.nx * self.ny
+
+    @staticmethod
+    def from_key(key: str) -> "Problem":
+        shape, dtype = key.split(":")
+        nx, ny = shape.split("x")
+        return Problem(int(nx), int(ny), dtype)
+
+
+@dataclasses.dataclass(frozen=True)
+class Candidate:
+    """One point of the search space. ``bm``/``tsteps`` are 0 for the
+    knob-free vmem route (kept integral so the tuple keys JSON/db rows
+    cleanly)."""
+    route: str
+    bm: int = 0
+    tsteps: int = 0
+
+    def label(self) -> str:
+        if self.route == "vmem":
+            return "vmem"
+        return f"{self.route} bm={self.bm} T={self.tsteps}"
+
+
+def band_est_bytes(bm: int, tsteps: int, ny: int, itemsize: int) -> int:
+    """The band kernels' working-set estimate — the same expression
+    ``_check_band_vmem`` fast-fails on (kept in one place so the pruner
+    and the fast-fail can never disagree)."""
+    return 5 * (bm + 2 * tsteps) * ny * itemsize
+
+
+def window_alignment_ok(ny: int, bm: int, tsteps: int) -> bool:
+    """The C2 route's SHAPE gates alone (lane-aligned width, 8-aligned
+    bm and T, an amortizable core) — ``window_band_viable`` minus the
+    live-backend checks, so a simulated search can reason about the
+    window route without a TPU attached."""
+    return (ny % 128 == 0 and bm % 8 == 0 and tsteps % 8 == 0
+            and bm > 2 * tsteps)
+
+
+def route_for(ny: int, bm: int, tsteps: int, force_legacy: bool = False,
+              assume_tpu: bool = False) -> str:
+    """Which kernel a (bm, T) band point actually measures —
+    ``band_chunk`` routes lane-aligned T=8 configs to the C2 window
+    kernel and the rest to legacy C, and an unlabeled table would let
+    C2 numbers masquerade as legacy-C measurements (advisor r4).
+    ``assume_tpu`` judges by the shape gates alone (the simulated
+    backend's view — no real backend consulted)."""
+    if force_legacy:
+        return "C"
+    if assume_tpu:
+        return "C2" if window_alignment_ok(ny, bm, tsteps) else "C"
+    return "C2" if ps.window_band_viable(ny, bm, tsteps) else "C"
+
+
+def candidate_space(problem: Problem, routes=None, bm_grid=None,
+                    t_ladder=None, probe_past_envelope: bool = False,
+                    assume_tpu: bool = False):
+    """(candidates, pruned) for ``problem``.
+
+    ``candidates`` is the measurable list; ``pruned`` is a list of
+    (candidate, reason) dropped by the resource model — surfaced, not
+    silent, so a frontier table can show what was never attempted.
+    ``probe_past_envelope`` keeps resource-model rejects in the
+    candidate list (the envelope-probing harnesses measure exactly
+    those points; the failure class is the datum). ``assume_tpu``
+    judges C2 viability by shape gates alone (the simulated backend's
+    view).
+    """
+    routes = ROUTES if routes is None else tuple(routes)
+    t_ladder = DEFAULT_T_LADDER if t_ladder is None else tuple(t_ladder)
+    bm_grid = DEFAULT_BM_GRID if bm_grid is None else tuple(bm_grid)
+    nx, ny, itemsize = problem.nx, problem.ny, problem.itemsize
+    limit = ps.vmem_hard_limit_bytes()
+
+    cands: list[Candidate] = []
+    pruned: list[tuple[Candidate, str]] = []
+
+    if "vmem" in routes:
+        c = Candidate("vmem")
+        if ps.fits_vmem((nx, ny), jnp.dtype(problem.dtype)):
+            cands.append(c)
+        else:
+            pruned.append((c, "grid exceeds the VMEM residency budget"))
+
+    # Seed the bm axis with the heuristic planners' own picks so the
+    # search result can only match or beat the static policy.
+    bms = set(bm_grid)
+    bms.add(ps.plan_bands(nx, ny, jnp.dtype(problem.dtype))[0])
+    for t in t_ladder:
+        if t % 8 == 0:
+            bms.add(ps.plan_window_band(nx, ny, t,
+                                        jnp.dtype(problem.dtype))[0])
+
+    for t in sorted(t_ladder):
+        for bm in sorted(bms):
+            if bm % 8 or bm <= 2 * t:
+                continue            # sublane rule / no amortizable core
+            est = band_est_bytes(bm, t, ny, itemsize)
+            over = est > limit
+            for route in ("C", "C2"):
+                if route not in routes:
+                    continue
+                c = Candidate(route, bm, t)
+                if route == "C2" and route_for(
+                        ny, bm, t, assume_tpu=assume_tpu) != "C2":
+                    pruned.append((c, "window route not viable "
+                                      "(alignment/backend gates)"))
+                    continue
+                reason = None
+                if over:
+                    reason = (f"est {est / 2**20:.1f} MB over the "
+                              f"{limit / 2**20:.0f} MB VMEM limit")
+                elif route == "C2":
+                    cap = ps._probed_ext_rows(ny * itemsize)
+                    if cap is not None and bm + 2 * t > cap:
+                        reason = (f"{bm + 2 * t} ext rows over the "
+                                  f"probed {cap}-row compile envelope")
+                if reason is None or probe_past_envelope:
+                    cands.append(c)
+                else:
+                    pruned.append((c, reason))
+    return cands, pruned

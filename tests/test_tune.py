@@ -1,0 +1,608 @@
+"""Autotune subsystem tests (heat2d_tpu/tune/, docs/TUNING.md).
+
+The load-bearing guarantees:
+
+- the db lookup ladder: exact hit -> nearest-shape flagged -> None;
+- NO tuning db => the band planners and batched runners trace programs
+  byte-identical to a build without the subsystem (jaxpr-pinned);
+- a db entry present => the tuned (bm, T, route) steers the plan and
+  surfaces in run-record ``tuned_config`` provenance;
+- corrupt/torn/salt-stale dbs degrade to "no db" with a warning, never
+  a crash;
+- probe mode restores the VMEM limit on every exit path;
+- the HEAT2D_VMEM_BUDGET env override and budget-source provenance;
+- the simulated search end to end: db written, resume is a pure cache
+  hit, frontier table matches the stored entries.
+"""
+
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import heat2d_tpu.ops.pallas_stencil as ps
+from heat2d_tpu.tune import runtime as tr
+from heat2d_tpu.tune.cli import frontier_table, search_problem
+from heat2d_tpu.tune.db import TuningDB
+from heat2d_tpu.tune.measure import (SimulatedBackend, classify_failure,
+                                     measure_candidate, probe_limits)
+from heat2d_tpu.tune.space import Candidate, Problem, candidate_space
+
+
+@pytest.fixture(autouse=True)
+def _no_db():
+    """Every test starts and ends with no tuning db active."""
+    tr.set_tuning_db(None)
+    yield
+    tr.set_tuning_db(None)
+
+
+def make_db(path, entries, kind="cpu", salt=None, stamp=None):
+    """A db file with pre-stamped best entries:
+    entries = {"64x64:float32": {"route": "C", "bm": 16, "tsteps": 4,
+               "mcells": 123.0}}"""
+    db = TuningDB(str(path))
+    for key, e in entries.items():
+        db.set_best(kind, key,
+                    {"route": e["route"], "bm": e["bm"],
+                     "tsteps": e["tsteps"]},
+                    e.get("mcells", 100.0), {"protocol": "test"})
+        if salt is not None:
+            db.data["devices"][kind]["entries"][key]["salt"] = salt
+    if stamp:
+        db.stamp_device(kind, **stamp)
+    db.save()
+    return db
+
+
+# --------------------------------------------------------------------- #
+# Candidate space
+# --------------------------------------------------------------------- #
+
+def test_candidate_space_respects_band_rules():
+    cands, pruned = candidate_space(Problem(4096, 4096),
+                                    assume_tpu=True)
+    assert cands, "empty candidate space"
+    for c in cands:
+        if c.route == "vmem":
+            continue
+        assert c.bm % 8 == 0, c            # Mosaic sublane rule
+        assert c.bm > 2 * c.tsteps, c      # amortizable band core
+        if c.route == "C2":
+            assert c.tsteps % 8 == 0, c    # window alignment gate
+    # The resource model pruned something and said why.
+    assert pruned
+    assert all(reason for _, reason in pruned)
+
+
+def test_candidate_space_prunes_over_envelope():
+    cands, pruned = candidate_space(Problem(4096, 8192),
+                                    assume_tpu=True)
+    est_limit = ps.vmem_hard_limit_bytes()
+    for c in cands:
+        if c.route != "vmem":
+            assert 5 * (c.bm + 2 * c.tsteps) * 8192 * 4 <= est_limit
+    # probe_past_envelope keeps the rejects measurable.
+    cands2, _ = candidate_space(Problem(4096, 8192), assume_tpu=True,
+                                probe_past_envelope=True)
+    assert len(cands2) > len(cands)
+
+
+def test_candidate_space_includes_planner_picks():
+    p = Problem(4096, 4096)
+    cands, _ = candidate_space(p, assume_tpu=True)
+    plan_bm = ps.plan_bands(p.nx, p.ny)[0]
+    assert any(c.bm == plan_bm for c in cands if c.route == "C")
+
+
+# --------------------------------------------------------------------- #
+# Measurement library
+# --------------------------------------------------------------------- #
+
+def test_simulated_backend_deterministic_and_classified():
+    b = SimulatedBackend()
+    p = Problem(4096, 4096)
+    ok = measure_candidate(p, Candidate("C2", 144, 16), backend=b)
+    assert ok.status == "ok"
+    assert ok.step_time_s == measure_candidate(
+        p, Candidate("C2", 144, 16), backend=b).step_time_s
+    oom = measure_candidate(p, Candidate("C", 320, 16), backend=b)
+    assert oom.status == "oom"
+    wide = Problem(4096, 8192)         # 32 KB rows: C2 envelope = 64
+    # bm=56, T=8: 72 ext rows — under the working-set limit but over
+    # the probed window envelope, the compile-error class.
+    ce = measure_candidate(wide, Candidate("C2", 56, 8), backend=b)
+    assert ce.status == "compile_error"
+
+
+def test_classify_failure_maps_config_error_to_oom():
+    from heat2d_tpu.config import ConfigError
+    assert classify_failure(ConfigError("needs ~20 MB of VMEM")) == "oom"
+    assert classify_failure(RuntimeError("Mosaic lowering bug")) \
+        == "compile_error"
+    assert classify_failure(RuntimeError("flaky tunnel")) == "error"
+
+
+def test_probe_limits_restores_on_exception():
+    before = (ps.VMEM_HARD_LIMIT_BYTES, ps.VMEM_LIMIT_ORIGIN,
+              ps.VMEM_BUDGET_SOURCE)
+    with pytest.raises(ValueError):
+        with probe_limits("test probe"):
+            assert ps.VMEM_HARD_LIMIT_BYTES == 10 ** 9
+            assert ps.VMEM_BUDGET_SOURCE == "probe"
+            raise ValueError("boom")
+    assert (ps.VMEM_HARD_LIMIT_BYTES, ps.VMEM_LIMIT_ORIGIN,
+            ps.VMEM_BUDGET_SOURCE) == before
+
+
+# --------------------------------------------------------------------- #
+# The db: persistence, corruption, salt
+# --------------------------------------------------------------------- #
+
+def test_db_roundtrip_atomic(tmp_path):
+    path = tmp_path / "db.json"
+    db = TuningDB(str(path))
+    db.record_point("cpu", "64x64:float32",
+                    {"route": "C", "bm": 16, "tsteps": 4,
+                     "status": "ok", "step_time_s": 1e-6,
+                     "mcells_per_s": 100.0})
+    db.set_best("cpu", "64x64:float32",
+                {"route": "C", "bm": 16, "tsteps": 4}, 100.0, {})
+    db.save()
+    assert path.exists()
+    assert not (tmp_path / "db.json.tmp").exists()   # no torn staging
+    again = TuningDB(str(path))
+    assert again.entry("cpu", "64x64:float32")["best"]["bm"] == 16
+
+
+def test_corrupt_db_ignored_with_warning(tmp_path, caplog):
+    path = tmp_path / "db.json"
+    path.write_text("{ torn json!!")
+    with caplog.at_level("WARNING", logger="heat2d_tpu.tune"):
+        db = TuningDB(str(path))
+    assert db.corrupt
+    assert any("corrupt" in r.message for r in caplog.records)
+    assert db.lookup("cpu", 64, 64) is None          # degrades, no crash
+    # And through the runtime hook: active but useless, never fatal.
+    tr.set_tuning_db(db)
+    assert tr.band_config(64, 64) is None
+    # A save against the unreadable file moves the original ASIDE
+    # instead of silently destroying it (it may not be a db at all).
+    db.save()
+    assert (tmp_path / "db.json.corrupt").read_text() == "{ torn json!!"
+    assert TuningDB(str(path)).corrupt is False      # fresh db readable
+
+
+def test_salt_mismatch_invisible(tmp_path):
+    make_db(tmp_path / "db.json",
+            {"64x64:float32": {"route": "C", "bm": 16, "tsteps": 4}},
+            salt="stale-salt")
+    db = TuningDB(str(tmp_path / "db.json"))
+    assert db.entry("cpu", "64x64:float32") is None
+    assert db.lookup("cpu", 64, 64) is None
+    # Unsalted read still sees it (export/inspection path).
+    assert db.entry("cpu", "64x64:float32", salted=False) is not None
+
+
+# --------------------------------------------------------------------- #
+# The lookup ladder
+# --------------------------------------------------------------------- #
+
+def test_lookup_exact_hit(tmp_path):
+    db = make_db(tmp_path / "db.json",
+                 {"64x64:float32": {"route": "C", "bm": 16,
+                                    "tsteps": 4}})
+    cfg = db.lookup("cpu", 64, 64)
+    assert cfg is not None and cfg.source == "exact"
+    assert (cfg.route, cfg.bm, cfg.tsteps) == ("C", 16, 4)
+    assert cfg.matched_key == "64x64:float32"
+
+
+def test_lookup_nearest_is_flagged(tmp_path):
+    db = make_db(tmp_path / "db.json",
+                 {"64x64:float32": {"route": "C", "bm": 16,
+                                    "tsteps": 4}})
+    cfg = db.lookup("cpu", 96, 64)       # same width, nearby height
+    assert cfg is not None
+    assert cfg.source == "nearest"
+    assert cfg.matched_key == "64x64:float32"
+    # Too far away (beyond the 4x log-distance): no match at all.
+    assert db.lookup("cpu", 64, 4096) is None
+    # dtype never crosses.
+    assert db.lookup("cpu", 64, 64, "bfloat16") is None
+
+
+def test_lookup_missing_db_is_none(tmp_path):
+    db = TuningDB(str(tmp_path / "absent.json"))
+    assert db.lookup("cpu", 64, 64) is None
+
+
+# --------------------------------------------------------------------- #
+# Runtime hook: fallback parity and tuned steering
+# --------------------------------------------------------------------- #
+
+def test_resolve_bands_without_db_is_plan_bands():
+    for m, n in ((64, 64), (100, 128), (1000, 512)):
+        assert ps._resolve_bands(m, n, jnp.float32, None) \
+            == ps.plan_bands(m, n, jnp.float32)
+
+
+def test_band_chunk_jaxpr_identical_without_db(monkeypatch):
+    """The acceptance pin: with no tuning db, band_chunk traces the
+    SAME program as a build without the tune subsystem (hook forced
+    off)."""
+    monkeypatch.setattr(ps, "VMEM_BUDGET_BYTES", 256 * 1024)  # band route
+    u = jnp.zeros((64, 128), jnp.float32)
+    with_hook = str(jax.make_jaxpr(
+        lambda v: ps.band_chunk(v, 20, 0.1, 0.1))(u))
+    monkeypatch.setattr(ps, "_tuned_band_config",
+                        lambda *a, **k: None)
+    without = str(jax.make_jaxpr(
+        lambda v: ps.band_chunk(v, 20, 0.1, 0.1))(u))
+    assert with_hook == without
+
+
+def test_batched_band_runner_jaxpr_identical_without_db(monkeypatch):
+    """The serve compile cache's kernel path (ensemble batched band
+    runner) is likewise pinned when no db is active."""
+    from heat2d_tpu.models.ensemble import _run_batch_band
+    monkeypatch.setattr(ps, "VMEM_BUDGET_BYTES", 256 * 1024)
+    u0 = jnp.zeros((2, 64, 128), jnp.float32)
+    cxs = jnp.asarray([0.1, 0.2], jnp.float32)
+    fn = lambda u, a, b: _run_batch_band(u, a, b, steps=10)  # noqa: E731
+    with_hook = str(jax.make_jaxpr(fn)(u0, cxs, cxs))
+    monkeypatch.setattr(ps, "_tuned_band_config",
+                        lambda *a, **k: None)
+    without = str(jax.make_jaxpr(fn)(u0, cxs, cxs))
+    assert with_hook == without
+
+
+def test_db_entry_steers_band_chunk(tmp_path, monkeypatch):
+    """With an entry present the tuned (bm, T) is used — the traced
+    program changes shape — and the result stays bitwise identical
+    (band height never changes values, only scheduling)."""
+    monkeypatch.setattr(ps, "VMEM_BUDGET_BYTES", 256 * 1024)
+    u = jnp.asarray(np.linspace(0, 1, 64 * 128, dtype=np.float32)
+                    .reshape(64, 128))
+    fn = jax.jit(lambda v: ps.band_chunk(v, 20, 0.1, 0.1))
+    base_jaxpr = str(jax.make_jaxpr(fn)(u))
+    base_out = np.asarray(fn(u))
+
+    make_db(tmp_path / "db.json",
+            {"64x128:float32": {"route": "C", "bm": 24, "tsteps": 4}})
+    tr.set_tuning_db(str(tmp_path / "db.json"))
+    tuned = ps._resolve_bands(64, 128, jnp.float32, None)
+    assert tuned == (24, 72)             # tuned bm, ceil-padded rows
+    tuned_jaxpr = str(jax.make_jaxpr(
+        lambda v: ps.band_chunk(v, 20, 0.1, 0.1))(u))
+    assert tuned_jaxpr != base_jaxpr     # the plan actually moved
+    out = np.asarray(jax.jit(
+        lambda v: ps.band_chunk(v, 20, 0.1, 0.1))(u))
+    np.testing.assert_array_equal(out, base_out)
+    # Provenance recorded for run records.
+    applied = tr.applied_configs()
+    assert applied and applied[0]["bm"] == 24
+    assert applied[0]["source"] == "exact"
+
+
+def test_invalid_db_entry_falls_back(tmp_path):
+    """Entries that fail the live resource model degrade to the
+    heuristic: a misaligned bm, and a bm too large for the hard
+    limit."""
+    make_db(tmp_path / "db.json",
+            {"64x128:float32": {"route": "C", "bm": 20, "tsteps": 4},
+             "64x256:float32": {"route": "C", "bm": 99992,
+                                "tsteps": 4},
+             # bm=80 at 32 KB rows fits its own T=4 (~14.4 MB) but NOT
+             # the DEFAULT_TSTEPS=8 its _resolve_bands consumers run
+             # at (~15.7 MB) — must fall back, not crash downstream
+             # _check_band_vmem (review r6).
+             "4096x8192:float32": {"route": "C", "bm": 80,
+                                   "tsteps": 4}})
+    tr.set_tuning_db(str(tmp_path / "db.json"))
+    assert tr.band_config(64, 128) is None         # bm % 8
+    assert tr.band_config(64, 256) is None         # over the limit
+    assert tr.band_config(4096, 8192) is None      # over at caller's T
+    assert ps._resolve_bands(64, 128, jnp.float32, None) \
+        == ps.plan_bands(64, 128, jnp.float32)
+    assert ps._resolve_bands(4096, 8192, jnp.float32, None) \
+        == ps.plan_bands(4096, 8192, jnp.float32)
+
+
+def test_c2_entry_degrades_to_legacy_off_tpu(tmp_path):
+    """A TPU-tuned C2 entry consulted off-TPU (window route not
+    viable) degrades to route C with the same knobs, not to a crash."""
+    make_db(tmp_path / "db.json",
+            {"64x128:float32": {"route": "C2", "bm": 24, "tsteps": 8}})
+    tr.set_tuning_db(str(tmp_path / "db.json"))
+    cfg = tr.band_config(64, 128)
+    assert cfg is not None and cfg.route == "C"
+    assert (cfg.bm, cfg.tsteps) == (24, 8)
+
+
+def test_allow_window_relabels_c2_for_legacy_consumers(tmp_path,
+                                                       monkeypatch):
+    """A legacy-only consumer (parity step form, _resolve_bands) must
+    get — and record — route C even where the window route IS viable:
+    provenance describes the program that actually compiles."""
+    make_db(tmp_path / "db.json",
+            {"64x128:float32": {"route": "C2", "bm": 24, "tsteps": 8}})
+    tr.set_tuning_db(str(tmp_path / "db.json"))
+    monkeypatch.setattr(ps, "window_band_viable",
+                        lambda *a, **k: True)
+    assert tr.band_config(64, 128).route == "C2"
+    tr.reset_applied()
+    cfg = tr.band_config(64, 128, allow_window=False)
+    assert cfg.route == "C" and cfg.bm == 24
+    assert tr.applied_configs()[0]["route"] == "C"
+
+
+def test_env_var_activates_db(tmp_path, monkeypatch):
+    make_db(tmp_path / "db.json",
+            {"64x128:float32": {"route": "C", "bm": 24, "tsteps": 4}})
+    monkeypatch.setenv(tr.ENV_VAR, str(tmp_path / "db.json"))
+    assert tr.active_db() is not None
+    assert tr.band_config(64, 128).bm == 24
+    monkeypatch.delenv(tr.ENV_VAR)
+    assert tr.active_db() is None
+
+
+# --------------------------------------------------------------------- #
+# VMEM budget: env override + source provenance
+# --------------------------------------------------------------------- #
+
+@pytest.fixture
+def _budget_state(monkeypatch):
+    monkeypatch.setattr(ps, "VMEM_BUDGET_BYTES", None)
+    monkeypatch.setattr(ps, "VMEM_HARD_LIMIT_BYTES", None)
+    monkeypatch.setattr(ps, "VMEM_LIMIT_ORIGIN", None)
+    monkeypatch.setattr(ps, "VMEM_BUDGET_SOURCE", "default")
+    monkeypatch.setattr(ps, "_env_budget_checked", False)
+    yield monkeypatch
+
+
+def test_env_vmem_budget_honored(_budget_state):
+    _budget_state.setenv("HEAT2D_VMEM_BUDGET", "32")
+    assert ps.vmem_budget_bytes() == 16 * 1024 * 1024   # total // 2
+    assert ps.vmem_hard_limit_bytes() == 30 * 1024 * 1024
+    assert ps.vmem_budget_source() == "env"
+
+
+def test_env_vmem_budget_bad_value_is_config_error(_budget_state):
+    from heat2d_tpu.config import ConfigError
+    _budget_state.setenv("HEAT2D_VMEM_BUDGET", "not-a-number")
+    with pytest.raises(ConfigError):
+        ps.vmem_budget_bytes()
+    # EVERY query raises — a typo'd cap must not raise once and then
+    # silently serve the default as if the override were applied.
+    with pytest.raises(ConfigError):
+        ps.vmem_budget_bytes()
+
+
+def test_vmem_budget_source_default_and_flag(_budget_state):
+    assert ps.vmem_budget_source() == "default"
+    ps.set_vmem_budget(32 * 1024 * 1024)
+    assert ps.vmem_budget_source() == "flag"
+
+
+def test_probe_limits_with_env_budget(_budget_state):
+    """The env override must not fire MID-probe (un-lifting the limit),
+    and after the probe the env's limit/source must be fully in force."""
+    _budget_state.setenv("HEAT2D_VMEM_BUDGET", "16")
+    with probe_limits("test probe"):
+        # First budget query happens inside the probe window: the hard
+        # limit must stay lifted, not snap to the env-derived 14 MB.
+        assert ps.vmem_hard_limit_bytes() == 10 ** 9
+    assert ps.vmem_hard_limit_bytes() == 14 * 1024 * 1024
+    assert ps.vmem_budget_source() == "env"
+
+
+def test_db_vmem_stamp_applies_as_budget(tmp_path, _budget_state):
+    make_db(tmp_path / "db.json", {},
+            stamp={"vmem_total_bytes": 24 * 1024 * 1024})
+    tr.set_tuning_db(str(tmp_path / "db.json"))
+    assert ps.vmem_budget_bytes() == 12 * 1024 * 1024
+    assert ps.vmem_budget_source() == "db"
+
+
+def test_flag_beats_db_vmem_stamp(tmp_path, _budget_state):
+    ps.set_vmem_budget(32 * 1024 * 1024)
+    make_db(tmp_path / "db.json", {},
+            stamp={"vmem_total_bytes": 24 * 1024 * 1024})
+    tr.set_tuning_db(str(tmp_path / "db.json"))
+    assert ps.vmem_budget_bytes() == 16 * 1024 * 1024
+    assert ps.vmem_budget_source() == "flag"
+
+
+# --------------------------------------------------------------------- #
+# Search end to end (simulated backend)
+# --------------------------------------------------------------------- #
+
+def test_search_resumes_as_pure_cache_hit(tmp_path):
+    backend = SimulatedBackend()
+    path = str(tmp_path / "db.json")
+    import io
+    s1 = search_problem(TuningDB(path), Problem(4096, 4096),
+                        backend=backend, probe_past_envelope=True,
+                        out=io.StringIO())
+    assert s1["measured"] > 0 and s1["best"] is not None
+    assert s1["failed"] > 0              # envelope failures captured
+    s2 = search_problem(TuningDB(path), Problem(4096, 4096),
+                        backend=backend, probe_past_envelope=True,
+                        out=io.StringIO())
+    assert s2["measured"] == 0           # pure cache hit
+    assert s2["cached"] == s1["measured"] + s1["cached"]
+    assert s2["best"] == s1["best"]
+
+
+def test_plain_resume_never_clobbers_probed_measurements(tmp_path):
+    """A plain run after --probe-past-envelope must not overwrite the
+    probe's measured over-envelope points with prune notes."""
+    backend = SimulatedBackend()
+    path = str(tmp_path / "db.json")
+    import io
+    search_problem(TuningDB(path), Problem(4096, 4096),
+                   backend=backend, probe_past_envelope=True,
+                   out=io.StringIO())
+    db = TuningDB(path)
+    before = db.entry(backend.device_kind,
+                      "4096x4096:float32")["points"]
+    assert any(p["status"] == "oom" for p in before)  # rejects measured
+    search_problem(TuningDB(path), Problem(4096, 4096),
+                   backend=backend, out=io.StringIO())  # plain run
+    after = TuningDB(path).entry(backend.device_kind,
+                                 "4096x4096:float32")["points"]
+
+    def by_key(points):
+        return sorted(points, key=lambda p: (p["route"], p["bm"],
+                                             p["tsteps"]))
+    # Not a single point clobbered (re-recording an unchanged prune
+    # note may reorder the list; content is what matters).
+    assert by_key(after) == by_key(before)
+
+
+def test_cli_rejects_bad_env_budget_at_startup(tmp_path, monkeypatch,
+                                               capsys):
+    from heat2d_tpu.cli import main
+    monkeypatch.setenv("HEAT2D_VMEM_BUDGET", "16MiB")
+    monkeypatch.setattr(ps, "_env_budget_checked", False)
+    rc = main(["--mode", "serial", "--nxprob", "8", "--nyprob", "8",
+               "--steps", "2", "--dat-layout", "none",
+               "--outdir", str(tmp_path)])
+    assert rc == 1
+    assert "HEAT2D_VMEM_BUDGET" in capsys.readouterr().err
+    # Nothing ran: no output artifacts were produced.
+    assert not list(tmp_path.iterdir())
+
+
+def test_search_then_lookup_roundtrip(tmp_path):
+    """What the search stamps, the runtime hook serves."""
+    backend = SimulatedBackend()
+    path = str(tmp_path / "db.json")
+    import io
+    s = search_problem(TuningDB(path), Problem(4096, 4096),
+                       backend=backend, out=io.StringIO())
+    db = TuningDB(path)
+    cfg = db.lookup(backend.device_kind, 4096, 4096)
+    assert cfg is not None and cfg.source == "exact"
+    assert cfg.bm == s["best"]["bm"]
+
+
+def test_frontier_table_matches_entries(tmp_path):
+    backend = SimulatedBackend()
+    path = str(tmp_path / "db.json")
+    import io
+    search_problem(TuningDB(path), Problem(640, 512), backend=backend,
+                   out=io.StringIO())
+    db = TuningDB(path)
+    table = frontier_table(db, backend.device_kind)
+    best = db.entry(backend.device_kind, "640x512:float32")["best"]
+    tagged = [ln for ln in table.splitlines() if "<-- best" in ln]
+    assert len(tagged) == 1
+    assert best["route"] in tagged[0]
+
+
+def test_selftest_cli_idempotent(tmp_path, capsys):
+    from heat2d_tpu.tune.cli import main
+    rc = main(["--selftest", "--db", str(tmp_path / "db.json")])
+    out = capsys.readouterr().out
+    assert rc == 0, out
+    assert "selftest passed" in out
+    assert (tmp_path / "db.json").exists()
+    # Idempotent: a second selftest against the same path cold-starts
+    # (its invariants assume a fresh db) instead of failing spuriously.
+    rc2 = main(["--selftest", "--db", str(tmp_path / "db.json")])
+    out2 = capsys.readouterr().out
+    assert rc2 == 0, out2
+
+
+def test_tune_metrics_flow_through_registry(tmp_path):
+    from heat2d_tpu.obs import MetricsRegistry
+    reg = MetricsRegistry()
+    import io
+    search_problem(TuningDB(str(tmp_path / "db.json")),
+                   Problem(640, 512), backend=SimulatedBackend(),
+                   registry=reg, out=io.StringIO())
+    snap = reg.snapshot()
+    measured = [v for k, v in snap["counters"].items()
+                if k.startswith("tune_points_measured_total")]
+    assert measured and sum(measured) > 0
+    assert any(k.startswith("tune_best_mcells_per_s")
+               for k in snap["gauges"])
+    assert "tune_measure_s" in snap["histograms"]
+
+
+# --------------------------------------------------------------------- #
+# Run-record + serve provenance
+# --------------------------------------------------------------------- #
+
+def test_cli_run_record_has_tuned_config(tmp_path, monkeypatch):
+    """Acceptance: a CLI pallas run against a db entry surfaces the
+    tuned config in the run record (and the vmem budget source)."""
+    from heat2d_tpu.cli import main
+    # Small enough that 64x128 is NOT VMEM-resident: the runner takes
+    # the band route, where the tuning hook lives.
+    monkeypatch.setattr(ps, "VMEM_BUDGET_BYTES", 64 * 1024)
+    make_db(tmp_path / "db.json",
+            {"64x128:float32": {"route": "C", "bm": 24, "tsteps": 4}})
+    tr.set_tuning_db(str(tmp_path / "db.json"))
+    rec_path = tmp_path / "rec.json"
+    rc = main(["--mode", "pallas", "--nxprob", "64", "--nyprob", "128",
+               "--steps", "24", "--dat-layout", "none",
+               "--outdir", str(tmp_path),
+               "--run-record", str(rec_path)])
+    assert rc == 0
+    rec = json.loads(rec_path.read_text())
+    assert rec["vmem_budget"]["source"] in ("default", "flag", "env",
+                                            "db", "probe")
+    tuned = rec["tuned_config"]
+    assert tuned and tuned[0]["bm"] == 24 and tuned[0]["route"] == "C"
+    assert tuned[0]["source"] == "exact"
+
+
+def test_cli_run_record_no_db_has_no_tuned_config(tmp_path):
+    from heat2d_tpu.cli import main
+    rec_path = tmp_path / "rec.json"
+    rc = main(["--mode", "serial", "--nxprob", "16", "--nyprob", "16",
+               "--steps", "4", "--dat-layout", "none",
+               "--outdir", str(tmp_path),
+               "--run-record", str(rec_path)])
+    assert rc == 0
+    rec = json.loads(rec_path.read_text())
+    assert "tuned_config" not in rec
+    assert "vmem_budget" in rec
+
+
+def test_serve_engine_preresolves_tuned_config(tmp_path, monkeypatch):
+    """The serve engine resolves the db's answer per signature before
+    the first launch and logs it with every launch row."""
+    from heat2d_tpu.serve.engine import EnsembleEngine
+    from heat2d_tpu.serve.schema import SolveRequest
+    monkeypatch.setattr(ps, "VMEM_BUDGET_BYTES", 1024)  # band method
+    make_db(tmp_path / "db.json",
+            {"24x128:float32": {"route": "C2", "bm": 24, "tsteps": 8}})
+    tr.set_tuning_db(str(tmp_path / "db.json"))
+    eng = EnsembleEngine(max_batch=4)
+    req = SolveRequest(nx=24, ny=128, steps=4, cx=0.1, cy=0.1,
+                       method="band")
+    out = eng.solve_batch([req])
+    assert len(out) == 1
+    row = eng.launch_log[-1]
+    assert row["tuned_config"] is not None
+    assert row["tuned_config"]["bm"] == 24
+    # The batched runner compiles the LEGACY band kernel; the record
+    # reports the route actually in play, even for a C2-stamped entry.
+    assert row["tuned_config"]["route"] == "C"
+    assert eng.tuned[req.signature()]["bm"] == 24
+
+
+def test_serve_engine_tuned_none_without_db(monkeypatch):
+    from heat2d_tpu.serve.engine import EnsembleEngine
+    from heat2d_tpu.serve.schema import SolveRequest
+    eng = EnsembleEngine(max_batch=4)
+    req = SolveRequest(nx=16, ny=24, steps=2, cx=0.1, cy=0.1,
+                       method="jnp")
+    eng.solve_batch([req])
+    assert eng.launch_log[-1]["tuned_config"] is None
