@@ -1,0 +1,40 @@
+"""Fleet subsystem — supervised multi-worker serving with
+chaos-proven failover (ROADMAP item 5).
+
+The reference's ``grad1612_mpi_heat.c`` runs one fixed set of ranks
+and dies whole if any rank dies; a single ``SolveServer`` (PR 2/3) has
+the same blast radius — one process. This package composes the
+existing ingredients (content-hashed requests, admission control,
+chaos harness, circuit breaker, jittered retry) into a pool that
+SURVIVES worker loss under live traffic:
+
+- ``worker``     — one ``SolveServer`` behind a JSONL stdio wire,
+                   heartbeating, chaos-injectable, drain-on-shutdown.
+- ``supervisor`` — spawn/watch/fence/restart N workers: death on
+                   process exit OR heartbeat age, fence before
+                   failover, full-jittered restart backoff.
+- ``router``     — ``FleetServer``: rendezvous routing by compiled
+                   signature, in-flight replay to survivors (dedup'd
+                   by the sha256 content hash — at most a latency
+                   blip, never a lost or duplicated answer), a shared
+                   cross-worker result cache that outlives any worker,
+                   per-tenant quotas/priorities, and the degraded-mode
+                   breaker fed by worker deaths.
+- ``wire``       — the JSONL protocol (per-dispatch ids make late
+                   answers from fenced workers structurally harmless).
+- ``cli``        — ``heat2d-tpu-fleet``: the chaos soak that proves
+                   the composition (kill k of N mid-load; assert
+                   bitwise-correct answers, nothing silently lost,
+                   throughput recovery, clean exit).
+
+Everything here is host-side orchestration: workers run the exact
+serving stack a standalone ``SolveServer`` runs, so fleet answers are
+bitwise the single-process answers (the soak's oracle check).
+"""
+
+from heat2d_tpu.fleet.router import (FleetServer, TenantPolicy,
+                                     route_signature)
+from heat2d_tpu.fleet.supervisor import Supervisor, WorkerGone
+
+__all__ = ["FleetServer", "Supervisor", "TenantPolicy", "WorkerGone",
+           "route_signature"]

@@ -1,0 +1,367 @@
+"""``heat2d-tpu-fleet`` — drive a supervised worker pool, optionally
+under chaos, and prove the fleet invariants from outside.
+
+The soak (``--soak S``) sustains a closed-loop load of ``--concurrency``
+outstanding requests over a rotating set of signatures for S seconds.
+With ``--chaos``, ``--kill K`` workers are hard-killed at the soak's
+midpoint (the supervisor must detect, fail over, and restart them).
+After the load drains, the CLI asserts the chaos-soak acceptance
+criteria and exits nonzero if any fail:
+
+1. **Zero incorrect results** — every distinct request is re-solved by
+   a single-worker ORACLE (an in-process ``SolveServer``) and every
+   fleet response must match it bitwise.
+2. **Nothing silently lost** — submitted == completed + structured
+   ``Rejected`` (and under default sizing, zero rejections).
+3. **Throughput recovers** — after the kill, the completion rate over
+   a sliding window must return to within ``--recovery-margin``
+   (default 20%) of the pre-kill steady state. Recovery is MEASURED,
+   not scheduled: the load keeps running until the bar clears (the
+   time-to-recovery is reported) or 3x the nominal soak elapses
+   (a failure).
+4. **Clean exit** — every worker drains and exits 0 at shutdown.
+
+``--metrics-out`` writes the registry JSONL + a ``kind="fleet"`` run
+record (soak phases, throughput windows, worker deaths/restarts,
+replay counts). CI's ``fleet-soak`` job runs exactly this on CPU with
+3 workers and one mid-load kill.
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import os
+import sys
+import threading
+import time
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="heat2d-tpu-fleet",
+        description="supervised multi-worker serving pool with "
+                    "chaos-proven failover (docs/FLEET.md)")
+    p.add_argument("--workers", type=int, default=3,
+                   help="worker subprocesses in the pool")
+    p.add_argument("--soak", type=float, default=None, metavar="S",
+                   help="run the sustained-load soak for S seconds "
+                        "and assert the fleet invariants")
+    p.add_argument("--chaos", action="store_true",
+                   help="with --soak: hard-kill --kill workers at the "
+                        "soak midpoint (failover + restart must absorb "
+                        "it)")
+    p.add_argument("--kill", type=int, default=1, metavar="K",
+                   help="workers to kill with --chaos (k of N)")
+    p.add_argument("--concurrency", type=int, default=8,
+                   help="outstanding requests in the closed loop")
+    p.add_argument("--nx", type=int, default=16)
+    p.add_argument("--ny", type=int, default=16)
+    p.add_argument("--steps", type=int, default=4)
+    p.add_argument("--signatures", type=int, default=2,
+                   help="distinct compiled signatures in the request "
+                        "mix (steps, steps+1, ...)")
+    p.add_argument("--recovery-margin", type=float, default=0.2,
+                   help="allowed post-restart throughput drop vs the "
+                        "pre-kill window (0.2 = within 20%%)")
+    p.add_argument("--window", type=float, default=None, metavar="S",
+                   help="throughput measurement window (default: a "
+                        "third of the soak)")
+    p.add_argument("--heartbeat-timeout", type=float, default=2.0)
+    p.add_argument("--timeout", type=float, default=60.0,
+                   help="per-request fleet deadline")
+    p.add_argument("--metrics-out", default=None, metavar="PATH",
+                   help="write telemetry JSONL (fleet_* families + the "
+                        "kind='fleet' run record)")
+    p.add_argument("--platform", default=None, choices=["cpu", "tpu"],
+                   help="force a JAX platform for the workers "
+                        "(default cpu: the soak is a logic gate, not a "
+                        "bench)")
+    p.add_argument("--log-level", default=None,
+                   choices=["debug", "info", "warning", "error"])
+    return p
+
+
+def _requests(args, n: int):
+    """The soak's request stream (a generator): ``n`` requests over
+    ``--signatures`` distinct compiled signatures with rotating
+    diffusivities. The pool repeats with period 256 per signature —
+    bounded so the oracle can verify every distinct hash — which is
+    why ``run_soak`` disables every result cache: the repeats must
+    re-solve, or the throughput gate would measure cache service."""
+    from heat2d_tpu.serve.schema import SolveRequest
+    for i in range(n):
+        yield SolveRequest(
+            nx=args.nx, ny=args.ny,
+            steps=args.steps + (i % args.signatures),
+            cx=0.05 + 0.0003 * (i % 256), cy=0.1, method="jnp")
+
+
+def run_soak(args, registry) -> int:
+    from heat2d_tpu.fleet.router import FleetServer
+    from heat2d_tpu.serve.schema import Rejected
+
+    failures = []
+    events = []                 # (t, "completed" | rejected-code)
+    ev_lock = threading.Lock()
+    responses = {}              # content_hash -> result bytes
+    fleet = FleetServer(
+        workers=args.workers, registry=registry,
+        default_timeout=args.timeout,
+        heartbeat_timeout=args.heartbeat_timeout,
+        # ALL result caches are OFF for the soak: the request pool
+        # cycles (bounded so the oracle can verify every distinct
+        # hash), and either the router-side shared cache or the
+        # workers' own LRUs would absorb the repeats — the throughput
+        # windows must measure the SOLVE path the chaos is aimed at,
+        # not cache service (which has its own tests).
+        cache_size=0, worker_cache_size=0,
+        env=({"JAX_PLATFORMS": args.platform} if args.platform
+             else {"JAX_PLATFORMS": "cpu"}))
+    killed = []
+    submitted = 0
+    sem = threading.Semaphore(args.concurrency)
+
+    def on_done(fut, req):
+        import numpy as np
+        now = time.monotonic()
+        try:
+            res = fut.result()
+            with ev_lock:
+                events.append((now, "completed"))
+                responses.setdefault(req.content_hash(),
+                                     np.asarray(res.u).tobytes())
+                if responses[req.content_hash()] != \
+                        np.asarray(res.u).tobytes():
+                    failures.append(
+                        f"divergent responses for {req.content_hash()}")
+        except Rejected as e:
+            with ev_lock:
+                events.append((now, f"rejected_{e.code}"))
+        except Exception as e:  # noqa: BLE001 — a soak reports, always
+            with ev_lock:
+                events.append((now, f"error:{e!r}"))
+        sem.release()
+
+    print(f"# fleet soak: {args.workers} workers, {args.soak:.0f}s, "
+          f"concurrency {args.concurrency}"
+          + (f", killing {args.kill} at midpoint" if args.chaos else ""))
+    with fleet:
+        # Warmup OUTSIDE the measured window: every signature compiles
+        # its padded batch programs on every worker-reachable path, so
+        # the pre-kill window measures steady-state serving, not
+        # compilation (the throughput-recovery gate needs a real
+        # baseline to compare against).
+        warm = [fleet.submit(r) for r in
+                (dataclasses.replace(req, cx=0.9 + 0.0003 * j)
+                 for j, req in enumerate(_requests(
+                     args, args.signatures * max(args.concurrency, 8))))]
+        for f in warm:
+            try:
+                f.result(timeout=args.timeout + 60)
+            except Exception:   # noqa: BLE001 — warmup is best-effort
+                pass
+        t_start = time.monotonic()
+        kill_at = t_start + args.soak / 2 if args.chaos else None
+        window = args.window or max(1.0, args.soak / 3)
+        reqs = iter(_requests(args, 10 ** 9))
+        t_rec = None        # when the fleet was whole-and-warm again
+        pre = post = None   # rps windows
+        t_thr = None        # when throughput was back within margin
+        last_check = 0.0
+        while True:
+            now = time.monotonic()
+            if (killed and t_rec is None
+                    and fleet.sup.deaths >= len(killed)
+                    and fleet.sup.restarts >= len(killed)
+                    and len(fleet.sup.alive_slots()) == args.workers
+                    and not fleet._cold):
+                t_rec = now
+                print(f"# t+{now - t_start:.1f}s: fleet recovered "
+                      f"({args.workers} workers alive and warm)")
+            if (pre is not None and t_thr is None
+                    and now >= kill_at + window   # window all post-kill
+                    and now - last_check >= 0.25):
+                # the recovery probe: completion rate over the sliding
+                # last window, against the pre-kill baseline
+                last_check = now
+                with ev_lock:
+                    r = _rate(events, 0.0, now - window, now)
+                if r >= (1.0 - args.recovery_margin) * pre:
+                    t_thr, post = now, r
+                    print(f"# t+{now - t_start:.1f}s: throughput "
+                          f"recovered ({r:.1f} rps vs {pre:.1f} "
+                          f"pre-kill)")
+            if now - t_start >= args.soak:
+                # "throughput recovered after restart" is measured, not
+                # scheduled: under --chaos the load keeps running until
+                # the sliding window clears the recovery bar (hard-
+                # capped at 3x the nominal soak, reported as a failure)
+                if (not args.chaos
+                        or (t_thr is not None and t_rec is not None)
+                        or now - t_start >= 3 * args.soak):
+                    break
+            if (kill_at is not None and not killed
+                    and now >= kill_at):
+                with ev_lock:
+                    pre = _rate(events, t_start, kill_at - t_start
+                                - window, kill_at - t_start)
+                for k in range(args.kill):
+                    fleet.sup.kill_worker(k)
+                    killed.append(k)
+                print(f"# t+{now - t_start:.1f}s: killed "
+                      f"worker(s) {killed} (pre-kill {pre:.1f} rps)")
+            if not sem.acquire(timeout=0.1):
+                continue
+            req = next(reqs)
+            submitted += 1
+            fleet.submit(req).add_done_callback(
+                lambda f, r=req: on_done(f, r))
+        # drain: wait for every outstanding slot back
+        for _ in range(args.concurrency):
+            sem.acquire(timeout=args.timeout + 30)
+        deaths, restarts = fleet.sup.deaths, fleet.sup.restarts
+        alive = len(fleet.sup.alive_slots())
+        clean = fleet.stop()
+
+    answered = len(events)
+    completed = sum(1 for _t, o in events if o == "completed")
+    rejected = answered - completed
+    if answered != submitted:
+        failures.append(f"silent loss: {submitted} submitted but only "
+                        f"{answered} answered")
+    if completed == 0:
+        failures.append("no request completed")
+    errors = [o for _t, o in events if o.startswith("error:")]
+    if errors:
+        failures.append(f"{len(errors)} unstructured errors, e.g. "
+                        f"{errors[0]}")
+
+    # -- oracle: every distinct request, bitwise ----------------------- #
+    mismatches = _oracle_check(args, responses)
+    if mismatches:
+        failures.append(f"{mismatches} responses differ bitwise from "
+                        f"the single-worker oracle")
+
+    # -- throughput windows -------------------------------------------- #
+    summary = {
+        "workers": args.workers, "soak_s": args.soak,
+        "submitted": submitted, "completed": completed,
+        "rejected": rejected, "distinct": len(responses),
+        "deaths": deaths, "restarts": restarts,
+        "replays": fleet.replays, "alive_at_end": alive,
+        "clean_exit": clean, "killed": killed,
+    }
+    if args.chaos:
+        if post is None:        # never cleared the bar: report the tail
+            t_end = events[-1][0] if events else time.monotonic()
+            post = _rate(events, 0.0, t_end - window, t_end)
+        summary.update(
+            pre_kill_rps=round(pre or 0.0, 2),
+            post_restart_rps=round(post, 2), window_s=window,
+            restart_recovery_s=(None if t_rec is None
+                                else round(t_rec - kill_at, 2)),
+            throughput_recovery_s=(None if t_thr is None
+                                   else round(t_thr - kill_at, 2)))
+        if registry is not None:
+            registry.gauge("fleet_throughput_rps", pre or 0.0,
+                           window="pre_kill")
+            registry.gauge("fleet_throughput_rps", post,
+                           window="post_restart")
+            if t_thr is not None:
+                registry.gauge("fleet_recovery_s", t_thr - kill_at)
+        if not pre:
+            failures.append("no pre-kill steady state measured — the "
+                            "recovery gate would be vacuous (soak too "
+                            "short or workers never warmed)")
+        if t_rec is None:
+            failures.append("fleet never returned to full strength "
+                            "(no recovery point observed)")
+        if deaths < len(killed):
+            failures.append(f"killed {len(killed)} workers but only "
+                            f"{deaths} deaths detected")
+        if restarts < len(killed):
+            failures.append(f"no restart after kill ({restarts} < "
+                            f"{len(killed)})")
+        if pre and t_thr is None:
+            failures.append(
+                f"throughput did not recover within {3 * args.soak:.0f}"
+                f"s: {post:.1f} rps vs {pre:.1f} pre-kill (margin "
+                f"{args.recovery_margin})")
+    if not clean:
+        failures.append("supervisor shutdown was not clean")
+
+    print(f"# soak summary: {json.dumps(summary)}")
+    for f in failures:
+        print(f"FAIL: {f}", file=sys.stderr)
+    _write_metrics(args, registry, dict(summary, failures=failures))
+    print("fleet soak " + ("FAILED" if failures else "passed"))
+    return 1 if failures else 0
+
+
+def _rate(events, t_start: float, lo: float, hi: float) -> float:
+    """Completions per second inside the (lo, hi] soak-relative
+    window."""
+    if hi <= lo:
+        return 0.0
+    n = sum(1 for t, o in events
+            if o == "completed" and lo < t - t_start <= hi)
+    return n / (hi - lo)
+
+
+def _oracle_check(args, responses) -> int:
+    """Re-solve every distinct request on ONE in-process server and
+    count bitwise mismatches against the fleet's answers."""
+    import numpy as np
+
+    from heat2d_tpu.serve.schema import SolveRequest
+    from heat2d_tpu.serve.server import SolveServer
+
+    todo = dict(responses)
+    mismatches = 0
+    with SolveServer(registry=None) as oracle:
+        # regenerate the request stream and solve each distinct hash
+        for req in _requests(args, 10 ** 6):
+            h = req.content_hash()
+            if h not in todo:
+                if not todo:
+                    break
+                continue
+            want = todo.pop(h)
+            got = np.asarray(
+                oracle.solve(req, timeout=120).u).tobytes()
+            if got != want:
+                mismatches += 1
+    return mismatches + len(todo)
+
+
+def _write_metrics(args, registry, extra) -> None:
+    from heat2d_tpu.obs.record import write_run_jsonl
+    write_run_jsonl(registry, args.metrics_out, "fleet", extra)
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    if args.log_level:
+        import logging
+        logging.basicConfig(
+            format="%(asctime)s %(name)s %(levelname)s %(message)s")
+        logging.getLogger("heat2d_tpu").setLevel(
+            getattr(logging, args.log_level.upper()))
+    # The router/oracle process stays on CPU unless told otherwise —
+    # workers get their platform via env (run_soak).
+    os.environ.setdefault("JAX_PLATFORMS", args.platform or "cpu")
+
+    from heat2d_tpu.obs import MetricsRegistry
+    registry = MetricsRegistry()
+    if args.soak is not None:
+        return run_soak(args, registry)
+    print("nothing to do: pass --soak S (optionally --chaos) — the "
+          "fleet embeds in-process via heat2d_tpu.fleet.FleetServer; "
+          "docs/FLEET.md", file=sys.stderr)
+    return 2
+
+
+if __name__ == "__main__":
+    sys.exit(main())

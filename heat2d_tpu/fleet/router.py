@@ -1,0 +1,609 @@
+"""Fleet router — signature-affine distribution with chaos-proven
+failover, a shared content-addressed cache, and tenant quotas.
+
+``FleetServer`` is the fleet's front door: the same ``submit() ->
+Future`` contract as a single ``SolveServer``, served by N worker
+subprocesses behind a ``Supervisor``. The layers, outermost first:
+
+1. **Shared cache + fleet single-flight.** The sha256 content hash is
+   already the distribution key, so one bounded LRU in the router
+   process covers ALL workers: any worker's answer warms every future
+   caller, and the cache survives worker restarts — the fleet's warm
+   state lives above the blast radius of any one process. Identical
+   in-flight requests coalesce fleet-wide onto one dispatch.
+2. **Admission: quotas, capacity, breaker.** Per-tenant
+   ``TenantPolicy`` (max in-flight + priority class: standard tenants
+   shed at the high watermark, ``priority=0`` tenants may use the
+   reserved headroom), a global in-flight cap, and the resil
+   ``DegradedMode`` breaker — worker deaths are its failure signal, so
+   a fleet in a crash loop sheds fresh compute while cache hits keep
+   answering. Cache hits and coalesced followers bypass quota/capacity
+   entirely: they cost no launch, and shedding an answer the fleet
+   already owns is never load shedding.
+3. **Routing.** Rendezvous (highest-random-weight) hashing of the
+   compiled signature over the ALIVE workers: each signature sticks to
+   one worker (its batcher buckets fill, its compile cache stays warm)
+   and a death remaps ONLY the dead worker's share — survivors keep
+   their warm signatures.
+4. **Failover.** Every dispatch is tracked in flight. When the
+   supervisor declares a worker dead, its in-flight requests REPLAY to
+   a survivor under a fresh wire id (at most ``max_replays`` hops,
+   then a structured ``Rejected("worker_lost")``). Solves are
+   deterministic, so a replayed answer is bitwise the answer the dead
+   worker would have given; the single-flight future resolves exactly
+   once, so a client sees at most a latency blip — never a lost or
+   duplicated answer. With no workers alive, requests PARK and flush
+   the moment a restarted worker reports ready; fleet-level deadlines
+   expire both parked and in-flight stragglers into
+   ``Rejected("timeout")``.
+5. **Warm restart.** A RESTARTED worker (never a first spawn) rejoins
+   in two phases: on ``ready`` the router replays the fleet's HOT
+   SIGNATURES to it as warmup events (off the client path), and the
+   slot stays out of routing until the worker reports warm — one
+   compiled program per hot signature; wider batch capacities compile
+   on demand (fleet/worker._warm_signature on why not the full
+   ladder). The compiled-program working set re-warms from the fleet's live
+   state before client requests can stall behind a fully cold worker —
+   the serving analogue of ``resil``'s restart-from-checkpoint (the
+   per-solve checkpoints themselves don't apply at serve timescales;
+   the warm state worth restoring is the compile cache, plus the
+   router-side shared result cache that never died). When every alive
+   worker is still cold (a full-fleet restart), routing falls back to
+   cold workers — a slow answer beats a parked one.
+
+Metric families (docs/FLEET.md has the table): ``fleet_requests_total
+{outcome}``, ``fleet_e2e_latency_s``, ``fleet_cache_*``,
+``fleet_coalesced_total``, ``fleet_inflight`` / ``fleet_parked``
+gauges, ``fleet_quota_rejected_total{tenant}``,
+``fleet_failover_replays_total``, ``fleet_workers_alive``,
+``fleet_worker_deaths_total{cause}``, ``fleet_worker_restarts_total``,
+``fleet_degraded`` / ``fleet_breaker_trips_total``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import logging
+import math
+import random
+import threading
+import time
+from concurrent.futures import Future
+from typing import Dict, List, Optional
+
+from heat2d_tpu.fleet import wire
+from heat2d_tpu.fleet.supervisor import Supervisor, WorkerGone
+from heat2d_tpu.resil.retry import DegradedMode, RetryPolicy
+from heat2d_tpu.serve.cache import ResultCache, SingleFlight
+from heat2d_tpu.serve.schema import Rejected, SolveRequest, SolveResult
+from heat2d_tpu.serve.server import coalesced_future
+from heat2d_tpu.serve.server import failed_future as _failed
+
+log = logging.getLogger("heat2d_tpu.fleet")
+
+#: fraction of global capacity standard-priority tenants may fill; the
+#: headroom above it is reserved for priority-0 (critical) tenants
+HIGH_WATERMARK = 0.8
+
+#: most-recent compiled signatures replayed to a restarted worker as
+#: compile warmup before it takes client traffic
+MAX_HOT_SIGNATURES = 32
+
+
+@dataclasses.dataclass(frozen=True)
+class TenantPolicy:
+    """Admission policy for one tenant. ``priority`` 0 is critical —
+    admitted up to the full global capacity; standard tenants (>= 1)
+    shed once the high watermark is reached, so a burst from a batch
+    tenant cannot starve interactive traffic."""
+
+    max_inflight: int = 64
+    priority: int = 1
+
+    def __post_init__(self):
+        if self.max_inflight < 1:
+            raise ValueError(
+                f"max_inflight must be >= 1, got {self.max_inflight}")
+        if self.priority < 0:
+            raise ValueError(
+                f"priority must be >= 0, got {self.priority}")
+
+
+def route_signature(sig: str, alive: List[int]) -> int:
+    """Rendezvous hashing: the alive worker with the highest
+    hash(sig, worker) wins. Deterministic, coordination-free, and
+    minimally disruptive — removing a worker remaps only the
+    signatures it owned."""
+    if not alive:
+        raise ValueError("no alive workers to route to")
+    return max(alive, key=lambda w: hashlib.sha256(
+        f"{sig}|{w}".encode()).digest())
+
+
+@dataclasses.dataclass
+class _Inflight:
+    """One dispatched request: everything needed to answer it — or to
+    replay it somewhere else. ``warmup`` records belong to a restarted
+    worker's rejoin phase: no client future waits on them, they are
+    never replayed, and their answers are discarded."""
+    key: Optional[str]          # content hash (cache / flight key)
+    sig: str                    # signature string (routing key)
+    tenant: str
+    req_dict: dict
+    t0: float
+    deadline: Optional[float]
+    slot: Optional[int] = None
+    rid: Optional[int] = None
+    replays: int = 0
+    warmup: bool = False
+
+
+class FleetServer:
+    """N supervised workers behind one ``submit()``. See the module
+    docstring for the layer map."""
+
+    def __init__(self, workers: int = 2, *,
+                 max_batch: int = 8, max_delay: float = 0.005,
+                 queue_depth: int = 256, worker_cache_size: int = 256,
+                 worker_timeout: float = 30.0,
+                 cache_size: int = 512,
+                 default_timeout: Optional[float] = 30.0,
+                 max_inflight: int = 256,
+                 quotas: Optional[Dict[str, TenantPolicy]] = None,
+                 default_policy: Optional[TenantPolicy] = None,
+                 max_replays: int = 2,
+                 heartbeat_interval: float = 0.25,
+                 heartbeat_timeout: float = 2.0,
+                 restart_policy: Optional[RetryPolicy] = None,
+                 restart_rng: Optional[random.Random] = None,
+                 max_restarts: Optional[int] = None,
+                 breaker: Optional[DegradedMode] = None,
+                 registry=None, env: Optional[dict] = None,
+                 per_worker_env: Optional[Dict[int, dict]] = None):
+        if registry is None:
+            from heat2d_tpu.obs import get_registry
+            registry = get_registry()
+        self.registry = registry
+        self.default_timeout = default_timeout
+        self.max_inflight = max_inflight
+        self.quotas = dict(quotas or {})
+        #: the unnamed tenant is critical by default: reservations are
+        #: something operators opt INTO by naming lower-priority tenants
+        self.default_policy = (TenantPolicy(max_inflight=max_inflight,
+                                            priority=0)
+                               if default_policy is None
+                               else default_policy)
+        self.max_replays = max_replays
+        self.cache = ResultCache(cache_size, registry=registry,
+                                 prefix="fleet_cache")
+        self.flight = SingleFlight(registry=registry,
+                                   counter="fleet_coalesced_total")
+        self.breaker = (DegradedMode(registry=registry,
+                                     metric_prefix="fleet")
+                        if breaker is None else breaker)
+        self.sup = Supervisor(
+            workers,
+            worker_args=["--max-batch", str(max_batch),
+                         "--max-delay", str(max_delay),
+                         "--queue-depth", str(queue_depth),
+                         "--cache-size", str(worker_cache_size),
+                         "--timeout", str(worker_timeout)],
+            env=env, per_worker_env=per_worker_env,
+            heartbeat_interval=heartbeat_interval,
+            heartbeat_timeout=heartbeat_timeout,
+            restart_policy=restart_policy, restart_rng=restart_rng,
+            max_restarts=max_restarts, registry=registry,
+            on_response=self._on_response,
+            on_worker_lost=self._on_worker_lost,
+            on_worker_ready=self._on_worker_ready,
+            on_tick=self._expire_overdue)
+        self._lock = threading.Lock()
+        self._records: Dict[int, _Inflight] = {}
+        self._parked: List[_Inflight] = []
+        self._next_rid = 0
+        self._tenant_inflight: Dict[str, int] = {}
+        self._total_inflight = 0
+        #: sig -> an example spec dict (the fleet's hot-signature set,
+        #: replayed to restarted workers as compile warmup)
+        self._hot: Dict[str, dict] = {}
+        #: slots that are ready but still warming (not routable unless
+        #: every alive slot is cold)
+        self._cold: set = set()
+        #: slot -> outstanding warmup rids
+        self._warming: Dict[int, set] = {}
+        self._stopped = False
+        self.replays = 0
+
+    # -- lifecycle ----------------------------------------------------- #
+
+    def start(self, wait_ready: bool = True) -> "FleetServer":
+        self._stopped = False
+        self.sup.start(wait_ready=wait_ready)
+        return self
+
+    def stop(self, timeout: float = 30.0) -> bool:
+        """Drain-stop the fleet; True iff every worker exited cleanly.
+        Anything still unanswered afterwards fails with a structured
+        ``Rejected("shutdown")`` — nobody hangs on a dead fleet."""
+        with self._lock:
+            # under the lock: _dispatch's park path checks this flag
+            # under the same lock, so a request either parks before the
+            # sweep below (and is swept) or fails at the park site
+            self._stopped = True
+        clean = self.sup.stop(timeout=timeout)
+        with self._lock:
+            leftovers = [r for r in (list(self._records.values())
+                                     + self._parked) if not r.warmup]
+            self._records.clear()
+            self._parked.clear()
+        for rec in leftovers:
+            self.flight.fail(rec.key, Rejected(
+                "shutdown", "fleet stopping", content_hash=rec.key))
+            self._count("rejected_shutdown")
+        return clean
+
+    def __enter__(self) -> "FleetServer":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    # -- serving ------------------------------------------------------- #
+
+    def submit(self, req: SolveRequest, tenant: str = "default",
+               timeout: Optional[float] = None) -> Future:
+        """Admit one request; the future resolves to a ``SolveResult``
+        or fails with a structured ``Rejected`` (never raises)."""
+        t0 = time.monotonic()
+        timeout = self.default_timeout if timeout is None else timeout
+        try:
+            req.validate()
+        except Rejected as e:
+            self._count("rejected_invalid")
+            return _failed(e)
+        key = req.content_hash()
+
+        hit = self.cache.get(key)
+        if hit is not None:
+            # Served no matter what state the fleet is in: quota,
+            # capacity and the breaker all gate COMPUTE, not answers
+            # the fleet already holds.
+            self._count("cache_hit")
+            self._latency(t0)
+            fut = Future()
+            fut.set_result(dataclasses.replace(
+                hit, cache_hit=True, coalesced=False))
+            return fut
+
+        if self._stopped:
+            # a stopped fleet must answer, not park a request no
+            # worker will ever pick up (cache hits above still serve —
+            # answers the router holds cost nothing)
+            self._count("rejected_shutdown")
+            return _failed(Rejected("shutdown", "fleet is stopped"))
+
+        fut, leader = self.flight.claim(key)
+        if not leader:
+            self._count("coalesced")
+            out = coalesced_future(fut)
+            out.add_done_callback(lambda _f: self._latency(t0))
+            return out
+
+        rej = self._admit(tenant, key)
+        if rej is not None:
+            self.flight.fail(key, rej)
+            fut.add_done_callback(lambda _f: self._latency(t0))
+            return fut
+
+        rec = _Inflight(
+            key=key, sig=str(req.signature()), tenant=tenant,
+            req_dict=req.spec(), t0=t0,
+            deadline=None if timeout is None else t0 + timeout)
+        fut.add_done_callback(lambda _f: self._release(tenant, t0))
+        self._dispatch(rec)
+        return fut
+
+    def solve(self, req: SolveRequest, tenant: str = "default",
+              timeout: Optional[float] = None) -> SolveResult:
+        """Synchronous convenience: submit + wait. Raises ``Rejected``."""
+        wait = self.default_timeout if timeout is None else timeout
+        return self.submit(req, tenant=tenant, timeout=timeout).result(
+            None if wait is None else wait + 60)
+
+    # -- admission ----------------------------------------------------- #
+
+    def _policy(self, tenant: str) -> TenantPolicy:
+        return self.quotas.get(tenant, self.default_policy)
+
+    def _admit(self, tenant: str, key: str) -> Optional[Rejected]:
+        """Reserve capacity for a fresh leader, or explain why not."""
+        pol = self._policy(tenant)
+        watermark = int(math.ceil(HIGH_WATERMARK * self.max_inflight))
+        with self._lock:
+            mine = self._tenant_inflight.get(tenant, 0)
+            if mine >= pol.max_inflight:
+                if self.registry is not None:
+                    self.registry.counter("fleet_quota_rejected_total",
+                                          tenant=tenant)
+                self._count("rejected_quota")
+                return Rejected(
+                    "quota", f"tenant {tenant!r} at its in-flight "
+                    f"limit {pol.max_inflight}", tenant=tenant,
+                    content_hash=key)
+            cap = (self.max_inflight if pol.priority == 0
+                   else watermark)
+            if self._total_inflight >= cap:
+                self._count("rejected_overloaded")
+                return Rejected(
+                    "overloaded",
+                    f"fleet at capacity ({self._total_inflight}/"
+                    f"{self.max_inflight}"
+                    + ("" if pol.priority == 0
+                       else f"; standard-priority watermark "
+                            f"{watermark}") + ")",
+                    tenant=tenant, content_hash=key)
+            if not self.breaker.allow():
+                self._count("rejected_degraded")
+                return Rejected(
+                    "degraded", "fleet is in degraded mode after "
+                    "repeated worker failures: uncached load is shed "
+                    "while workers recover", content_hash=key,
+                    breaker_state=self.breaker.state)
+            self._tenant_inflight[tenant] = mine + 1
+            self._total_inflight += 1
+            self._gauge_inflight_locked()
+        return None
+
+    def _release(self, tenant: str, t0: float) -> None:
+        with self._lock:
+            self._tenant_inflight[tenant] = max(
+                0, self._tenant_inflight.get(tenant, 0) - 1)
+            self._total_inflight = max(0, self._total_inflight - 1)
+            self._gauge_inflight_locked()
+        self._latency(t0)
+
+    # -- dispatch / failover ------------------------------------------- #
+
+    def _routable(self) -> List[int]:
+        """Alive slots minus the still-warming ones — unless ALL alive
+        slots are cold (full-fleet restart): then a cold worker beats
+        parking."""
+        alive = self.sup.alive_slots()
+        with self._lock:
+            warm = [s for s in alive if s not in self._cold]
+        return warm or alive
+
+    def _dispatch(self, rec: _Inflight) -> None:
+        """Route ``rec`` to an alive worker, parking when none exist.
+        A fresh wire id per dispatch: a late answer from a fenced
+        worker can never alias a replay's."""
+        tried = set()
+        while True:
+            alive = set(self.sup.alive_slots())
+            pool = ([rec.slot] if rec.warmup
+                    else [s for s in self._routable()
+                          if s not in tried])
+            pool = [s for s in pool if s in alive]
+            if not pool:
+                if rec.warmup:
+                    return      # its worker died; nothing to warm
+                with self._lock:
+                    stopped = self._stopped
+                    if not stopped:
+                        self._parked.append(rec)
+                        if self.registry is not None:
+                            self.registry.gauge("fleet_parked",
+                                                len(self._parked))
+                if stopped:
+                    # stop()'s sweep may already have run: parking now
+                    # would strand the caller's future forever
+                    self.flight.fail(rec.key, Rejected(
+                        "shutdown", "fleet stopping",
+                        content_hash=rec.key))
+                    self._count("rejected_shutdown")
+                    return
+                log.info("no alive workers: parked request %s…",
+                         rec.key[:12])
+                return
+            slot = route_signature(rec.sig, pool)
+            with self._lock:
+                self._next_rid += 1
+                rid = self._next_rid
+                rec.rid, rec.slot = rid, slot
+                self._records[rid] = rec
+                if not rec.warmup:
+                    # hot-signature set: recency-ordered, bounded
+                    self._hot.pop(rec.sig, None)
+                    self._hot[rec.sig] = rec.req_dict
+                    while len(self._hot) > MAX_HOT_SIGNATURES:
+                        self._hot.pop(next(iter(self._hot)))
+                else:
+                    self._warming.setdefault(slot, set()).add(rid)
+            msg = {"id": rid, "req": rec.req_dict}
+            if rec.warmup:
+                msg["event"] = "warmup"
+            try:
+                self.sup.send(slot, msg)
+                return
+            except WorkerGone:
+                with self._lock:
+                    owned = self._records.pop(rid, None) is not None
+                    if rec.warmup:
+                        self._warming.get(slot, set()).discard(rid)
+                if rec.warmup:
+                    return
+                if not owned:
+                    # a concurrent _on_worker_lost sweep already popped
+                    # this rid and owns the replay — retrying here
+                    # would double-dispatch the request
+                    return
+                tried.add(slot)
+
+    def _on_response(self, slot: int, msg: dict) -> None:
+        with self._lock:
+            rec = self._records.pop(msg.get("id"), None)
+        if rec is None:
+            return      # late line from a fenced worker, or a replayed
+            #             request already answered — dropped by design
+        if rec.warmup:
+            self._warmup_done(rec)
+            return
+        if msg.get("ok"):
+            try:
+                res = wire.decode_result(msg)
+            except (KeyError, ValueError) as e:
+                self.flight.fail(rec.key, Rejected(
+                    "error", f"undecodable worker response: {e!r}",
+                    content_hash=rec.key))
+                self._count("error")
+                return
+            self.cache.put(rec.key, res)
+            self.flight.resolve(rec.key, res)
+            self.breaker.record_success()
+            self._count("completed")
+        else:
+            # A structured worker-side rejection is an ANSWER (queue
+            # full, watchdog timeout...), not a fleet fault: it must
+            # not feed the breaker.
+            exc = wire.decode_rejection(msg)
+            self.flight.fail(rec.key, exc)
+            self._count("rejected_" + exc.code)
+
+    def _on_worker_lost(self, slot: int) -> None:
+        with self._lock:
+            lost = [r for r in self._records.values()
+                    if r.slot == slot]
+            for r in lost:
+                self._records.pop(r.rid, None)
+            # a dying warmup is moot — the replacement re-warms
+            self._warming.pop(slot, None)
+            self._cold.discard(slot)
+            lost = [r for r in lost if not r.warmup]
+        self.breaker.record_failure()
+        if not lost:
+            return
+        log.warning("worker %d died with %d request(s) in flight; "
+                    "replaying to survivors", slot, len(lost))
+        for rec in lost:
+            rec.replays += 1
+            self.replays += 1
+            if self.registry is not None:
+                self.registry.counter("fleet_failover_replays_total")
+            if rec.replays > self.max_replays:
+                self.flight.fail(rec.key, Rejected(
+                    "worker_lost",
+                    f"request lost {rec.replays} workers (limit "
+                    f"{self.max_replays} replays)",
+                    content_hash=rec.key))
+                self._count("rejected_worker_lost")
+            else:
+                self._dispatch(rec)
+
+    def _on_worker_ready(self, slot: int,
+                         restarted: bool = False) -> None:
+        if restarted:
+            # only REPLACEMENTS warm-gate: a first spawn at fleet start
+            # has no hot set worth waiting for, and gating it would
+            # race the first client dispatches
+            self._begin_warmup(slot)
+        self._flush_parked()
+
+    def _begin_warmup(self, slot: int) -> None:
+        """Two-phase rejoin: replay the hot-signature set to the fresh
+        worker (compile warmup, off the client path) and keep the slot
+        out of routing until the last warmup answer lands."""
+        now = time.monotonic()
+        with self._lock:
+            hot = list(self._hot.items())
+        if not hot:
+            return              # nothing to warm (fleet start)
+        with self._lock:
+            self._cold.add(slot)
+            # the -1 sentinel holds the set non-empty until every
+            # warmup dispatch below has registered (else an early
+            # answer could mark the slot warm mid-enqueue)
+            self._warming[slot] = {-1}
+        if self.registry is not None:
+            self.registry.counter("fleet_worker_warmups_total")
+        log.info("worker %d warming %d hot signature(s) before "
+                 "rejoining the routing set", slot, len(hot))
+        for sig, spec in hot:
+            # one warmup per signature: the WORKER walks the padded-
+            # capacity ladder itself (fleet/worker._warm_signature)
+            self._dispatch(_Inflight(
+                key=None, sig=sig, tenant="_warmup",
+                req_dict=dict(spec), t0=now,
+                deadline=now + (self.default_timeout or 60.0),
+                slot=slot, warmup=True))
+        done = _Inflight(key=None, sig="", tenant="_warmup",
+                         req_dict={}, t0=now, deadline=None,
+                         slot=slot, rid=-1, warmup=True)
+        self._warmup_done(done)     # release the enqueue sentinel
+
+    def _warmup_done(self, rec: _Inflight) -> None:
+        with self._lock:
+            pend = self._warming.get(rec.slot)
+            if pend is not None:
+                pend.discard(rec.rid)
+                if pend:
+                    return
+                self._warming.pop(rec.slot, None)
+            self._cold.discard(rec.slot)
+        log.info("worker %d warm — rejoining the routing set",
+                 rec.slot)
+
+    def _flush_parked(self) -> None:
+        with self._lock:
+            parked, self._parked = self._parked, []
+            if self.registry is not None:
+                self.registry.gauge("fleet_parked", 0)
+        for rec in parked:
+            self._dispatch(rec)
+
+    def _expire_overdue(self) -> None:
+        """Monitor-tick sweep: fleet-level deadlines bound parked AND
+        in-flight requests, whatever state the workers are in."""
+        now = time.monotonic()
+        overdue = []
+        with self._lock:
+            for rid in [rid for rid, r in self._records.items()
+                        if r.deadline is not None
+                        and r.deadline <= now]:
+                overdue.append(self._records.pop(rid))
+            keep = []
+            for r in self._parked:
+                (overdue if r.deadline is not None
+                 and r.deadline <= now else keep).append(r)
+            self._parked = keep
+        for rec in overdue:
+            if rec.warmup:
+                # an overdue warmup must not wedge the slot cold
+                self._warmup_done(rec)
+                continue
+            self.flight.fail(rec.key, Rejected(
+                "timeout", "request exceeded its fleet deadline",
+                content_hash=rec.key,
+                waited_s=round(now - rec.t0, 6)))
+            self._count("rejected_timeout")
+        # Parked work re-dispatches on any tick with a live worker —
+        # belt-and-braces for the park-vs-ready race where a request
+        # parks just after the ready flush swept the list.
+        if self._parked and self.sup.alive_slots():
+            self._flush_parked()
+
+    # -- metrics ------------------------------------------------------- #
+
+    def _count(self, outcome: str) -> None:
+        if self.registry is not None:
+            self.registry.counter("fleet_requests_total",
+                                  outcome=outcome)
+
+    def _latency(self, t0: float) -> None:
+        if self.registry is not None:
+            self.registry.observe("fleet_e2e_latency_s",
+                                  time.monotonic() - t0)
+
+    def _gauge_inflight_locked(self) -> None:
+        if self.registry is not None:
+            self.registry.gauge("fleet_inflight", self._total_inflight)

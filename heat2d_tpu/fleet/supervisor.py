@@ -1,0 +1,392 @@
+"""Worker-pool supervisor — spawn, watch, fence, restart.
+
+Owns N worker subprocesses (``fleet/worker.py``) and nothing about
+requests: the router above it keeps the in-flight bookkeeping, the
+supervisor keeps the PROCESSES — spawn with per-slot env (chaos
+campaigns aim at individual workers), read their stdout on a thread
+per worker, and run one monitor loop that declares a worker dead on
+either signal:
+
+- **process exit** (``poll()`` — a crash, an injected
+  ``os._exit(137)``);
+- **heartbeat age** (no line from the worker within
+  ``heartbeat_timeout`` — catches the gray failure where the process
+  is alive but silent: a dropped-heartbeat campaign, a wedged
+  runtime, a stop-the-world hang).
+
+Declaring death FENCES first: the process is killed before the router
+hears ``on_worker_lost``, so a half-dead worker cannot race its
+replacement with late answers (the wire's per-dispatch ids make such
+lines harmless anyway — fencing just keeps the property structural).
+
+Restarts are automatic with FULL-JITTERED capped exponential backoff
+(``resil.retry.RetryPolicy(jitter=True)``): N workers killed by the
+same fault come back decorrelated instead of thundering-herding the
+same signature. The attempt counter resets when a replacement reports
+ready, so a stable worker earns back a fast restart.
+
+The supervisor's shutdown is graceful end to end: each worker gets a
+``shutdown`` line (drain: in-flight answers flush before exit 0), then
+an escalating terminate/kill for stragglers. ``stop()`` returns True
+iff every CURRENT worker exited cleanly — the chaos soak's "clean
+supervisor exit" assertion.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import random
+import subprocess
+import sys
+import threading
+import time
+from typing import Callable, Dict, List, Optional
+
+from heat2d_tpu.resil.retry import RetryPolicy
+
+log = logging.getLogger("heat2d_tpu.fleet")
+
+#: default jittered backoff for worker restarts (docstring above)
+DEFAULT_RESTART_POLICY = RetryPolicy(max_attempts=1000, base_delay=0.2,
+                                     backoff=2.0, max_delay=5.0,
+                                     jitter=True)
+
+
+class WorkerGone(RuntimeError):
+    """Raised by ``send`` when the target worker's pipe is gone; the
+    router treats it like a death it just hasn't been told about yet."""
+
+
+class WorkerHandle:
+    """One live worker process + its liveness state."""
+
+    def __init__(self, slot: int, proc: subprocess.Popen):
+        self.slot = slot
+        self.proc = proc
+        self.spawned = time.monotonic()
+        self.last_seen = self.spawned
+        self.ready = False
+        self.dead = False
+        self.restarted = False      # a replacement, not a first spawn
+        self.write_lock = threading.Lock()
+
+    def pid(self) -> int:
+        return self.proc.pid
+
+
+class Supervisor:
+    """Spawn/watch/restart N fleet workers. See the module docstring
+    for the failure model; the router wires the three callbacks."""
+
+    def __init__(self, workers: int, *,
+                 worker_args: Optional[List[str]] = None,
+                 env: Optional[dict] = None,
+                 per_worker_env: Optional[Dict[int, dict]] = None,
+                 heartbeat_interval: float = 0.25,
+                 heartbeat_timeout: float = 2.0,
+                 ready_timeout: float = 60.0,
+                 restart_policy: Optional[RetryPolicy] = None,
+                 restart_rng: Optional[random.Random] = None,
+                 max_restarts: Optional[int] = None,
+                 registry=None,
+                 on_response: Optional[Callable[[int, dict], None]] = None,
+                 on_worker_lost: Optional[Callable[[int], None]] = None,
+                 on_worker_ready: Optional[Callable[[int], None]] = None,
+                 on_tick: Optional[Callable[[], None]] = None):
+        if workers < 1:
+            raise ValueError(f"workers must be >= 1, got {workers}")
+        self.n = workers
+        self.worker_args = list(worker_args or [])
+        self.env = dict(env or {})
+        self.per_worker_env = {int(k): dict(v) for k, v in
+                               (per_worker_env or {}).items()}
+        self.heartbeat_interval = heartbeat_interval
+        self.heartbeat_timeout = heartbeat_timeout
+        self.ready_timeout = ready_timeout
+        self.restart_policy = (DEFAULT_RESTART_POLICY
+                               if restart_policy is None
+                               else restart_policy)
+        self.restart_rng = restart_rng
+        self.max_restarts = max_restarts
+        self.registry = registry
+        self.on_response = on_response
+        self.on_worker_lost = on_worker_lost
+        self.on_worker_ready = on_worker_ready
+        self.on_tick = on_tick
+
+        self._lock = threading.Lock()
+        self._handles: List[Optional[WorkerHandle]] = [None] * workers
+        self._attempts = [0] * workers       # consecutive failed spawns
+        self._restart_at = [None] * workers  # due time while slot dead
+        self._spawn_counts = [0] * workers   # generations per slot
+        self._stop = threading.Event()
+        self._monitor: Optional[threading.Thread] = None
+        self.restarts = 0
+        self.deaths = 0
+
+    # -- lifecycle ----------------------------------------------------- #
+
+    def start(self, wait_ready: bool = True) -> "Supervisor":
+        self._stop.clear()      # stop()/start() cycles must re-arm
+        #                         the monitor, not leave it stillborn
+        for slot in range(self.n):
+            self._spawn(slot)
+        self._monitor = threading.Thread(target=self._monitor_loop,
+                                         name="heat2d-fleet-monitor",
+                                         daemon=True)
+        self._monitor.start()
+        if wait_ready:
+            deadline = time.monotonic() + self.ready_timeout
+            while time.monotonic() < deadline:
+                if all(h is not None and h.ready
+                       for h in self._handles):
+                    break
+                time.sleep(0.01)
+        return self
+
+    def stop(self, timeout: float = 30.0) -> bool:
+        """Drain-shutdown every worker; True iff all exited 0."""
+        self._stop.set()
+        if self._monitor is not None:
+            self._monitor.join(timeout=10)
+        clean = True
+        with self._lock:
+            # dead handles awaiting restart were already reaped by the
+            # death path; cleanliness is about the CURRENT workers
+            handles = [h for h in self._handles
+                       if h is not None and not h.dead]
+            self._handles = [None] * self.n
+        for h in handles:
+            try:
+                self._write(h, {"event": "shutdown"})
+            except WorkerGone:
+                pass
+        deadline = time.monotonic() + timeout
+        for h in handles:
+            left = max(0.1, deadline - time.monotonic())
+            try:
+                rc = h.proc.wait(timeout=left)
+            except subprocess.TimeoutExpired:
+                log.warning("worker %d did not drain in time; killing",
+                            h.slot)
+                h.proc.kill()
+                h.proc.wait(timeout=10)
+                rc = None
+            if rc != 0:
+                clean = False
+            self._close_pipes(h)
+        self._gauge_alive()
+        return clean
+
+    def __enter__(self) -> "Supervisor":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    # -- the router's surface ------------------------------------------ #
+
+    def alive_slots(self) -> List[int]:
+        with self._lock:
+            return [h.slot for h in self._handles
+                    if h is not None and h.ready and not h.dead]
+
+    def send(self, slot: int, obj: dict) -> None:
+        with self._lock:
+            h = self._handles[slot]
+        if h is None or h.dead:
+            raise WorkerGone(f"worker {slot} is not running")
+        self._write(h, obj)
+
+    def kill_worker(self, slot: int) -> None:
+        """Hard-kill a worker (the chaos soak's mid-load kill). The
+        monitor detects the exit and runs the normal death path."""
+        with self._lock:
+            h = self._handles[slot]
+        if h is not None:
+            log.warning("chaos: hard-killing worker %d (pid %d)",
+                        slot, h.pid())
+            h.proc.kill()
+
+    # -- spawn / death / restart --------------------------------------- #
+
+    def _worker_cmd(self, slot: int) -> List[str]:
+        return [sys.executable, "-m", "heat2d_tpu.fleet.worker",
+                "--worker-id", str(slot),
+                "--heartbeat", str(self.heartbeat_interval),
+                *self.worker_args]
+
+    def _worker_env(self, slot: int) -> dict:
+        import heat2d_tpu
+        env = dict(os.environ)
+        # the worker must import this heat2d_tpu regardless of cwd or
+        # whether the package is pip-installed in the child's env
+        pkg_root = os.path.dirname(os.path.dirname(
+            os.path.abspath(heat2d_tpu.__file__)))
+        env["PYTHONPATH"] = pkg_root + (
+            os.pathsep + env["PYTHONPATH"] if env.get("PYTHONPATH")
+            else "")
+        env.update(self.env)
+        env.update(self.per_worker_env.get(slot, {}))
+        return env
+
+    def _spawn(self, slot: int) -> None:
+        proc = subprocess.Popen(
+            self._worker_cmd(slot), stdin=subprocess.PIPE,
+            stdout=subprocess.PIPE, stderr=None,  # stderr passes through
+            env=self._worker_env(slot), text=True, bufsize=1)
+        h = WorkerHandle(slot, proc)
+        with self._lock:
+            self._handles[slot] = h
+            self._restart_at[slot] = None
+            self._spawn_counts[slot] += 1
+            h.restarted = self._spawn_counts[slot] > 1
+        threading.Thread(target=self._read_loop, args=(h,),
+                         name=f"heat2d-fleet-reader-{slot}",
+                         daemon=True).start()
+        log.info("spawned worker %d (pid %d)", slot, proc.pid)
+
+    def _read_loop(self, h: WorkerHandle) -> None:
+        try:
+            for line in h.proc.stdout:
+                h.last_seen = time.monotonic()
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    msg = json.loads(line)
+                except ValueError:
+                    continue        # torn line from a killed worker
+                ev = msg.get("event")
+                if ev == "ready":
+                    h.ready = True
+                    with self._lock:
+                        self._attempts[h.slot] = 0
+                    self._gauge_alive()
+                    log.info("worker %d ready (pid %d%s)", h.slot,
+                             h.pid(),
+                             ", restart" if h.restarted else "")
+                    if self.on_worker_ready is not None:
+                        self.on_worker_ready(h.slot,
+                                             restarted=h.restarted)
+                elif ev == "hb":
+                    pass            # last_seen update above is the point
+                elif "id" in msg and self.on_response is not None:
+                    self.on_response(h.slot, msg)
+        except (OSError, ValueError):
+            pass                    # pipe torn down under the reader
+        # EOF: the process is exiting; the monitor loop reaps it.
+
+    def _write(self, h: WorkerHandle, obj: dict) -> None:
+        try:
+            with h.write_lock:
+                h.proc.stdin.write(json.dumps(obj) + "\n")
+                h.proc.stdin.flush()
+        except (BrokenPipeError, OSError, ValueError) as e:
+            raise WorkerGone(f"worker {h.slot}: {e!r}") from None
+
+    def _close_pipes(self, h: WorkerHandle) -> None:
+        for f in (h.proc.stdin, h.proc.stdout):
+            try:
+                if f is not None:
+                    f.close()
+            except OSError:
+                pass
+
+    def _monitor_loop(self) -> None:
+        poll = max(0.02, min(self.heartbeat_timeout / 4, 0.2))
+        while not self._stop.wait(poll):
+            now = time.monotonic()
+            for slot in range(self.n):
+                try:
+                    self._monitor_slot(slot, now)
+                except Exception:
+                    # the monitor IS the fleet's failure detector: a
+                    # transient here (Popen EAGAIN, a broken callback)
+                    # must not kill supervision for every worker
+                    log.exception("monitor pass failed for slot %d",
+                                  slot)
+            if self.on_tick is not None:
+                try:
+                    self.on_tick()
+                except Exception:
+                    log.exception("on_tick callback failed")
+
+    def _monitor_slot(self, slot: int, now: float) -> None:
+        with self._lock:
+            h = self._handles[slot]
+            due = self._restart_at[slot]
+        if h is None or h.dead:
+            if (due is not None and now >= due
+                    and not self._stop.is_set()):
+                self._restart(slot)
+            return
+        if h.proc.poll() is not None:
+            self._declare_dead(h, "exit")
+        elif (h.ready
+              and now - h.last_seen > self.heartbeat_timeout):
+            self._declare_dead(h, "heartbeat")
+        elif (not h.ready
+              and now - h.spawned > self.ready_timeout):
+            self._declare_dead(h, "spawn_timeout")
+
+    def _declare_dead(self, h: WorkerHandle, cause: str) -> None:
+        rc = h.proc.poll()
+        log.warning("worker %d declared dead (%s, rc=%s)", h.slot,
+                    cause, rc)
+        h.dead = True
+        self.deaths += 1
+        # FENCE before failover: a heartbeat-silent worker is still
+        # serving — kill it so it cannot answer after its in-flight
+        # work is replayed elsewhere.
+        try:
+            h.proc.kill()
+            h.proc.wait(timeout=10)
+        except (OSError, subprocess.TimeoutExpired):
+            pass
+        self._close_pipes(h)
+        if self.registry is not None:
+            self.registry.counter("fleet_worker_deaths_total",
+                                  cause=cause)
+        self._gauge_alive()
+        with self._lock:
+            attempt = self._attempts[h.slot]
+            self._attempts[h.slot] += 1
+            exhausted = (self.max_restarts is not None
+                         and self._attempts[h.slot] > self.max_restarts)
+            self._restart_at[h.slot] = (
+                None if exhausted
+                else time.monotonic() + self.restart_policy.delay(
+                    attempt, rng=self.restart_rng))
+        if exhausted:
+            log.error("worker %d: restart budget exhausted (%d); "
+                      "slot stays down", h.slot, self.max_restarts)
+        if self.on_worker_lost is not None:
+            self.on_worker_lost(h.slot)
+
+    def _restart(self, slot: int) -> None:
+        self.restarts += 1
+        if self.registry is not None:
+            self.registry.counter("fleet_worker_restarts_total")
+        log.info("restarting worker %d (restart #%d)", slot,
+                 self.restarts)
+        try:
+            self._spawn(slot)
+        except Exception:
+            # Popen can fail transiently (fork EAGAIN, fd exhaustion);
+            # back off instead of hot-looping a failing spawn
+            with self._lock:
+                attempt = self._attempts[slot]
+                self._attempts[slot] += 1
+                self._restart_at[slot] = (
+                    time.monotonic() + self.restart_policy.delay(
+                        attempt, rng=self.restart_rng))
+            log.exception("respawn of worker %d failed; retrying "
+                          "with backoff", slot)
+
+    def _gauge_alive(self) -> None:
+        if self.registry is not None:
+            self.registry.gauge("fleet_workers_alive",
+                                len(self.alive_slots()))

@@ -1,0 +1,76 @@
+"""Fleet wire protocol — JSON lines over a worker's stdio pipe.
+
+One JSON object per line, both directions. The supervisor writes
+request envelopes and control events to a worker's stdin; the worker
+writes responses and liveness events to stdout. Grids travel base64 —
+the payloads are small final states (a few KB for the serving grid
+sizes), and a text protocol keeps the framing trivially debuggable
+(``strace``/log-tail shows complete messages) and crash-safe: a worker
+killed mid-line leaves one torn line the reader skips, never a
+desynchronized binary stream.
+
+supervisor -> worker::
+
+    {"id": 7, "req": {...SolveRequest.spec()...}}
+    {"event": "shutdown"}              # drain and exit 0
+
+worker -> supervisor::
+
+    {"event": "ready", "pid": 1234, "worker": 0}
+    {"event": "hb", "worker": 0}       # periodic heartbeat
+    {"id": 7, "ok": true,  ...encode_result fields...}
+    {"id": 7, "ok": false, "rejected": {...Rejected.to_record()...}}
+
+``id`` is the supervisor's in-flight key: it is unique per DISPATCH
+(a replayed request gets a fresh id), so a late line from a fenced
+worker can never be confused with the replay's answer.
+"""
+
+from __future__ import annotations
+
+import base64
+
+from heat2d_tpu.serve.schema import Rejected, SolveResult
+
+PROTOCOL = "heat2d-tpu/fleet-wire/v1"
+
+
+def encode_result(rid: int, res: SolveResult) -> dict:
+    import numpy as np
+    u = np.ascontiguousarray(np.asarray(res.u))
+    return {
+        "id": rid, "ok": True,
+        "steps_done": int(res.steps_done),
+        "content_hash": res.content_hash,
+        "batch_size": int(res.batch_size),
+        "worker_cache_hit": bool(res.cache_hit),
+        "u_shape": [int(d) for d in u.shape],
+        "u_dtype": str(u.dtype),
+        "u_b64": base64.b64encode(u.tobytes()).decode("ascii"),
+    }
+
+
+def decode_result(msg: dict) -> SolveResult:
+    """The worker's answer as a ``SolveResult``. ``u`` is a read-only
+    numpy view over the decoded bytes — results are immutable by
+    contract (the fleet cache shares them across callers)."""
+    import numpy as np
+    u = np.frombuffer(base64.b64decode(msg["u_b64"]),
+                      dtype=msg["u_dtype"]).reshape(msg["u_shape"])
+    return SolveResult(u=u, steps_done=int(msg["steps_done"]),
+                       content_hash=msg["content_hash"],
+                       batch_size=int(msg.get("batch_size", 1)))
+
+
+def encode_rejection(rid: int, exc: BaseException) -> dict:
+    if isinstance(exc, Rejected):
+        return {"id": rid, "ok": False, "rejected": exc.to_record()}
+    return {"id": rid, "ok": False,
+            "rejected": {"rejected": "error", "message": repr(exc)}}
+
+
+def decode_rejection(msg: dict) -> Rejected:
+    d = dict(msg.get("rejected") or {})
+    code = d.pop("rejected", "error")
+    message = d.pop("message", "")
+    return Rejected(code, message, **d)

@@ -1,0 +1,174 @@
+"""Fleet worker — one ``SolveServer`` behind a stdio wire.
+
+Runs as a subprocess of the fleet supervisor (``python -m
+heat2d_tpu.fleet.worker``): a full serving stack (micro-batcher,
+worker-local result cache, retry/watchdog/breaker) whose front door is
+the JSONL protocol in ``fleet/wire.py`` instead of an in-process
+``submit()``. The worker is deliberately BORING: all fleet policy —
+routing, failover, cross-worker dedup, quotas — lives in the
+supervisor/router process; a worker just serves what it is handed and
+proves it is alive.
+
+Liveness: a daemon thread heartbeats every ``--heartbeat`` seconds.
+The chaos hook ``chaos.heartbeat_point()`` sits in front of each beat
+(``HEAT2D_CHAOS_HEARTBEAT_DROP_AFTER`` makes a worker go silent while
+still serving — the gray failure the supervisor must catch on
+heartbeat age alone), and ``chaos.worker_request_point()`` sits in
+each request pickup (``HEAT2D_CHAOS_WORKER_KILL_AFTER`` hard-kills
+mid-load; ``HEAT2D_CHAOS_SLOW_WORKER_S`` makes a straggler). Chaos
+config arrives via the environment, so the supervisor can aim a
+campaign at individual workers with per-slot env vars.
+
+Shutdown: a ``{"event": "shutdown"}`` line — or stdin EOF, which is
+what a dead supervisor looks like — drains the server gracefully
+(``stop(drain=True)``: every admitted request resolves and its
+response line is flushed before exit 0). No orphaned worker outlives
+its supervisor.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+import os
+import sys
+import threading
+
+log = logging.getLogger("heat2d_tpu.fleet.worker")
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="heat2d-tpu-fleet-worker",
+        description="fleet worker: a SolveServer behind the JSONL "
+                    "stdio wire (spawned by the fleet supervisor)")
+    p.add_argument("--worker-id", type=int, default=0)
+    p.add_argument("--heartbeat", type=float, default=0.25, metavar="S")
+    p.add_argument("--max-batch", type=int, default=8)
+    p.add_argument("--max-delay", type=float, default=0.005)
+    p.add_argument("--queue-depth", type=int, default=256)
+    p.add_argument("--cache-size", type=int, default=256)
+    p.add_argument("--timeout", type=float, default=30.0)
+    return p
+
+
+def _warm_signature(server, emit, rid: int, spec: dict) -> None:
+    """Compile the signature's base program (capacity 1) and report
+    warm. Deliberately NOT the whole padded-capacity ladder: wider
+    capacities compile on demand, each a one-time stall shared by the
+    batch that needs it — pre-compiling them here was measured to
+    starve the serving cores for seconds after every restart (the cure
+    worse than the blip, especially on small hosts). The gate exists
+    to keep a FULLY cold worker out of the hot path, and one compiled
+    program per hot signature is exactly that line."""
+    from heat2d_tpu.serve.schema import SolveRequest
+    try:
+        req = SolveRequest.from_dict(spec)
+        server.engine.solve_batch([req])
+    except Exception as e:  # noqa: BLE001 — a failed warmup must not
+        #                     keep the worker out of the routing set
+        log.warning("warmup failed for %s: %r", spec, e)
+    emit({"id": rid, "ok": True, "warm": True})
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    from heat2d_tpu.fleet import wire
+    from heat2d_tpu.obs import MetricsRegistry
+    from heat2d_tpu.resil import chaos
+    from heat2d_tpu.serve.schema import Rejected, SolveRequest
+    from heat2d_tpu.serve.server import SolveServer
+
+    server = SolveServer(
+        max_batch=args.max_batch, max_delay=args.max_delay,
+        max_queue=args.queue_depth, cache_size=args.cache_size,
+        default_timeout=args.timeout,
+        registry=MetricsRegistry()).start()
+
+    wlock = threading.Lock()
+
+    def emit(obj: dict) -> None:
+        line = json.dumps(obj)
+        with wlock:
+            try:
+                sys.stdout.write(line + "\n")
+                sys.stdout.flush()
+            except (BrokenPipeError, OSError):
+                # supervisor is gone; the stdin EOF will end the loop
+                pass
+
+    stop_hb = threading.Event()
+
+    def hb_loop() -> None:
+        while not stop_hb.wait(args.heartbeat):
+            if chaos.heartbeat_point():
+                emit({"event": "hb", "worker": args.worker_id})
+
+    threading.Thread(target=hb_loop, name="heat2d-fleet-hb",
+                     daemon=True).start()
+    warm_threads: list = []
+    emit({"event": "ready", "pid": os.getpid(),
+          "worker": args.worker_id, "protocol": wire.PROTOCOL})
+
+    for line in sys.stdin:
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            msg = json.loads(line)
+        except ValueError:
+            log.warning("worker %d: skipping unparseable line",
+                        args.worker_id)
+            continue
+        if msg.get("event") == "shutdown":
+            break
+        if "id" not in msg:
+            continue
+        rid = msg["id"]
+        if msg.get("event") == "warmup":
+            # Warm-restart: compile this signature's base program off
+            # the request path, then report warm (_warm_signature on
+            # why only the base). Not client load: it bypasses the
+            # chaos request hook and the batcher (a direct engine
+            # launch).
+            t = threading.Thread(
+                target=_warm_signature,
+                args=(server, emit, rid, msg.get("req") or {}),
+                name="heat2d-fleet-warmup", daemon=True)
+            warm_threads.append(t)
+            t.start()
+            continue
+        # Fault-injection point: slow-worker latency and the mid-load
+        # hard kill both land here — the request is accepted (the
+        # supervisor holds it in flight) but may never be answered.
+        chaos.worker_request_point()
+        try:
+            req = SolveRequest.from_dict(msg.get("req") or {})
+        except Rejected as e:
+            emit(wire.encode_rejection(rid, e))
+            continue
+        fut = server.submit(req)
+
+        def _done(f, rid=rid):
+            exc = f.exception()
+            if exc is None:
+                emit(wire.encode_result(rid, f.result()))
+            else:
+                emit(wire.encode_rejection(rid, exc))
+
+        fut.add_done_callback(_done)
+
+    # Graceful exit: drain resolves every in-flight future, and each
+    # resolution's done-callback emits its response before we return.
+    # An in-flight warmup compile must finish first — tearing the
+    # interpreter down under an active XLA compile is not a clean exit.
+    for t in warm_threads:
+        t.join(timeout=120)
+    server.stop(drain=True)
+    stop_hb.set()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

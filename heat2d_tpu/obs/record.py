@@ -21,8 +21,10 @@ RECORD_SCHEMA = "heat2d-tpu/run-record/v1"
 #: "run" (CLI solver), "ensemble" (CLI batched sweep), "bench"/"sweep"
 #: (benchmark harnesses), "serve" (heat2d-tpu-serve: launch log +
 #: serving telemetry snapshot rides in the same JSONL), "tune"
-#: (heat2d-tpu-tune: search summary + tune_* metric families).
-RECORD_KINDS = ("run", "ensemble", "bench", "sweep", "serve", "tune")
+#: (heat2d-tpu-tune: search summary + tune_* metric families), "fleet"
+#: (heat2d-tpu-fleet: supervisor/soak summary + fleet_* families).
+RECORD_KINDS = ("run", "ensemble", "bench", "sweep", "serve", "tune",
+                "fleet")
 
 
 def run_context() -> dict:
@@ -74,3 +76,15 @@ def build_record(kind: str, config=None, steps_done=None, elapsed_s=None,
     if extra:
         rec.update(extra)
     return attach_context(rec, kind)
+
+
+def write_run_jsonl(registry, path: str, kind: str, extra: dict) -> None:
+    """The one-line telemetry export shared by the CLIs: the
+    registry's events + snapshot plus a ``kind`` run record carrying
+    ``extra`` as its payload. No-op without a registry or path."""
+    if registry is None or not path:
+        return
+    record = build_record(kind, extra=dict(extra))
+    registry.write_jsonl(path,
+                         extra_records=[{"event": "run_record",
+                                         **record}])

@@ -18,9 +18,10 @@ and the CLI:
                  (pipelined commit) so the multihost sharded path is
                  barrier-safe.
 - ``chaos``    — fault injection (kill mid-checkpoint-write, fail N
-                 launches, inject latency) driven by ``HEAT2D_CHAOS_*``
-                 env vars or ``install()``, so CI exercises REAL
-                 failure paths.
+                 launches, inject latency, and the fleet worker modes:
+                 self-kill mid-load, heartbeat drop, slow worker)
+                 driven by ``HEAT2D_CHAOS_*`` env vars or
+                 ``install()``, so CI exercises REAL failure paths.
 - ``retry``    — ``RetryPolicy``/``call_with_retries`` (capped
                  exponential backoff for transients), ``Watchdog``
                  (deadline -> structured timeout instead of a hang),

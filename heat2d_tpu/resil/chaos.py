@@ -17,6 +17,17 @@ failure path executes. This module injects those failures on purpose:
 - **inject latency** — ``HEAT2D_CHAOS_LAUNCH_LATENCY_S`` /
   ``HEAT2D_CHAOS_CKPT_LATENCY_S`` sleep inside the launch / checkpoint
   write (drives watchdog-deadline and async-overlap tests).
+- **kill a fleet worker mid-load** — ``HEAT2D_CHAOS_WORKER_KILL_AFTER=N``
+  hard-kills the worker process (``os._exit(137)``) as it picks up its
+  Nth request — the request is accepted but never answered, exactly the
+  in-flight loss the fleet router's failover replay must absorb.
+- **drop heartbeats** — ``HEAT2D_CHAOS_HEARTBEAT_DROP_AFTER=N`` makes a
+  worker go silent after its Nth heartbeat while it keeps serving: the
+  supervisor must declare it dead on heartbeat age alone (the
+  gray-failure case process liveness checks miss).
+- **slow worker** — ``HEAT2D_CHAOS_SLOW_WORKER_S`` sleeps inside each
+  request pickup (drives latency-blip and routing-under-straggler
+  tests).
 
 Config comes from the environment (so CI can chaos a whole CLI
 subprocess without code changes) or programmatically via ``install()``
@@ -48,41 +59,70 @@ class ChaosError(RuntimeError):
 
 @dataclasses.dataclass
 class ChaosConfig:
-    """One injection campaign. All fields off by default."""
+    """One injection campaign. All fields off by default; an explicit
+    ``0`` is canonicalized to 'off' (``HEAT2D_CHAOS_X=0`` and an unset
+    var arm nothing)."""
 
     kill_ckpt_at: Optional[int] = None      # 1-based checkpoint ordinal
     kill_ckpt_phase: str = "mid_write"
     fail_launches: int = 0                  # first N launches raise
     launch_latency_s: float = 0.0
     ckpt_latency_s: float = 0.0
+    worker_kill_after: Optional[int] = None  # 1-based request ordinal
+    heartbeat_drop_after: Optional[int] = None  # beats after N dropped
+    slow_worker_s: float = 0.0
 
     def __post_init__(self):
         if self.kill_ckpt_phase not in CKPT_PHASES:
             raise ValueError(
                 f"kill_ckpt_phase must be one of {CKPT_PHASES}, got "
                 f"{self.kill_ckpt_phase!r}")
+        # 0 ordinals can never fire (counters are 1-based): canonicalize
+        # to disarmed so any_active()/from_env treat them as unset.
+        for f in ("kill_ckpt_at", "worker_kill_after",
+                  "heartbeat_drop_after"):
+            if getattr(self, f) == 0:
+                setattr(self, f, None)
 
     @classmethod
     def from_env(cls, env=os.environ) -> Optional["ChaosConfig"]:
-        """A config iff any HEAT2D_CHAOS_* var is set, else None."""
+        """A config iff any HEAT2D_CHAOS_* var is armed, else None.
+
+        Parsing is STRICT: a garbage value (``FAIL_LAUNCHES=lots``)
+        raises ``ValueError`` naming the variable instead of silently
+        disarming — a chaos campaign that no-ops on a typo would let
+        the test it drives pass vacuously, the worst failure mode a
+        fault harness can have. Unset and empty mean 'off'; explicit
+        ``0`` means 'off' too (see ``ChaosConfig``)."""
         def get(name, cast, default):
             v = env.get(_ENV_PREFIX + name)
-            return default if v in (None, "") else cast(v)
+            if v in (None, ""):
+                return default
+            try:
+                return cast(v)
+            except ValueError:
+                raise ValueError(
+                    f"{_ENV_PREFIX}{name}={v!r} is not a valid "
+                    f"{cast.__name__} — refusing to run a chaos "
+                    f"campaign that silently no-ops") from None
 
         cfg = cls(
             kill_ckpt_at=get("KILL_CKPT_AT", int, None),
             kill_ckpt_phase=get("KILL_CKPT_PHASE", str, "mid_write"),
             fail_launches=get("FAIL_LAUNCHES", int, 0),
             launch_latency_s=get("LAUNCH_LATENCY_S", float, 0.0),
-            ckpt_latency_s=get("CKPT_LATENCY_S", float, 0.0))
-        if (cfg.kill_ckpt_at is None and not cfg.fail_launches
-                and not cfg.launch_latency_s and not cfg.ckpt_latency_s):
-            return None
-        return cfg
+            ckpt_latency_s=get("CKPT_LATENCY_S", float, 0.0),
+            worker_kill_after=get("WORKER_KILL_AFTER", int, None),
+            heartbeat_drop_after=get("HEARTBEAT_DROP_AFTER", int, None),
+            slow_worker_s=get("SLOW_WORKER_S", float, 0.0))
+        return cfg if cfg.any_active() else None
 
     def any_active(self) -> bool:
         return bool(self.kill_ckpt_at is not None or self.fail_launches
-                    or self.launch_latency_s or self.ckpt_latency_s)
+                    or self.launch_latency_s or self.ckpt_latency_s
+                    or self.worker_kill_after is not None
+                    or self.heartbeat_drop_after is not None
+                    or self.slow_worker_s)
 
 
 class _Controller:
@@ -97,6 +137,8 @@ class _Controller:
         self.ckpt_count = 0      # checkpoints that reached mid_write
         self.launch_count = 0
         self.launches_failed = 0
+        self.worker_requests = 0     # fleet-worker request pickups
+        self.heartbeats = 0          # heartbeats attempted
 
     def _count(self, point: str) -> None:
         if self.registry is not None:
@@ -135,6 +177,35 @@ class _Controller:
             self._count("launch_failure")
             raise ChaosError(
                 f"injected launch failure {n}/{cfg.fail_launches}")
+
+    def worker_request_point(self) -> None:
+        cfg = self.config
+        with self._lock:
+            self.worker_requests += 1
+            n = self.worker_requests
+        if cfg.slow_worker_s:
+            self._count("slow_worker")
+            time.sleep(cfg.slow_worker_s)
+        if (cfg.worker_kill_after is not None
+                and n == cfg.worker_kill_after):
+            # Hard kill mid-pickup: the request was accepted but will
+            # never be answered — the supervisor sees the death and the
+            # router must replay the in-flight work to a survivor.
+            self._count("worker_kill")
+            os._exit(137)
+
+    def heartbeat_point(self) -> bool:
+        """True = send the heartbeat, False = drop it (the worker keeps
+        running — a gray failure only heartbeat age can detect)."""
+        cfg = self.config
+        with self._lock:
+            self.heartbeats += 1
+            n = self.heartbeats
+        if (cfg.heartbeat_drop_after is not None
+                and n > cfg.heartbeat_drop_after):
+            self._count("heartbeat_drop")
+            return False
+        return True
 
 
 _lock = threading.Lock()
@@ -200,3 +271,22 @@ def launch_point() -> None:
     c = controller()
     if c is not None:
         c.launch_point()
+
+
+def worker_request_point() -> None:
+    """Called by a fleet worker as it picks each request off its pipe."""
+    if not _enabled and _env_checked:
+        return
+    c = controller()
+    if c is not None:
+        c.worker_request_point()
+
+
+def heartbeat_point() -> bool:
+    """Called by a fleet worker before each heartbeat; False = drop."""
+    if not _enabled and _env_checked:
+        return True
+    c = controller()
+    if c is None:
+        return True
+    return c.heartbeat_point()

@@ -20,14 +20,19 @@ composes:
   pile-up). After the cooldown one probe is admitted (HALF-OPEN); its
   success closes the breaker, its failure re-opens it.
 
-Everything here is deterministic (no jitter: reproducibility is a
-project invariant) and registry-instrumented but registry-optional.
+Backoff is deterministic by default (reproducibility is a project
+invariant); fleet callers opt into FULL JITTER (``jitter=True`` —
+attempt i sleeps uniform(0, cap_i)) so N workers restarted by the same
+failure don't thundering-herd the same signature, and tests pin the
+jittered schedule through the deterministic ``rng`` seed hook.
+Everything is registry-instrumented but registry-optional.
 """
 
 from __future__ import annotations
 
 import dataclasses
 import logging
+import random
 import threading
 import time
 from typing import Callable, Optional
@@ -57,32 +62,56 @@ def default_transient(exc: BaseException) -> bool:
 @dataclasses.dataclass(frozen=True)
 class RetryPolicy:
     """Capped exponential backoff: attempt i (0-based re-try index)
-    sleeps ``min(base_delay * backoff**i, max_delay)``."""
+    sleeps ``cap_i = min(base_delay * backoff**i, max_delay)``.
+
+    With ``jitter=True`` the sleep is FULL-JITTERED — drawn uniform
+    over ``[0, cap_i)`` — which decorrelates N processes retrying the
+    same failure (the fleet supervisor's restart storm). The draw comes
+    from ``rng`` (a ``random.Random``; tests seed it for a pinned
+    schedule) or the module default."""
 
     max_attempts: int = 3       # total tries, including the first
     base_delay: float = 0.05
     backoff: float = 2.0
     max_delay: float = 2.0
+    jitter: bool = False
 
     def __post_init__(self):
         if self.max_attempts < 1:
             raise ValueError(
                 f"max_attempts must be >= 1, got {self.max_attempts}")
 
-    def delay(self, retry_index: int) -> float:
-        return min(self.base_delay * self.backoff ** retry_index,
-                   self.max_delay)
+    def cap(self, retry_index: int) -> float:
+        """The deterministic ceiling of attempt ``retry_index``'s sleep
+        (== the sleep itself when jitter is off). A long-lived caller
+        (the fleet supervisor's crash-loop restarts) can reach attempt
+        indices where ``backoff ** i`` overflows a float — the cap wins
+        there, it must not raise."""
+        try:
+            d = self.base_delay * self.backoff ** retry_index
+        except OverflowError:
+            return self.max_delay
+        return min(d, self.max_delay)
+
+    def delay(self, retry_index: int,
+              rng: Optional[random.Random] = None) -> float:
+        d = self.cap(retry_index)
+        if not self.jitter:
+            return d
+        return d * (rng if rng is not None else random).random()
 
 
 def call_with_retries(fn: Callable, policy: RetryPolicy, *,
                       classify: Callable[[BaseException], bool] = None,
                       on_retry: Callable[[int, BaseException], None] = None,
-                      sleep: Callable[[float], None] = time.sleep):
+                      sleep: Callable[[float], None] = time.sleep,
+                      rng: Optional[random.Random] = None):
     """Run ``fn()`` under ``policy``. Non-transient failures (per
     ``classify``, default ``default_transient``) propagate immediately;
     transients retry with backoff until attempts run out, then the LAST
     failure propagates. ``on_retry(retry_index, exc)`` fires before each
-    backoff sleep (metrics hook)."""
+    backoff sleep (metrics hook). ``rng`` is the jitter source for
+    ``jitter=True`` policies (seed it for deterministic tests)."""
     classify = default_transient if classify is None else classify
     for attempt in range(policy.max_attempts):
         try:
@@ -93,10 +122,11 @@ def call_with_retries(fn: Callable, policy: RetryPolicy, *,
                 raise
             if on_retry is not None:
                 on_retry(attempt, e)
+            d = policy.delay(attempt, rng=rng)
             log.warning("transient failure (attempt %d/%d), retrying "
                         "in %.3fs: %r", attempt + 1,
-                        policy.max_attempts, policy.delay(attempt), e)
-            sleep(policy.delay(attempt))
+                        policy.max_attempts, d, e)
+            sleep(d)
     raise AssertionError("unreachable")  # loop always returns or raises
 
 
@@ -145,12 +175,14 @@ class DegradedMode:
     """
 
     def __init__(self, threshold: int = 5, cooldown: float = 5.0,
-                 registry=None, clock: Callable[[], float] = time.monotonic):
+                 registry=None, clock: Callable[[], float] = time.monotonic,
+                 metric_prefix: str = "serve"):
         if threshold < 1:
             raise ValueError(f"threshold must be >= 1, got {threshold}")
         self.threshold = threshold
         self.cooldown = cooldown
         self.registry = registry
+        self.metric_prefix = metric_prefix
         self._clock = clock
         self._lock = threading.Lock()
         self._failures = 0          # consecutive
@@ -220,12 +252,12 @@ class DegradedMode:
                         self.cooldown)
                     if self.registry is not None:
                         self.registry.counter(
-                            "serve_breaker_trips_total")
+                            self.metric_prefix + "_breaker_trips_total")
                 self._opened_at = self._clock()
             self._gauge_locked()
 
     def _gauge_locked(self) -> None:
         if self.registry is not None:
             self.registry.gauge(
-                "serve_degraded",
+                self.metric_prefix + "_degraded",
                 0.0 if self._opened_at is None else 1.0)

@@ -80,6 +80,7 @@ class MicroBatcher:
         self._buckets: "collections.OrderedDict" = collections.OrderedDict()
         self._depth = 0
         self._running = False
+        self._draining = False
         self._thread: Optional[threading.Thread] = None
 
     # -- lifecycle ----------------------------------------------------- #
@@ -102,15 +103,31 @@ class MicroBatcher:
                                         daemon=True)
         self._thread.start()
 
-    def stop(self) -> None:
-        """Stop the scheduler; anything still queued is rejected with
-        ``Rejected("shutdown")`` (callers must not hang forever on a
-        future nobody will fill)."""
+    def stop(self, drain: bool = False) -> None:
+        """Stop the scheduler. Default: anything still queued is
+        rejected with ``Rejected("shutdown")`` (callers must not hang
+        forever on a future nobody will fill).
+
+        ``drain=True`` is the graceful path rolling worker restarts
+        need: admission closes immediately (new submits reject), but
+        the scheduler keeps dispatching — partial buckets flush without
+        waiting out ``max_delay`` — until the queue is EMPTY, and only
+        then exits. Because dispatch runs synchronously on the
+        scheduler thread, when ``stop(drain=True)`` returns every
+        admitted request has been resolved or failed; none were
+        dropped."""
         with self._cond:
-            self._running = False
+            if drain and self._running:
+                self._draining = True
+            else:
+                self._running = False
             self._cond.notify_all()
         if self._thread is not None:
             self._thread.join(timeout=60)
+        with self._cond:
+            self._running = False
+            self._draining = False
+        if self._thread is not None:
             if self._thread.is_alive():
                 # A wedged dispatch: keep the handle so start() refuses
                 # to spawn a concurrent consumer next to it.
@@ -140,9 +157,11 @@ class MicroBatcher:
         now = time.monotonic()
         p = Pending(req, key, fail, timeout, now)
         with self._cond:
-            if not self._running:
-                raise Rejected("shutdown", "server not running",
-                               content_hash=key)
+            if not self._running or self._draining:
+                raise Rejected(
+                    "shutdown",
+                    "server draining" if self._draining
+                    else "server not running", content_hash=key)
             if self._depth >= self.max_queue:
                 if self.registry is not None:
                     self.registry.counter("serve_rejected_total",
@@ -171,9 +190,13 @@ class MicroBatcher:
             with self._cond:
                 if not self._running:
                     return
+                if self._draining and self._depth == 0:
+                    self._running = False
+                    return              # drained dry: a clean exit
                 now = time.monotonic()
                 expired = self._pop_expired_locked(now)
-                sig, batch = self._pop_ready_locked(now)
+                sig, batch = self._pop_ready_locked(
+                    now, drain=self._draining)
                 if not expired and batch is None:
                     self._cond.wait(timeout=self._wake_in_locked(now))
                     continue
@@ -211,15 +234,17 @@ class MicroBatcher:
         self._depth -= len(out)
         return out
 
-    def _pop_ready_locked(self, now: float):
+    def _pop_ready_locked(self, now: float, drain: bool = False):
         """Of the buckets that are full or whose oldest member aged past
         max_delay, the one with the OLDEST head dispatches first — never
         the first-inserted: a sustained hot signature keeps its bucket
         position while non-empty, and insertion-order service would
-        starve every other bucket into timeout. Pops up to max_batch."""
+        starve every other bucket into timeout. Pops up to max_batch.
+        While draining, every non-empty bucket is ready — nothing new
+        can arrive, so aging a partial batch only delays shutdown."""
         pick = None
         for sig, q in self._buckets.items():
-            if (len(q) >= self.max_batch
+            if (drain or len(q) >= self.max_batch
                     or q[0].enqueued + self.max_delay <= now):
                 if pick is None or q[0].enqueued < \
                         self._buckets[pick][0].enqueued:

@@ -89,9 +89,14 @@ class SolveServer:
         self._started = True
         return self
 
-    def stop(self) -> None:
+    def stop(self, drain: bool = False) -> None:
+        """Stop serving. ``drain=True`` is the graceful path (rolling
+        worker restarts): admission closes, queued buckets flush, and
+        every in-flight future is resolved before this returns — no
+        admitted request is dropped across a drain. Default (False)
+        rejects whatever is still queued with ``Rejected("shutdown")``."""
         self._started = False
-        self.batcher.stop()
+        self.batcher.stop(drain=drain)
 
     def __enter__(self) -> "SolveServer":
         return self.start()
@@ -143,20 +148,7 @@ class SolveServer:
             return fut
         if not leader:
             self._count("coalesced")
-            # A derived future: the leader's result re-labeled
-            # coalesced=True (the grid itself is shared, not copied),
-            # so the caller can see HOW it was served.
-            out = Future()
-
-            def _relabel(f: Future) -> None:
-                exc = f.exception()
-                if exc is not None:
-                    out.set_exception(exc)
-                else:
-                    out.set_result(dataclasses.replace(
-                        f.result(), coalesced=True))
-
-            fut.add_done_callback(_relabel)
+            out = coalesced_future(fut)
             out.add_done_callback(lambda _f: self._latency(t0))
             return out
 
@@ -284,3 +276,27 @@ def _failed(exc: BaseException) -> Future:
     fut = Future()
     fut.set_exception(exc)
     return fut
+
+
+#: public alias — the fleet router shares the same failure path
+failed_future = _failed
+
+
+def coalesced_future(leader: Future) -> Future:
+    """A derived future for a single-flight FOLLOWER: the leader's
+    result re-labeled ``coalesced=True`` (the grid itself is shared,
+    not copied), so the caller can see HOW it was served; the leader's
+    failure propagates as-is. Shared by ``SolveServer`` and the fleet
+    router."""
+    out = Future()
+
+    def _relabel(f: Future) -> None:
+        exc = f.exception()
+        if exc is not None:
+            out.set_exception(exc)
+        else:
+            out.set_result(dataclasses.replace(
+                f.result(), coalesced=True))
+
+    leader.add_done_callback(_relabel)
+    return out

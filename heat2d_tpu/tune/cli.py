@@ -25,7 +25,7 @@ import json
 import os
 import sys
 
-from heat2d_tpu.tune.db import TuningDB, current_salt
+from heat2d_tpu.tune.db import DB_SCHEMA, TuningDB, current_salt
 from heat2d_tpu.tune.measure import (TERMINAL_STATUSES, SimulatedBackend,
                                      measure_candidate, probe_limits)
 from heat2d_tpu.tune.space import Candidate, Problem, candidate_space
@@ -83,6 +83,14 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--print", dest="print_only", action="store_true",
                    help="print the frontier table from the stored db "
                         "without measuring anything")
+    p.add_argument("--merge", nargs="+", default=None, metavar="DB",
+                   help="merge these tuning dbs (fleet-wide "
+                        "consolidation: best entry per device kind, "
+                        "shape:dtype, salt) and write the result to "
+                        "-o/--out")
+    p.add_argument("-o", "--out", default=None, metavar="PATH",
+                   help="with --merge: output db path (may equal an "
+                        "input for in-place consolidation)")
     p.add_argument("--export", default=None, metavar="PATH",
                    help="write the db document (pretty JSON) here "
                         "after the run")
@@ -387,13 +395,44 @@ def run_selftest(args, registry=None) -> int:
 
 
 def _write_metrics(args, registry, extra) -> None:
-    if registry is None or not args.metrics_out:
-        return
-    from heat2d_tpu.obs.record import build_record
-    record = build_record("tune", extra=dict(extra))
-    registry.write_jsonl(args.metrics_out,
-                         extra_records=[{"event": "run_record",
-                                         **record}])
+    from heat2d_tpu.obs.record import write_run_jsonl
+    write_run_jsonl(registry, args.metrics_out, "tune", extra)
+
+
+def run_merge(args, out=sys.stdout) -> int:
+    """``--merge a.json b.json -o out.json``: consolidate per-worker
+    dbs fleet-wide. Inputs load with the normal corruption tolerance
+    (a torn worker db degrades to an empty contribution, flagged in
+    the summary); the output commits atomically."""
+    if not args.out:
+        print("--merge requires -o/--out PATH", file=sys.stderr)
+        return 2
+    merged = TuningDB(args.out)
+    # The output starts EMPTY even if the path exists: the result must
+    # be exactly the merge of the named inputs (list the output as an
+    # input for read-modify-write consolidation).
+    merged.data = {"schema": DB_SCHEMA, "devices": {}}
+    merged.corrupt = False
+    rc = 0
+    for path in args.merge:
+        src = TuningDB(path)
+        if src.corrupt or (not src.data["devices"]
+                           and not os.path.exists(path)):
+            print(f"# {path}: unreadable or missing — contributed "
+                  f"nothing", file=out)
+            rc = 1
+            continue
+        s = merged.merge(src)
+        print(f"# {path}: +{s['entries_added']} entries, "
+              f"{s['entries_merged']} merged "
+              f"(+{s['points_added']} points), "
+              f"{s['entries_kept']} kept", file=out)
+    merged.save()
+    n = sum(len(d.get("entries", {}))
+            for d in merged.data["devices"].values())
+    print(f"# wrote {args.out}: {n} entries across "
+          f"{len(merged.data['devices'])} device kinds", file=out)
+    return rc
 
 
 def main(argv=None) -> int:
@@ -404,6 +443,8 @@ def main(argv=None) -> int:
     if args.metrics_out:
         from heat2d_tpu.obs import MetricsRegistry
         registry = MetricsRegistry()
+    if args.merge:
+        return run_merge(args)
     if args.selftest:
         return run_selftest(args, registry)
     if args.print_only:

@@ -32,6 +32,7 @@ Rules the lookup/write paths enforce:
 
 from __future__ import annotations
 
+import copy
 import dataclasses
 import hashlib
 import json
@@ -202,6 +203,55 @@ class TuningDB:
         e["mcells_per_s"] = mcells_per_s
         e["provenance"] = provenance
 
+    # -- fleet-wide consolidation -------------------------------------- #
+
+    def merge(self, other) -> dict:
+        """Merge another db (``TuningDB`` or raw document dict) into
+        this one — the fleet-wide consolidation primitive: N workers
+        each tune against their own db; merging keeps the best entry
+        per (device kind, problem key, salt).
+
+        - **Same salt**: points union (per ``(route, bm, tsteps)`` the
+          better datum wins — an ``ok`` beats any failure, a faster
+          ``ok`` beats a slower one) and the best/provenance restamp
+          from the merged frontier.
+        - **Different salts**: one storage slot per problem key, so the
+          CURRENT code version wins; between two stale salts the newer
+          provenance timestamp wins (both describe dead code — keep the
+          fresher corpse for inspection).
+        - Device-level stamps (``vmem_total_bytes`` ...) fill in where
+          this db has none; an existing stamp is never overwritten.
+
+        Returns a summary dict (devices / entries added, merged, kept /
+        points added) the CLI prints."""
+        doc = other.data if isinstance(other, TuningDB) else other
+        if not isinstance(doc, dict) or "devices" not in doc:
+            raise ValueError("merge source is not a tuning db document")
+        s = {"devices": 0, "entries_added": 0, "entries_merged": 0,
+             "entries_kept": 0, "points_added": 0}
+        for kind, dev in doc.get("devices", {}).items():
+            s["devices"] += 1
+            mine = self.device(kind)
+            for k, v in dev.items():
+                if k != "entries":
+                    mine.setdefault(k, copy.deepcopy(v))
+            for key, theirs in dev.get("entries", {}).items():
+                ours = mine["entries"].get(key)
+                if ours is None:
+                    mine["entries"][key] = copy.deepcopy(theirs)
+                    s["entries_added"] += 1
+                elif ours.get("salt") == theirs.get("salt"):
+                    s["points_added"] += _merge_entry(ours, theirs)
+                    s["entries_merged"] += 1
+                elif theirs.get("salt") == current_salt() or (
+                        ours.get("salt") != current_salt()
+                        and _entry_ts(theirs) > _entry_ts(ours)):
+                    mine["entries"][key] = copy.deepcopy(theirs)
+                    s["entries_added"] += 1
+                else:
+                    s["entries_kept"] += 1
+        return s
+
     # -- the lookup ladder --------------------------------------------- #
 
     def lookup(self, device_kind: str, nx: int, ny: int,
@@ -246,3 +296,49 @@ class TuningDB:
                            tsteps=int(b.get("tsteps", 0)),
                            source=source, matched_key=key,
                            mcells_per_s=entry.get("mcells_per_s"))
+
+
+def _entry_ts(e: dict) -> str:
+    """ISO timestamps sort lexically; entries without provenance sort
+    oldest."""
+    return (e.get("provenance") or {}).get("timestamp") or ""
+
+
+def _better_point(p: dict, q: dict) -> bool:
+    """True when measured point ``p`` is the better datum than ``q`` for
+    the same (route, bm, tsteps): ``ok`` beats any failure class, and
+    among oks the higher min-of-reps rate is the truer capability."""
+    p_ok, q_ok = p.get("status") == "ok", q.get("status") == "ok"
+    if p_ok != q_ok:
+        return p_ok
+    if not p_ok:
+        return False                     # two failures: keep the first
+    return (p.get("mcells_per_s") or 0) > (q.get("mcells_per_s") or 0)
+
+
+def _merge_entry(ours: dict, theirs: dict) -> int:
+    """Union ``theirs``'s points into ``ours`` (same salt) and restamp
+    the best from the merged frontier. Returns points added."""
+    added = 0
+    pts = ours.setdefault("points", [])
+    have = {_point_key(p): i for i, p in enumerate(pts)}
+    for p in theirs.get("points", []):
+        k = _point_key(p)
+        if k not in have:
+            have[k] = len(pts)
+            pts.append(copy.deepcopy(p))
+            added += 1
+        elif _better_point(p, pts[have[k]]):
+            pts[have[k]] = copy.deepcopy(p)
+    ok = [p for p in pts if p.get("status") == "ok"]
+    if ok:
+        b = max(ok, key=lambda p: p.get("mcells_per_s") or 0)
+        best_key = _point_key(b)
+        ours["best"] = {"route": b["route"], "bm": b["bm"],
+                        "tsteps": b["tsteps"]}
+        ours["mcells_per_s"] = b.get("mcells_per_s")
+        # the winning measurement's provenance travels with it
+        if (_point_key(theirs.get("best") or {}) == best_key
+                and theirs.get("provenance")):
+            ours["provenance"] = copy.deepcopy(theirs["provenance"])
+    return added

@@ -1,0 +1,457 @@
+"""Fleet subsystem (heat2d_tpu/fleet/): supervised multi-worker pool —
+routing, quotas, failover replay, warm restart, chaos-driven worker
+kills (ISSUE 6 acceptance criteria).
+
+Two tiers: router-logic tests against a FAKE supervisor (no
+subprocesses — the failover/quota/warmup state machines exercised
+deterministically), and end-to-end tests with real worker subprocesses
+under injected faults (self-kill mid-load, dropped heartbeats, the CLI
+chaos soak)."""
+
+import json
+import time
+
+import numpy as np
+import pytest
+
+from heat2d_tpu.fleet import (FleetServer, TenantPolicy, WorkerGone,
+                              route_signature)
+from heat2d_tpu.fleet import wire
+from heat2d_tpu.obs import MetricsRegistry
+from heat2d_tpu.resil.retry import DegradedMode
+from heat2d_tpu.serve.schema import Rejected, SolveRequest, SolveResult
+
+NX, NY, STEPS = 16, 16, 4
+
+
+def req(cx=0.1, **kw):
+    kw.setdefault("nx", NX)
+    kw.setdefault("ny", NY)
+    kw.setdefault("steps", STEPS)
+    kw.setdefault("method", "jnp")
+    return SolveRequest(cx=cx, cy=0.1, **kw)
+
+
+# --------------------------------------------------------------------- #
+# wire protocol
+# --------------------------------------------------------------------- #
+
+def test_wire_result_roundtrip_bitwise():
+    u = np.arange(12, dtype=np.float32).reshape(3, 4) * 0.37
+    res = SolveResult(u=u, steps_done=7, content_hash="abc",
+                      batch_size=3)
+    msg = wire.encode_result(41, res)
+    assert json.loads(json.dumps(msg)) == msg      # JSON-safe
+    back = wire.decode_result(msg)
+    assert back.steps_done == 7 and back.content_hash == "abc"
+    assert np.asarray(back.u).tobytes() == u.tobytes()
+    assert np.asarray(back.u).dtype == u.dtype
+
+
+def test_wire_rejection_roundtrip():
+    exc = Rejected("queue_full", "depth 9 at limit 8", content_hash="h")
+    back = wire.decode_rejection(wire.encode_rejection(3, exc))
+    assert back.code == "queue_full"
+    assert back.fields["content_hash"] == "h"
+    other = wire.decode_rejection(
+        wire.encode_rejection(4, ValueError("boom")))
+    assert other.code == "error" and "boom" in other.message
+
+
+# --------------------------------------------------------------------- #
+# rendezvous routing
+# --------------------------------------------------------------------- #
+
+def test_route_signature_deterministic_and_minimally_disruptive():
+    sigs = [f"sig-{i}" for i in range(64)]
+    alive = [0, 1, 2]
+    before = {s: route_signature(s, alive) for s in sigs}
+    assert before == {s: route_signature(s, alive) for s in sigs}
+    # every worker owns some share
+    assert set(before.values()) == {0, 1, 2}
+    # removing worker 1 remaps ONLY worker 1's signatures
+    after = {s: route_signature(s, [0, 2]) for s in sigs}
+    for s in sigs:
+        if before[s] != 1:
+            assert after[s] == before[s]
+        else:
+            assert after[s] in (0, 2)
+    with pytest.raises(ValueError):
+        route_signature("s", [])
+
+
+def test_tenant_policy_validation():
+    with pytest.raises(ValueError):
+        TenantPolicy(max_inflight=0)
+    with pytest.raises(ValueError):
+        TenantPolicy(priority=-1)
+
+
+# --------------------------------------------------------------------- #
+# router logic against a fake supervisor (no subprocesses)
+# --------------------------------------------------------------------- #
+
+class FakeSup:
+    """The Supervisor surface the router uses, minus the processes."""
+
+    def __init__(self, alive=(0, 1)):
+        self.alive = list(alive)
+        self.sent = []                  # (slot, msg) in send order
+        self.deaths = 0
+        self.restarts = 0
+
+    def alive_slots(self):
+        return list(self.alive)
+
+    def send(self, slot, obj):
+        if slot not in self.alive:
+            raise WorkerGone(f"worker {slot} is not running")
+        self.sent.append((slot, obj))
+
+    def start(self, wait_ready=True):
+        return self
+
+    def stop(self, timeout=30.0):
+        return True
+
+
+def make_router(**kw):
+    kw.setdefault("registry", MetricsRegistry())
+    fs = FleetServer(workers=2, **kw)
+    fs.sup = FakeSup()
+    return fs
+
+
+def answer(fs, slot, msg, u=None):
+    """Worker-side completion for a dispatched envelope."""
+    spec = msg["req"]
+    if u is None:
+        u = np.full((spec["nx"], spec["ny"]), spec["cx"],
+                    dtype=np.float32)
+    res = SolveResult(u=u, steps_done=spec["steps"],
+                      content_hash="computed")
+    fs._on_response(slot, wire.encode_result(msg["id"], res))
+
+
+def test_router_dispatch_response_cache_and_coalesce():
+    fs = make_router()
+    r = req(cx=0.17)
+    f1 = fs.submit(r)
+    f2 = fs.submit(r)                   # identical, in flight: coalesce
+    assert len(fs.sup.sent) == 1        # ONE dispatch for both
+    slot, msg = fs.sup.sent[0]
+    assert msg["req"]["cx"] == 0.17
+    answer(fs, slot, msg)
+    r1, r2 = f1.result(timeout=5), f2.result(timeout=5)
+    assert not r1.coalesced and r2.coalesced
+    assert np.asarray(r1.u).tobytes() == np.asarray(r2.u).tobytes()
+    # repeat: served from the shared fleet cache, no new dispatch
+    r3 = fs.submit(r).result(timeout=5)
+    assert r3.cache_hit and len(fs.sup.sent) == 1
+    snap = fs.registry.snapshot()
+    assert snap["counters"]["fleet_cache_hits_total"] == 1
+    assert snap["counters"]["fleet_coalesced_total"] == 1
+    assert snap["counters"][
+        "fleet_requests_total{outcome=completed}"] == 1
+
+
+def test_router_worker_rejection_is_an_answer_not_a_fault():
+    fs = make_router()
+    f = fs.submit(req())
+    slot, msg = fs.sup.sent[-1]
+    fs._on_response(slot, wire.encode_rejection(
+        msg["id"], Rejected("queue_full", "worker side")))
+    with pytest.raises(Rejected) as e:
+        f.result(timeout=5)
+    assert e.value.code == "queue_full"
+    assert fs.breaker.state == "closed"   # rejections never trip it
+
+
+def test_router_failover_replays_to_survivor():
+    fs = make_router()
+    f = fs.submit(req(cx=0.3))
+    slot0, msg0 = fs.sup.sent[-1]
+    # the assigned worker dies with the request in flight
+    fs.sup.alive = [s for s in fs.sup.alive if s != slot0]
+    fs._on_worker_lost(slot0)
+    assert len(fs.sup.sent) == 2
+    slot1, msg1 = fs.sup.sent[-1]
+    assert slot1 != slot0
+    assert msg1["id"] != msg0["id"]       # fresh wire id per dispatch
+    assert msg1["req"] == msg0["req"]
+    answer(fs, slot1, msg1)
+    assert f.result(timeout=5).steps_done == STEPS
+    assert fs.replays == 1
+    snap = fs.registry.snapshot()
+    assert snap["counters"]["fleet_failover_replays_total"] == 1
+    # a LATE answer under the dead worker's old id is dropped
+    fs._on_response(slot0, wire.encode_result(
+        msg0["id"], SolveResult(u=np.zeros((2, 2), np.float32),
+                                steps_done=1, content_hash="stale")))
+
+
+def test_router_replay_budget_exhausts_to_structured_rejection():
+    fs = make_router(max_replays=1)
+    f = fs.submit(req(cx=0.4))
+    for _ in range(2):
+        slot, _msg = fs.sup.sent[-1]
+        fs._on_worker_lost(slot)
+    with pytest.raises(Rejected) as e:
+        f.result(timeout=5)
+    assert e.value.code == "worker_lost"
+
+
+def test_router_parks_without_workers_and_flushes_on_ready():
+    fs = make_router()
+    fs.sup.alive = []
+    f = fs.submit(req(cx=0.5))
+    assert not fs.sup.sent and len(fs._parked) == 1
+    fs.sup.alive = [1]
+    fs._on_worker_ready(1)
+    assert len(fs.sup.sent) == 1
+    slot, msg = fs.sup.sent[-1]
+    answer(fs, slot, msg)
+    assert f.result(timeout=5).steps_done == STEPS
+
+
+def test_router_fleet_deadline_expires_parked_requests():
+    fs = make_router(default_timeout=0.01)
+    fs.sup.alive = []
+    f = fs.submit(req(cx=0.6))
+    time.sleep(0.05)
+    fs._expire_overdue()
+    with pytest.raises(Rejected) as e:
+        f.result(timeout=5)
+    assert e.value.code == "timeout"
+
+
+def test_router_tenant_quota_and_priority_watermark():
+    fs = make_router(
+        max_inflight=10,
+        quotas={"small": TenantPolicy(max_inflight=1),
+                "batch": TenantPolicy(max_inflight=10, priority=1)})
+    # per-tenant cap: second in-flight request is shed at the door
+    f1 = fs.submit(req(cx=0.61), tenant="small")
+    f2 = fs.submit(req(cx=0.62), tenant="small")
+    with pytest.raises(Rejected) as e:
+        f2.result(timeout=5)
+    assert e.value.code == "quota" and e.value.fields["tenant"] == "small"
+    # resolving the first frees the slot
+    slot, msg = fs.sup.sent[-1]
+    answer(fs, slot, msg)
+    f1.result(timeout=5)
+    fs.submit(req(cx=0.63), tenant="small")
+    assert len(fs.sup.sent) == 2
+    # watermark: standard-priority tenants shed at 80% of capacity,
+    # the critical default tenant fills the reserved headroom
+    futs = [fs.submit(req(cx=0.7 + 0.001 * i), tenant="batch")
+            for i in range(8)]
+    with pytest.raises(Rejected) as e:
+        futs[-1].result(timeout=5)      # 8th standard would pass 8/10
+    assert e.value.code == "overloaded"
+    crit = fs.submit(req(cx=0.81))      # priority-0 default tenant
+    assert not crit.done()              # admitted, waiting on a worker
+    snap = fs.registry.snapshot()
+    assert snap["counters"][
+        "fleet_quota_rejected_total{tenant=small}"] == 1
+
+
+def test_router_breaker_sheds_fresh_but_cache_answers():
+    fs = make_router(breaker=DegradedMode(threshold=1, cooldown=60.0))
+    warm = req(cx=0.9)
+    f = fs.submit(warm)
+    slot, msg = fs.sup.sent[-1]
+    answer(fs, slot, msg)
+    f.result(timeout=5)
+    fs._on_worker_lost(0)               # death trips threshold=1
+    with pytest.raises(Rejected) as e:
+        fs.submit(req(cx=0.91)).result(timeout=5)
+    assert e.value.code == "degraded"
+    hit = fs.submit(warm).result(timeout=5)
+    assert hit.cache_hit                # answers the fleet holds flow
+
+
+def test_router_warm_restart_gates_routing_until_warm():
+    fs = make_router()
+    # serve one request: its signature becomes the hot set
+    f = fs.submit(req(cx=0.2))
+    slot0, msg0 = fs.sup.sent[-1]
+    answer(fs, slot0, msg0)
+    f.result(timeout=5)
+    # a restarted worker rejoins: warmup goes to IT, marked as such
+    # (a FIRST spawn never warm-gates — only replacements do)
+    other = 1 - slot0
+    fs._on_worker_ready(other, restarted=False)
+    assert other not in fs._cold
+    fs._on_worker_ready(other, restarted=True)
+    warmups = [(s, m) for s, m in fs.sup.sent
+               if m.get("event") == "warmup"]
+    assert len(warmups) == 1 and warmups[0][0] == other
+    assert other in fs._cold
+    # while cold, client traffic avoids it
+    n_before = len(fs.sup.sent)
+    f2 = fs.submit(req(cx=0.21))
+    assert fs.sup.sent[n_before][0] == slot0
+    # the warm-done answer readmits the slot
+    wslot, wmsg = warmups[0]
+    fs._on_response(wslot, {"id": wmsg["id"], "ok": True, "warm": True})
+    assert other not in fs._cold
+    answer(fs, *fs.sup.sent[n_before])
+    f2.result(timeout=5)
+    snap = fs.registry.snapshot()
+    assert snap["counters"]["fleet_worker_warmups_total"] == 1
+
+
+# --------------------------------------------------------------------- #
+# end to end: real worker subprocesses under injected faults
+# --------------------------------------------------------------------- #
+
+def fleet(**kw):
+    kw.setdefault("registry", MetricsRegistry())
+    kw.setdefault("env", {"JAX_PLATFORMS": "cpu"})
+    kw.setdefault("heartbeat_timeout", 1.5)
+    return FleetServer(**kw)
+
+
+def oracle_grid(r):
+    from heat2d_tpu.serve.server import SolveServer
+    with SolveServer(registry=MetricsRegistry()) as s:
+        return np.asarray(s.solve(r, timeout=120).u).tobytes()
+
+
+def test_fleet_serves_and_fails_over_bitwise():
+    """ISSUE acceptance (core): requests in flight on a hard-killed
+    worker are replayed to a survivor; every request is answered,
+    bitwise-identical to a single-worker oracle; the dead worker is
+    restarted; shutdown is clean."""
+    reg = MetricsRegistry()
+    reqs = [req(cx=0.05 + 0.01 * i, steps=STEPS + (i % 2))
+            for i in range(6)]
+    with fleet(workers=2, registry=reg,
+               per_worker_env={0: {"HEAT2D_CHAOS_SLOW_WORKER_S": "0.4"}}
+               ) as fs:
+        futs = [fs.submit(r) for r in reqs]
+        time.sleep(0.2)                 # work lands on both workers
+        fs.sup.kill_worker(0)
+        results = [f.result(timeout=120) for f in futs]
+        assert fs.sup.deaths == 1
+        deadline = time.monotonic() + 30
+        while (len(fs.sup.alive_slots()) < 2
+               and time.monotonic() < deadline):
+            time.sleep(0.05)
+        assert len(fs.sup.alive_slots()) == 2   # restarted and ready
+        assert fs.stop()                        # clean drain exit
+    assert fs.sup.restarts >= 1
+    for r, res in zip(reqs, results):
+        assert np.asarray(res.u).tobytes() == oracle_grid(r)
+    snap = reg.snapshot()
+    assert snap["counters"][
+        "fleet_worker_deaths_total{cause=exit}"] == 1
+    assert snap["counters"]["fleet_worker_restarts_total"] >= 1
+    assert snap["counters"][
+        "fleet_requests_total{outcome=completed}"] == 6
+
+
+def test_fleet_chaos_env_self_kill_parks_and_recovers():
+    """A worker armed with HEAT2D_CHAOS_WORKER_KILL_AFTER dies picking
+    up its 3rd request; the survivors of its queue park (single
+    worker), the replacement drains them, nothing is lost."""
+    reg = MetricsRegistry()
+    reqs = [req(cx=0.2 + 0.01 * i) for i in range(4)]
+    with fleet(workers=1, registry=reg, max_replays=5,
+               per_worker_env={0: {"HEAT2D_CHAOS_WORKER_KILL_AFTER":
+                                   "3"}}) as fs:
+        # sequential load: the worker serves #1 and #2, dies PICKING UP
+        # #3 (accepted, never answered) — the replacement, whose chaos
+        # counter is fresh, drains the replay and #4
+        results = [fs.solve(r, timeout=120) for r in reqs]
+        assert fs.sup.deaths >= 1 and fs.sup.restarts >= 1
+    assert len(results) == 4
+    for r, res in zip(reqs, results):
+        assert np.asarray(res.u).tobytes() == oracle_grid(r)
+
+
+def test_fleet_heartbeat_drop_is_detected_and_fenced():
+    """A worker that goes silent but keeps running (dropped heartbeats
+    — the gray failure) is declared dead on heartbeat age, killed, and
+    replaced; traffic keeps flowing."""
+    reg = MetricsRegistry()
+    # 25 beats at 0.1s: the worker serves its first request, then goes
+    # silent while IDLE — responses also count as liveness, so only an
+    # idle-and-silent worker ages past the heartbeat timeout
+    with fleet(workers=1, registry=reg, max_replays=5,
+               heartbeat_interval=0.1, heartbeat_timeout=0.8,
+               per_worker_env={0: {"HEAT2D_CHAOS_HEARTBEAT_DROP_AFTER":
+                                   "25"}}) as fs:
+        first = fs.solve(req(cx=0.31), timeout=120)
+        deadline = time.monotonic() + 60
+        while fs.sup.deaths < 1 and time.monotonic() < deadline:
+            time.sleep(0.05)
+        assert fs.sup.deaths >= 1
+        # the replacement (same env: it will drop heartbeats too and
+        # die again eventually) still serves fresh load meanwhile
+        second = fs.solve(req(cx=0.32), timeout=120)
+    assert first.steps_done == STEPS and second.steps_done == STEPS
+    snap = reg.snapshot()
+    assert snap["counters"].get(
+        "fleet_worker_deaths_total{cause=heartbeat}", 0) >= 1
+
+
+def test_fleet_stop_start_cycle_rearms_monitoring():
+    """A stop()/start() cycle must re-arm the monitor (regression: a
+    stale stop event left failure detection silently dead), and a
+    stopped fleet answers submits with Rejected('shutdown') instead of
+    parking a future nobody will resolve."""
+    fs = fleet(workers=1)
+    fs.start()
+    assert fs.solve(req(cx=0.41), timeout=120).steps_done == STEPS
+    fs.stop()
+    with pytest.raises(Rejected) as e:
+        fs.solve(req(cx=0.42), timeout=5)
+    assert e.value.code == "shutdown"
+    fs.start()
+    try:
+        assert fs.solve(req(cx=0.43), timeout=120).steps_done == STEPS
+        # the re-armed monitor still detects kills and restarts
+        fs.sup.kill_worker(0)
+        deadline = time.monotonic() + 30
+        while fs.sup.deaths < 1 and time.monotonic() < deadline:
+            time.sleep(0.05)
+        assert fs.sup.deaths == 1
+        assert fs.solve(req(cx=0.44), timeout=120).steps_done == STEPS
+    finally:
+        fs.stop()
+
+
+def test_fleet_cli_chaos_soak(tmp_path):
+    """ISSUE acceptance, end to end through the CLI: sustained load, 1
+    of 2 workers killed mid-soak, zero incorrect results (bitwise
+    oracle), nothing silently lost, throughput recovered, clean exit —
+    the CLI exits 0 iff all of it held. Telemetry lands as a
+    kind='fleet' run record with the new metric families."""
+    from heat2d_tpu.fleet.cli import main
+
+    out = tmp_path / "fleet.jsonl"
+    # 10s soak, kill at 5s, 3s windows: the post-restart window starts
+    # after the failover blip (survivor compiles the dead worker's
+    # share) and contains the restarted worker's warm rejoin
+    rc = main(["--workers", "2", "--soak", "10", "--window", "3",
+               "--chaos", "--concurrency", "4",
+               "--metrics-out", str(out)])
+    assert rc == 0
+    lines = [json.loads(l) for l in out.read_text().splitlines()]
+    rec = [l for l in lines if l.get("event") == "run_record"][0]
+    assert rec["kind"] == "fleet"
+    assert rec["completed"] == rec["submitted"] > 0
+    assert rec["deaths"] >= 1 and rec["restarts"] >= 1
+    assert rec["clean_exit"] is True
+    assert rec["pre_kill_rps"] > 0
+    assert rec["throughput_recovery_s"] is not None
+    assert rec["post_restart_rps"] >= 0.8 * rec["pre_kill_rps"]
+    snap = [l for l in lines if l.get("event") == "snapshot"][0]
+    # the snapshot is written post-shutdown: the gauge exists and ends 0
+    assert snap["gauges"]["fleet_workers_alive"] == 0
+    assert snap["counters"]["fleet_worker_restarts_total"] >= 1
+    assert "fleet_e2e_latency_s" in snap["histograms"]
+    assert snap["gauges"][
+        "fleet_throughput_rps{window=post_restart}"] > 0

@@ -351,6 +351,142 @@ def test_degraded_mode_state_machine():
     #                                      is not a second trip
 
 
+def test_retry_policy_full_jitter_bounded_and_seeded():
+    """Fleet satellite: jittered delays stay within the deterministic
+    cap at every attempt, and a seeded rng pins the schedule — N
+    restarted workers decorrelate without losing reproducible tests."""
+    import random
+
+    p = RetryPolicy(base_delay=0.1, backoff=2.0, max_delay=0.35,
+                    jitter=True)
+    rng = random.Random(1234)
+    sched = [p.delay(i, rng=rng) for i in range(6)]
+    assert all(0.0 <= d <= p.cap(i) for i, d in enumerate(sched))
+    assert [p.cap(i) for i in range(4)] == [0.1, 0.2, 0.35, 0.35]
+    # seeded: the exact schedule reproduces
+    rng_again = random.Random(1234)
+    assert sched == [p.delay(i, rng=rng_again) for i in range(6)]
+    # two differently-seeded workers do NOT share a schedule
+    other = [p.delay(i, rng=random.Random(5678)) for i in range(6)]
+    assert sched != other
+    # jitter off (the default) stays byte-for-byte the legacy behavior
+    q = RetryPolicy(base_delay=0.1, backoff=2.0, max_delay=0.35)
+    assert [q.delay(i) for i in range(4)] == [0.1, 0.2, 0.35, 0.35]
+
+
+def test_call_with_retries_jitter_sleeps_within_cap():
+    import random
+
+    calls, slept = [], []
+
+    def flaky():
+        calls.append(1)
+        if len(calls) < 4:
+            raise ChaosError("injected")
+        return "ok"
+
+    p = RetryPolicy(max_attempts=4, base_delay=0.1, backoff=2.0,
+                    max_delay=0.25, jitter=True)
+    assert call_with_retries(flaky, p, sleep=slept.append,
+                             rng=random.Random(7)) == "ok"
+    assert len(slept) == 3
+    assert all(0.0 <= d <= p.cap(i) for i, d in enumerate(slept))
+
+
+# --------------------------------------------------------------------- #
+# chaos env parsing edge cases (fleet satellite)
+# --------------------------------------------------------------------- #
+
+def test_chaos_env_unset_and_empty_mean_off():
+    assert ChaosConfig.from_env({}) is None
+    assert ChaosConfig.from_env({"HEAT2D_CHAOS_FAIL_LAUNCHES": "",
+                                 "HEAT2D_CHAOS_KILL_CKPT_AT": ""}) \
+        is None
+    # phase alone arms nothing
+    assert ChaosConfig.from_env(
+        {"HEAT2D_CHAOS_KILL_CKPT_PHASE": "pre_meta"}) is None
+
+
+def test_chaos_env_zero_means_off():
+    """An explicit 0 is 'off', not 'an ordinal that never fires': the
+    config canonicalizes and from_env returns None."""
+    env = {"HEAT2D_CHAOS_KILL_CKPT_AT": "0",
+           "HEAT2D_CHAOS_FAIL_LAUNCHES": "0",
+           "HEAT2D_CHAOS_LAUNCH_LATENCY_S": "0",
+           "HEAT2D_CHAOS_WORKER_KILL_AFTER": "0",
+           "HEAT2D_CHAOS_HEARTBEAT_DROP_AFTER": "0",
+           "HEAT2D_CHAOS_SLOW_WORKER_S": "0"}
+    assert ChaosConfig.from_env(env) is None
+    assert ChaosConfig(kill_ckpt_at=0).kill_ckpt_at is None
+    assert not ChaosConfig(worker_kill_after=0).any_active()
+
+
+def test_chaos_env_garbage_raises_not_noops():
+    """A typo'd chaos var must refuse loudly: a campaign that silently
+    no-ops lets the chaos test it drives pass vacuously."""
+    with pytest.raises(ValueError, match="FAIL_LAUNCHES"):
+        ChaosConfig.from_env({"HEAT2D_CHAOS_FAIL_LAUNCHES": "lots"})
+    with pytest.raises(ValueError, match="LAUNCH_LATENCY_S"):
+        ChaosConfig.from_env({"HEAT2D_CHAOS_LAUNCH_LATENCY_S": "fast"})
+    with pytest.raises(ValueError, match="kill_ckpt_phase"):
+        ChaosConfig.from_env({"HEAT2D_CHAOS_KILL_CKPT_AT": "1",
+                              "HEAT2D_CHAOS_KILL_CKPT_PHASE": "bogus"})
+    with pytest.raises(ValueError, match="WORKER_KILL_AFTER"):
+        ChaosConfig.from_env({"HEAT2D_CHAOS_WORKER_KILL_AFTER": "x"})
+
+
+def test_chaos_worker_hooks_in_process():
+    """The fleet fault modes, at the hook level: slow-worker injects
+    real latency into request pickup; heartbeat-drop silences beats
+    after N while the process keeps running."""
+    reg = MetricsRegistry()
+    chaos.install(ChaosConfig(slow_worker_s=0.05,
+                              heartbeat_drop_after=2), registry=reg)
+    t0 = time.monotonic()
+    chaos.worker_request_point()
+    assert time.monotonic() - t0 >= 0.05
+    assert [chaos.heartbeat_point() for _ in range(4)] == \
+        [True, True, False, False]
+    snap = reg.snapshot()
+    assert snap["counters"][
+        "resil_chaos_injected_total{point=slow_worker}"] == 1
+    assert snap["counters"][
+        "resil_chaos_injected_total{point=heartbeat_drop}"] == 2
+    chaos.uninstall()
+    # disarmed: the hooks are no-ops again
+    assert chaos.heartbeat_point() is True
+    chaos.worker_request_point()
+
+
+def test_degraded_mode_concurrent_half_open_single_probe():
+    """Fleet load pattern: many threads hit allow() the instant the
+    cooldown expires — exactly ONE gets the half-open probe token; its
+    success re-closes the breaker for everyone."""
+    import threading
+
+    t = [0.0]
+    b = DegradedMode(threshold=1, cooldown=10.0, clock=lambda: t[0])
+    b.record_failure()
+    t[0] = 11.0
+    grants = []
+    barrier = threading.Barrier(8)
+
+    def hammer():
+        barrier.wait()
+        grants.append(b.allow())
+
+    threads = [threading.Thread(target=hammer) for _ in range(8)]
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join()
+    assert sum(grants) == 1                 # one probe token
+    b.record_success()                      # probe verdict: healthy
+    assert b.state == "closed"
+    grants2 = [b.allow() for _ in range(8)]
+    assert all(grants2)                     # re-closed for everyone
+
+
 def test_degraded_probe_token_expires():
     """A probe that hangs (its verdict never arrives) must not shed
     traffic forever: the token expires after one cooldown and another

@@ -231,6 +231,44 @@ def test_per_request_timeout_returns_structured_rejection():
     assert rec["rejected"] == "timeout" and rec["waited_s"] >= 0.05
 
 
+def test_stop_drain_resolves_every_queued_request():
+    """Fleet satellite: stop(drain=True) closes admission, flushes the
+    queued buckets WITHOUT waiting out max_delay, and resolves every
+    in-flight future before returning — the graceful path a rolling
+    worker restart needs (no admitted request is dropped)."""
+    server = make_server(max_delay=60.0, max_batch=100)
+    server.start()
+    # max_delay=60s: nothing would dispatch on its own within the test
+    futs = [server.submit(req(cx=0.05 + 0.01 * i)) for i in range(5)]
+    futs += [server.submit(req(nx=NX + 8, cx=0.3))]   # second bucket
+    assert not any(f.done() for f in futs)
+    server.stop(drain=True)
+    # drain returned => every future is already resolved, successfully
+    for f in futs:
+        res = f.result(timeout=0)
+        assert isinstance(res, SolveResult)
+    assert server.batcher.depth() == 0
+    # admission is closed during/after a drain
+    with pytest.raises(Rejected) as e:
+        server.submit(req(cx=0.9)).result(timeout=5)
+    assert e.value.code == "shutdown"
+    # and the server can come back up for the next restart cycle
+    server.start()
+    fut = server.submit(req(cx=0.91))
+    server.stop(drain=True)
+    assert fut.result(timeout=0).steps_done == STEPS
+
+
+def test_stop_default_still_rejects_queued():
+    """Non-drain stop keeps the legacy contract: queued requests fail
+    with a structured shutdown rejection rather than hanging."""
+    with make_server(max_delay=60.0, max_batch=100) as server:
+        fut = server.submit(req(cx=0.4))
+    with pytest.raises(Rejected) as e:
+        fut.result(timeout=5)
+    assert e.value.code == "shutdown"
+
+
 # --------------------------------------------------------------------- #
 # compile cache
 # --------------------------------------------------------------------- #

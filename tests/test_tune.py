@@ -606,3 +606,139 @@ def test_serve_engine_tuned_none_without_db(monkeypatch):
                        method="jnp")
     eng.solve_batch([req])
     assert eng.launch_log[-1]["tuned_config"] is None
+
+
+# --------------------------------------------------------------------- #
+# fleet-wide db consolidation: TuningDB.merge + --merge CLI
+# --------------------------------------------------------------------- #
+
+def _point(route, bm, t, mcells=None, status="ok"):
+    p = {"route": route, "bm": bm, "tsteps": t, "status": status}
+    if mcells is not None:
+        p["mcells_per_s"] = mcells
+        p["step_time_s"] = 1.0 / mcells
+    return p
+
+
+def _worker_db(path, kind="cpu", points=(), best=None, ts="2026-01-01"):
+    db = TuningDB(str(path))
+    key = "64x64:float32"
+    for p in points:
+        db.record_point(kind, key, dict(p))
+    if best is not None:
+        db.set_best(kind, key,
+                    {"route": best["route"], "bm": best["bm"],
+                     "tsteps": best["tsteps"]}, best["mcells_per_s"],
+                    {"protocol": f"worker@{path}",
+                     "timestamp": f"{ts}T00:00:00+00:00"})
+    db.save()
+    return db
+
+
+def test_db_merge_same_salt_keeps_best_and_unions_points(tmp_path):
+    """Two workers measured overlapping spaces: the merge unions the
+    points (the better datum wins per (route, bm, T)) and restamps the
+    best — with the winning measurement's provenance."""
+    a = _worker_db(tmp_path / "a.json",
+                   points=[_point("C", 8, 8, 100.0),
+                           _point("C", 16, 8, 120.0),
+                           _point("C2", 8, 8, status="oom")],
+                   best={"route": "C", "bm": 16, "tsteps": 8,
+                         "mcells_per_s": 120.0})
+    _worker_db(tmp_path / "b.json",
+               points=[_point("C", 16, 8, 150.0),     # faster re-measure
+                       _point("C2", 8, 8, 140.0),     # succeeded here
+                       _point("C2", 16, 8, 90.0)],
+               best={"route": "C", "bm": 16, "tsteps": 8,
+                     "mcells_per_s": 150.0}, ts="2026-02-01")
+    s = a.merge(TuningDB(str(tmp_path / "b.json")))
+    assert s["entries_merged"] == 1 and s["points_added"] == 1
+    e = a.entry("cpu", "64x64:float32")
+    by_key = {(p["route"], p["bm"], p["tsteps"]): p
+              for p in e["points"]}
+    assert len(by_key) == 4
+    assert by_key[("C", 16, 8)]["mcells_per_s"] == 150.0  # better won
+    assert by_key[("C2", 8, 8)]["status"] == "ok"         # ok beat oom
+    assert e["best"] == {"route": "C", "bm": 16, "tsteps": 8}
+    assert e["mcells_per_s"] == 150.0
+    assert e["provenance"]["protocol"].endswith("b.json")
+    # lookup serves the merged best
+    cfg = a.lookup("cpu", 64, 64)
+    assert cfg is not None and cfg.bm == 16 and cfg.source == "exact"
+
+
+def test_db_merge_current_salt_wins_over_stale(tmp_path):
+    """Entries measured under a different kernel revision lose the
+    storage slot to current-salt entries no matter their rate; between
+    two stale salts the newer provenance wins."""
+    a = _worker_db(tmp_path / "a.json",
+                   points=[_point("C", 8, 8, 999.0)],
+                   best={"route": "C", "bm": 8, "tsteps": 8,
+                         "mcells_per_s": 999.0})
+    a.data["devices"]["cpu"]["entries"]["64x64:float32"]["salt"] = \
+        "stale-aaaa"
+    b = _worker_db(tmp_path / "b.json",
+                   points=[_point("C", 16, 8, 10.0)],
+                   best={"route": "C", "bm": 16, "tsteps": 8,
+                         "mcells_per_s": 10.0})
+    a.merge(b)
+    e = a.entry("cpu", "64x64:float32")       # salted lookup: current
+    assert e is not None and e["best"]["bm"] == 16
+    # reversed: a current-salt holder keeps its slot against stale
+    b2 = TuningDB(str(tmp_path / "b.json"))
+    stale = {"devices": {"cpu": {"entries": {"64x64:float32": {
+        "salt": "stale-bbbb", "points": [_point("C", 24, 8, 5000.0)],
+        "best": {"route": "C", "bm": 24, "tsteps": 8},
+        "mcells_per_s": 5000.0,
+        "provenance": {"timestamp": "2030-01-01T00:00:00+00:00"}}}}}}
+    s = b2.merge(stale)
+    assert s["entries_kept"] == 1
+    assert b2.entry("cpu", "64x64:float32")["best"]["bm"] == 16
+
+
+def test_db_merge_new_device_kind_and_stamps(tmp_path):
+    a = TuningDB(str(tmp_path / "a.json"))
+    a.stamp_device("cpu", vmem_total_bytes=111)
+    b = _worker_db(tmp_path / "b.json", kind="TPU v5e",
+                   points=[_point("C2", 64, 16, 9000.0)],
+                   best={"route": "C2", "bm": 64, "tsteps": 16,
+                         "mcells_per_s": 9000.0})
+    b.stamp_device("cpu", vmem_total_bytes=222)
+    s = a.merge(b)
+    assert s["entries_added"] == 1
+    assert a.lookup("TPU v5e", 64, 64).route == "C2"
+    # an existing device stamp is never overwritten by a merge
+    assert a.device("cpu")["vmem_total_bytes"] == 111
+    with pytest.raises(ValueError):
+        a.merge({"not": "a db"})
+
+
+def test_merge_cli_writes_consolidated_db(tmp_path, capsys):
+    """heat2d-tpu-tune --merge a.json b.json -o out.json — the
+    fleet-wide consolidation entry point; corrupt inputs contribute
+    nothing and flag the exit code."""
+    from heat2d_tpu.tune.cli import main
+
+    _worker_db(tmp_path / "a.json",
+               points=[_point("C", 8, 8, 100.0)],
+               best={"route": "C", "bm": 8, "tsteps": 8,
+                     "mcells_per_s": 100.0})
+    _worker_db(tmp_path / "b.json",
+               points=[_point("C", 16, 8, 160.0)],
+               best={"route": "C", "bm": 16, "tsteps": 8,
+                     "mcells_per_s": 160.0}, ts="2026-03-01")
+    out = tmp_path / "merged.json"
+    assert main(["--merge", str(tmp_path / "a.json"),
+                 str(tmp_path / "b.json"), "-o", str(out)]) == 0
+    merged = TuningDB(str(out))
+    cfg = merged.lookup("cpu", 64, 64)
+    assert cfg is not None and cfg.bm == 16
+    assert cfg.mcells_per_s == 160.0
+    # missing -o is a usage error
+    assert main(["--merge", str(tmp_path / "a.json")]) == 2
+    # a corrupt input degrades to an empty contribution, rc 1
+    bad = tmp_path / "bad.json"
+    bad.write_text("{torn")
+    assert main(["--merge", str(tmp_path / "a.json"), str(bad),
+                 "-o", str(out)]) == 1
+    assert TuningDB(str(out)).lookup("cpu", 64, 64).bm == 8
