@@ -1,0 +1,78 @@
+"""Differentiable solves & inverse problems (ROADMAP item 4).
+
+This is a JAX codebase, and until this package nothing in it called
+``jax.grad``: the forward 5-point Jacobi solve was served at 98% of the
+single-chip bound, but only *forward*. This package exposes the solver
+as a differentiable operator and ships the inverse-problem workload
+that turns one forward solve into a whole request class (parameter
+recovery, sensitivity analysis, data assimilation):
+
+- ``adjoint`` — the differentiable forward operator: ``custom_vjp``
+                over the fused multi-step path so reverse-mode never
+                naively unrolls (and never stores) all T step states;
+                a checkpointed-segment adjoint (store every K-th state,
+                recompute segments on the backward sweep — O(T/K + K)
+                memory) selectable against a full-storage reference
+                adjoint (O(T) memory, zero recompute). Constant
+                (cx, cy) and per-cell variable-coefficient
+                (``ops.stencil_step_var``) routes.
+- ``inverse`` — gradient-based recovery of an initial condition or a
+                per-cell diffusivity field from sparse observations:
+                Adam/GD on the differentiable solve, stability-box
+                projection, per-iteration loss/grad-norm telemetry
+                through the obs/ metrics registry.
+- ``serving`` — ``InverseRequest``/``InverseResult``: optimization
+                loops as first-class serving requests through the
+                existing ``serve`` batcher/cache/admission (content-
+                hashed like ``SolveRequest``; repeat submissions are
+                cache hits, duplicates coalesce in flight).
+- ``cli``     — ``heat2d-tpu-inverse`` (``--selftest`` recovers a known
+                synthetic diffusivity field through a running
+                SolveServer — the CI smoke job).
+
+Zero cost when unused: importing this package (or building operators
+from it) changes no existing traced program — the forward solver and
+the serve batch runners stay byte-identical (jaxpr-pinned by
+tests/test_diff.py), exactly the obs/chaos/tune contract.
+"""
+
+from heat2d_tpu.diff.inverse import (InverseProblem, InverseSolution,
+                                     adam_minimize, observation_mask,
+                                     synthetic_diffusivity,
+                                     unit_reference_init)
+from heat2d_tpu.diff.serving import (InverseEngine, InverseRequest,
+                                     InverseResult)
+from heat2d_tpu.diff.vocab import ADJOINTS, COEFFS, METHODS, TARGETS
+
+#: adjoint.py is jax-heavy; everything above imports without jax, so a
+#: client that only builds/hashes InverseRequests (the admission path
+#: serving.py keeps jax-free) never pays the jax import. The adjoint
+#: names resolve lazily on first access (PEP 562).
+_ADJOINT_EXPORTS = ("DiffSpec", "make_diff_solve", "segment_schedule")
+
+
+def __getattr__(name):
+    if name in _ADJOINT_EXPORTS:
+        from heat2d_tpu.diff import adjoint
+        return getattr(adjoint, name)
+    raise AttributeError(
+        f"module {__name__!r} has no attribute {name!r}")
+
+__all__ = [
+    "ADJOINTS",
+    "COEFFS",
+    "METHODS",
+    "TARGETS",
+    "DiffSpec",
+    "InverseEngine",
+    "InverseProblem",
+    "InverseRequest",
+    "InverseResult",
+    "InverseSolution",
+    "adam_minimize",
+    "make_diff_solve",
+    "observation_mask",
+    "segment_schedule",
+    "synthetic_diffusivity",
+    "unit_reference_init",
+]

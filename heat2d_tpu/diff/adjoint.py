@@ -1,0 +1,281 @@
+"""The differentiable forward operator — checkpointed-segment adjoint.
+
+``jax.grad`` of a plain ``lax.fori_loop`` forward converts the loop to
+a scan and stores every one of the T step states for the backward pass
+— O(T) device memory, which is exactly what makes long-horizon adjoints
+infeasible on big grids. This module overrides reverse-mode with a
+``custom_vjp`` whose storage is a *choice*:
+
+- ``adjoint="checkpoint"`` (default): the forward stashes only every
+  K-th state (the segment starts — K defaults to ~sqrt(T), the
+  memory-optimal single-level schedule); the backward sweep walks the
+  segments in reverse, RECOMPUTES each segment's K intermediate states
+  from its stored start (one extra forward pass in total), then pulls
+  the cotangent back step by step. Memory O(T/K + K), compute ~2x
+  forward.
+- ``adjoint="full"``: the reference — store all T states
+  (``models.engine.run_fixed_stacked``), recompute nothing. Memory
+  O(T), compute ~1x. The backward sweep walks the SAME segment
+  schedule slicing the stored trajectory, so for the jnp step route
+  the two adjoints are bitwise-identical gradient for gradient (the
+  checkpointed recompute is deterministic) — tests pin this.
+
+Both routes differentiate with respect to the initial state AND the
+coefficients. Two coefficient forms:
+
+- ``coeff="const"``: scalar (cx, cy) — the reference's diffusivities as
+  traced operands.
+- ``coeff="var"``: per-cell (kx, ky) fields (``ops.stencil_step_var``)
+  — the heterogeneous-material route the inverse driver recovers.
+
+Primal fusion: the forward (and the stored checkpoints) advance through
+``method="jnp"`` (per-step ``lax.fori_loop``) or ``method="band"`` (the
+batched temporally-blocked band kernel, B=1 — constant coefficients
+only). The band route plans through ``ops._resolve_bands`` and
+therefore consults the tuning db (``HEAT2D_TUNE_DB``) exactly like the
+serve warm path; its FMA step form deviates from the jnp literal form
+at f32-ulp, so the bitwise full-vs-checkpoint guarantee is pinned on
+the jnp route (docs/DIFFERENTIABLE.md). ``method="auto"`` picks band
+only on a real TPU backend for HBM-sized grids.
+
+The per-step pullback uses ``jax.vjp`` of the SAME step function the
+forward ran, linearized at the stored/recomputed state, so gradient
+parity against central finite differences holds to f32 tolerance on
+both coefficient routes (tests/test_diff.py).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from heat2d_tpu.diff.vocab import ADJOINTS, COEFFS, METHODS
+from heat2d_tpu.models.engine import run_fixed_stacked
+from heat2d_tpu.ops.stencil import stencil_step, stencil_step_var
+
+
+def segment_schedule(steps: int, segment=None) -> tuple:
+    """The checkpointed-segment schedule: ``steps`` split into segment
+    lengths (full segments of ``segment`` steps plus one remainder).
+    ``segment=None`` picks ~sqrt(steps), minimizing stored + recomputed
+    states for the single-level scheme. Returns a tuple summing to
+    ``steps`` (empty for steps=0)."""
+    if steps < 0:
+        raise ValueError(f"steps must be >= 0, got {steps}")
+    if steps == 0:
+        return ()
+    if segment is None:
+        segment = max(1, int(round(math.sqrt(steps))))
+    segment = int(segment)
+    if segment < 1:
+        raise ValueError(f"segment must be >= 1, got {segment}")
+    n_full, rem = divmod(steps, segment)
+    return (segment,) * n_full + ((rem,) if rem else ())
+
+
+@dataclasses.dataclass(frozen=True)
+class DiffSpec:
+    """Static spec of one differentiable solve — hashable, so it rides
+    as a ``custom_vjp`` nondiff argument and keys jit caches."""
+    nx: int
+    ny: int
+    steps: int
+    coeff: str = "const"          # "const" (scalar cx, cy) | "var" (fields)
+    adjoint: str = "checkpoint"   # "checkpoint" | "full"
+    schedule: tuple = ()          # segment lengths (sum == steps)
+    method: str = "jnp"           # primal multi-step route (resolved)
+
+
+# --------------------------------------------------------------------- #
+# step / multi-step primitives
+# --------------------------------------------------------------------- #
+
+def _step(spec: DiffSpec, u, a, b):
+    """One forward step. ``accum_dtype=None``: accumulate in u's dtype
+    (pure-f32 fast path; true f64 under x64 instead of a silent
+    truncation through float32)."""
+    if spec.coeff == "const":
+        return stencil_step(u, a, b, accum_dtype=None)
+    return stencil_step_var(u, a, b)
+
+
+def _multi(spec: DiffSpec, u, a, b, n: int):
+    """Advance ``n`` steps WITHOUT storing intermediates — the fused
+    primal. The band route runs the batched temporally-blocked kernel
+    (B=1; (cx, cy) ride as traced SMEM scalars) and consults the
+    tuning db through ``ops._resolve_bands`` like every band plan."""
+    if n == 0:
+        return u
+    if spec.method == "band" and spec.coeff == "const":
+        from heat2d_tpu.models.ensemble import _run_batch_band
+        dt = u.dtype
+        return _run_batch_band(
+            u[None], jnp.reshape(jnp.asarray(a, dt), (1,)),
+            jnp.reshape(jnp.asarray(b, dt), (1,)), steps=n)[0]
+    return lax.fori_loop(0, n, lambda _, v: _step(spec, v, a, b), u,
+                         unroll=False)
+
+
+def _segment_states(spec: DiffSpec, u, a, b, n: int):
+    """(u_after_n, states): the recompute primitive — ``states[t]`` is
+    the input of step t (``states[0] == u``). One scan of the SAME step
+    the full-storage forward runs, so recomputed states are bitwise the
+    stored ones."""
+    return run_fixed_stacked(lambda v: _step(spec, v, a, b), u, n)
+
+
+# --------------------------------------------------------------------- #
+# the custom-VJP operator
+# --------------------------------------------------------------------- #
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(0,))
+def _diff_solve(spec: DiffSpec, u0, a, b):
+    # Primal (autodiff unused): the fused forward only — no residual
+    # storage, no checkpoint stash. jit of this path costs exactly the
+    # fused multi-step.
+    return _multi(spec, u0, a, b, spec.steps)
+
+
+def _diff_solve_fwd(spec: DiffSpec, u0, a, b):
+    if spec.adjoint == "full":
+        u_final, states = _segment_states(spec, u0, a, b, spec.steps)
+        return u_final, (states, a, b)
+    # Checkpointed: stash only the segment-start states (every K-th).
+    ck = [u0]
+    u = u0
+    for k in spec.schedule[:-1]:
+        u = _multi(spec, u, a, b, k)
+        ck.append(u)
+    u_final = (_multi(spec, u, a, b, spec.schedule[-1])
+               if spec.schedule else u)
+    return u_final, (jnp.stack(ck), a, b)
+
+
+def _diff_solve_bwd(spec: DiffSpec, res, wbar):
+    stored, a, b = res
+    ga = jnp.zeros_like(a)
+    gb = jnp.zeros_like(b)
+
+    def step_for_vjp(u, aa, bb):
+        return _step(spec, u, aa, bb)
+
+    def pullback(carry, u_t):
+        w, ga, gb = carry
+        _, vjp = jax.vjp(step_for_vjp, u_t, a, b)
+        du, da, db = vjp(w)
+        return (du, ga + da, gb + db), None
+
+    carry = (wbar, ga, gb)
+    starts = []
+    t = 0
+    for k in spec.schedule:
+        starts.append(t)
+        t += k
+    for i in reversed(range(len(spec.schedule))):
+        n = spec.schedule[i]
+        if spec.adjoint == "full":
+            seg_states = stored[starts[i]:starts[i] + n]
+        else:
+            # Recompute this segment's states from its stored start —
+            # the same scan the full-storage forward ran, so the
+            # linearization points are bitwise identical.
+            _, seg_states = _segment_states(spec, stored[i], a, b, n)
+        carry, _ = lax.scan(pullback, carry, seg_states, reverse=True)
+    du0, ga, gb = carry
+    return du0, ga, gb
+
+
+_diff_solve.defvjp(_diff_solve_fwd, _diff_solve_bwd)
+
+
+# --------------------------------------------------------------------- #
+# public entry
+# --------------------------------------------------------------------- #
+
+def _resolve_method(method: str, nx: int, ny: int, coeff: str,
+                    adjoint: str) -> str:
+    if method not in METHODS:
+        raise ValueError(f"method must be one of {METHODS}, got {method!r}")
+    if coeff == "var":
+        if method == "band":
+            raise ValueError(
+                "method='band' supports coeff='const' only (the band "
+                "kernels take scalar diffusivities; the variable-"
+                "coefficient route runs the jnp step)")
+        return "jnp"
+    if adjoint == "full":
+        # Full storage records EVERY step state — its forward is
+        # necessarily the per-step scan, and custom_vjp's fwd must
+        # reproduce the primal bit for bit, so the fused band primal
+        # (FMA step form, f32-ulp off the scan) is not composable
+        # with it. The fused primal is the checkpointed adjoint's
+        # domain; full storage is the per-step reference.
+        if method == "band":
+            raise ValueError(
+                "adjoint='full' records every step state (per-step "
+                "scan); it cannot run the fused band primal — use "
+                "adjoint='checkpoint' with method='band', or "
+                "method='jnp'")
+        return "jnp"
+    if method != "auto":
+        return method
+    from heat2d_tpu.ops.pallas_stencil import _on_tpu, fits_vmem
+    if _on_tpu() and not fits_vmem((nx, ny)):
+        return "band"
+    return "jnp"
+
+
+def make_diff_solve(nx: int, ny: int, steps: int, *, coeff: str = "const",
+                    adjoint: str = "checkpoint", segment=None,
+                    method: str = "auto"):
+    """Build the differentiable solve ``f(u0, a, b) -> u_final``.
+
+    ``u0`` is the (nx, ny) initial grid; ``(a, b)`` are scalar
+    ``(cx, cy)`` for ``coeff="const"`` or per-cell ``(kx, ky)`` fields
+    for ``coeff="var"``. The returned callable is differentiable in all
+    three arguments (``jax.grad``/``jax.vjp``/``jax.jit`` compose), and
+    its reverse-mode memory follows ``adjoint``/``segment`` — see the
+    module docstring and docs/DIFFERENTIABLE.md.
+    """
+    if nx < 3 or ny < 3:
+        raise ValueError(f"grid must be at least 3x3, got {nx}x{ny}")
+    if coeff not in COEFFS:
+        raise ValueError(f"coeff must be one of {COEFFS}, got {coeff!r}")
+    if adjoint not in ADJOINTS:
+        raise ValueError(
+            f"adjoint must be one of {ADJOINTS}, got {adjoint!r}")
+    spec = DiffSpec(nx=int(nx), ny=int(ny), steps=int(steps), coeff=coeff,
+                    adjoint=adjoint,
+                    schedule=segment_schedule(steps, segment),
+                    method=_resolve_method(method, nx, ny, coeff,
+                                           adjoint))
+    if spec.method == "band":
+        # Pre-resolve the tuning db's answer for the fused segments
+        # (the band plan consults it again at trace time through
+        # ops._resolve_bands) so applied-config provenance reaches run
+        # records before the first compile — the serve engine's
+        # _preresolve_tuned pattern.
+        from heat2d_tpu.tune import runtime as tune_runtime
+        tune_runtime.adjoint_config(nx, ny)
+
+    def solve(u0, a, b):
+        u0 = jnp.asarray(u0)
+        if u0.shape != (spec.nx, spec.ny):
+            raise ValueError(
+                f"u0 must be ({spec.nx}, {spec.ny}), got {u0.shape}")
+        a = jnp.asarray(a, u0.dtype)
+        b = jnp.asarray(b, u0.dtype)
+        want = () if spec.coeff == "const" else (spec.nx, spec.ny)
+        if a.shape != want or b.shape != want:
+            raise ValueError(
+                f"coeff={spec.coeff!r} takes coefficient shape {want}, "
+                f"got {a.shape}/{b.shape}")
+        return _diff_solve(spec, u0, a, b)
+
+    solve.spec = spec
+    return solve

@@ -1,0 +1,303 @@
+"""Inverse-problem driver — recover fields from sparse observations.
+
+The reference pipeline (and every PR before this one) runs the heat
+equation *forward*: coefficients in, final temperature out. This module
+runs it backward: given sparse observations of the final state, recover
+either
+
+- ``target="init"``        — the initial condition ``u0`` (the known
+                             (cx, cy) constant-coefficient route), or
+- ``target="diffusivity"`` — a per-cell isotropic diffusivity field
+                             ``kappa`` (``kx = ky = kappa``, the
+                             variable-coefficient route of
+                             ``ops.stencil_step_var``),
+
+by Adam (or plain gradient descent) on the differentiable solve
+(``diff.adjoint.make_diff_solve``). The loss is the mean squared
+mismatch over observed cells, optionally Tikhonov-regularized; the
+diffusivity route projects every iterate into the explicit-scheme
+stability box ``kappa in [k_min, 0.24]`` (``kx + ky <= 1/2``).
+
+Telemetry: every iteration streams ``inverse_loss`` and
+``inverse_grad_norm`` series points plus an ``inverse_iterations_total``
+counter through the obs/ metrics registry (docs/OBSERVABILITY.md) — the
+optimization trajectory is first-class observable exactly like the
+convergence-residual trajectory of a forward solve.
+
+The optimizer is a host loop over one jitted ``value_and_grad`` — the
+per-iteration solve+adjoint is one compiled program (compiled once per
+signature), and the host only sees two scalars per iteration plus the
+final field. Best-so-far parameters are tracked as HOST copies via
+``resil.snapshot_state`` (the same snapshot primitive the async
+checkpointer uses), so a diverging tail never loses the best iterate.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Callable, Optional
+
+import numpy as np
+
+from heat2d_tpu.diff.vocab import TARGETS
+
+#: Stability box for the projected diffusivity iterates: the explicit
+#: scheme needs kx + ky <= 1/2, i.e. isotropic kappa <= 1/4; 0.24
+#: leaves margin, and the floor keeps the field physical (kappa >= 0)
+#: and the solve sensitive to it.
+KAPPA_MIN, KAPPA_MAX = 1e-4, 0.24
+
+
+def synthetic_diffusivity(nx: int, ny: int, base: float = 0.08,
+                          bump: float = 0.08) -> np.ndarray:
+    """A smooth known kappa field for selftests/CI: ``base`` plus an
+    off-center Gaussian bump of height ``bump``, everywhere inside the
+    stability box. The recovery target of ``--selftest``."""
+    ix = np.arange(nx, dtype=np.float32)[:, None]
+    iy = np.arange(ny, dtype=np.float32)[None, :]
+    gx = np.exp(-((ix - nx / 3.0) ** 2) / (2 * (nx / 6.0) ** 2))
+    gy = np.exp(-((iy - 2 * ny / 3.0) ** 2) / (2 * (ny / 6.0) ** 2))
+    return (base + bump * gx * gy).astype(np.float32)
+
+
+def unit_reference_init(nx: int, ny: int) -> np.ndarray:
+    """The reference initial condition (``ops.init.inidat``) scaled to
+    unit peak — the canonical KNOWN init of serving-path diffusivity
+    recoveries (``diff.serving.InverseEngine``). The raw inidat peaks
+    at ~(nx·ny/4)² and squares into the loss; unit peak keeps losses
+    O(1) so request-level ``tol`` thresholds mean the same thing at
+    every grid size."""
+    from heat2d_tpu.ops.init import inidat
+    u0 = np.asarray(inidat(nx, ny))
+    return (u0 / u0.max()).astype(np.float32)
+
+
+def observation_mask(nx: int, ny: int, every: int = 3) -> np.ndarray:
+    """Sparse interior observation mask: every ``every``-th interior
+    cell (edges are boundary-held and carry no information)."""
+    if every < 1:
+        raise ValueError(f"every must be >= 1, got {every}")
+    m = np.zeros((nx, ny), dtype=bool)
+    m[1:-1:every, 1:-1:every] = True
+    return m
+
+
+@functools.lru_cache(maxsize=64)
+def loss_grad_runner(nx: int, ny: int, steps: int, target: str,
+                     adjoint: str, segment: Optional[int], method: str,
+                     reg_on: bool) -> Callable:
+    """The per-COMPILE-SIGNATURE memoized ``jax.jit(value_and_grad)``
+    of the observation-mismatch loss — the inverse analogue of
+    ``models.ensemble.batch_runner``. Everything problem-specific that
+    does NOT change the traced program rides as operands:
+
+    ``runner(params, *, aux, mask, obs, n_obs, reg) -> (loss, grad)``
+
+    where ``aux`` is ``(cx, cy)`` scalars for ``target="init"`` (params
+    is the candidate u0) or ``(u0,)`` for ``target="diffusivity"``
+    (params is the candidate kappa field). ``reg_on`` is a static key:
+    with regularization off the traced program carries no dead
+    regularization term."""
+    import jax
+    import jax.numpy as jnp
+
+    from heat2d_tpu.diff.adjoint import make_diff_solve
+
+    coeff = "const" if target == "init" else "var"
+    solve = make_diff_solve(nx, ny, steps, coeff=coeff, adjoint=adjoint,
+                            segment=segment, method=method)
+
+    def loss(params, aux, mask, obs, n_obs, reg):
+        if target == "init":
+            u = solve(params, aux[0], aux[1])
+        else:
+            u = solve(aux[0], params, params)
+        r = (u - obs) * mask
+        out = jnp.sum(r * r) / n_obs
+        if reg_on:
+            out = out + reg * jnp.mean(params * params)
+        return out
+
+    return jax.jit(jax.value_and_grad(loss))
+
+
+@dataclasses.dataclass
+class InverseSolution:
+    """One finished inverse solve. ``params`` is the best-loss iterate
+    (host numpy), not necessarily the last."""
+    params: np.ndarray
+    final_loss: float
+    iterations: int
+    converged: bool
+    grad_norm: float
+    loss_history: list
+    grad_norm_history: list
+
+
+def adam_minimize(value_and_grad: Callable, params0, *,
+                  iterations: int = 100, lr: float = 0.05,
+                  beta1: float = 0.9, beta2: float = 0.999,
+                  eps: float = 1e-8, project: Optional[Callable] = None,
+                  tol: Optional[float] = None, registry=None,
+                  series_labels: Optional[dict] = None,
+                  progress: Optional[Callable] = None) -> InverseSolution:
+    """Adam with optional projection and early stop.
+
+    ``value_and_grad(params) -> (loss, grad)`` (typically jitted);
+    ``project(params) -> params`` clamps each iterate (stability box);
+    ``tol`` stops early once ``loss <= tol`` (sets ``converged``);
+    ``registry``/``series_labels`` stream the per-iteration
+    ``inverse_loss`` / ``inverse_grad_norm`` series; ``progress`` is an
+    optional host callback ``(iteration, loss, grad_norm)``.
+    """
+    import jax.numpy as jnp
+
+    from heat2d_tpu.resil.snapshot import snapshot_state
+
+    if iterations < 1:
+        raise ValueError(f"iterations must be >= 1, got {iterations}")
+    params = jnp.asarray(params0)
+    m = jnp.zeros_like(params)
+    v = jnp.zeros_like(params)
+    labels = dict(series_labels or {})
+    loss_hist: list = []
+    gn_hist: list = []
+    best_loss = float("inf")
+    # dtype=None: the snapshot keeps the optimization's dtype — an f64
+    # run's best iterate must not truncate through float32.
+    best = snapshot_state(params, dtype=None)
+    converged = False
+    it = 0
+    for it in range(1, iterations + 1):
+        loss, g = value_and_grad(params)
+        loss = float(loss)
+        gn = float(jnp.sqrt(jnp.sum(g * g)))
+        loss_hist.append(loss)
+        gn_hist.append(gn)
+        if registry is not None:
+            registry.series("inverse_loss", it, loss, **labels)
+            registry.series("inverse_grad_norm", it, gn, **labels)
+            registry.counter("inverse_iterations_total")
+        if progress is not None:
+            progress(it, loss, gn)
+        if loss < best_loss:
+            best_loss = loss
+            best = snapshot_state(params, dtype=None)
+        if tol is not None and loss <= tol:
+            converged = True
+            break
+        m = beta1 * m + (1.0 - beta1) * g
+        v = beta2 * v + (1.0 - beta2) * g * g
+        mhat = m / (1.0 - beta1 ** it)
+        vhat = v / (1.0 - beta2 ** it)
+        params = params - lr * mhat / (jnp.sqrt(vhat) + eps)
+        if project is not None:
+            params = project(params)
+    return InverseSolution(
+        params=best, final_loss=best_loss, iterations=it,
+        converged=converged, grad_norm=gn_hist[-1] if gn_hist else 0.0,
+        loss_history=loss_hist, grad_norm_history=gn_hist)
+
+
+@dataclasses.dataclass
+class InverseProblem:
+    """One inverse problem over final-state observations.
+
+    ``obs_mask`` (bool (nx, ny)) marks observed cells; ``obs_values``
+    holds the observed final-state values (only masked entries are
+    read). For ``target="init"`` the constant coefficients (cx, cy) are
+    known and ``u0`` is recovered; for ``target="diffusivity"`` the
+    initial condition is known (``u0``, defaulting to the reference
+    ``inidat``) and the isotropic per-cell ``kappa`` is recovered.
+    """
+    nx: int
+    ny: int
+    steps: int
+    target: str
+    obs_mask: np.ndarray
+    obs_values: np.ndarray
+    cx: float = 0.1
+    cy: float = 0.1
+    u0: Optional[np.ndarray] = None     # known init (diffusivity target)
+    reg: float = 0.0                    # Tikhonov weight on the params
+    adjoint: str = "checkpoint"
+    segment: Optional[int] = None
+    method: str = "auto"
+
+    def __post_init__(self):
+        if self.target not in TARGETS:
+            raise ValueError(
+                f"target must be one of {TARGETS}, got {self.target!r}")
+        if tuple(np.shape(self.obs_mask)) != (self.nx, self.ny) or \
+                tuple(np.shape(self.obs_values)) != (self.nx, self.ny):
+            raise ValueError(
+                f"obs_mask/obs_values must be ({self.nx}, {self.ny})")
+        if not bool(np.any(self.obs_mask)):
+            raise ValueError("obs_mask selects no cells")
+
+    # -- pieces the optimizer consumes --------------------------------- #
+
+    def known_u0(self):
+        from heat2d_tpu.ops.init import inidat
+        if self.u0 is not None:
+            return np.asarray(self.u0, np.float32)
+        return np.asarray(inidat(self.nx, self.ny))
+
+    def initial_params(self) -> np.ndarray:
+        """The optimizer's starting iterate: scattered observations for
+        the init target (right where the data is), a flat mid-box field
+        for diffusivity."""
+        if self.target == "init":
+            p = np.zeros((self.nx, self.ny), np.float32)
+            p[self.obs_mask] = np.asarray(self.obs_values,
+                                          np.float32)[self.obs_mask]
+            return p
+        return np.full((self.nx, self.ny), 0.1, np.float32)
+
+    def project(self) -> Optional[Callable]:
+        if self.target != "diffusivity":
+            return None
+        import jax.numpy as jnp
+
+        def clamp(p):
+            return jnp.clip(p, KAPPA_MIN, KAPPA_MAX)
+        return clamp
+
+    def value_and_grad(self) -> Callable:
+        """``params -> (loss, grad)``: the memoized compiled runner for
+        this problem's COMPILE signature, with the observation data,
+        known coefficients/init, and regularization weight bound as
+        traced OPERANDS. Two problems sharing (grid, steps, target,
+        adjoint, segment, method, reg-on/off) share ONE executable —
+        the property the serving layer's signature bucketing relies on
+        (a fresh closure per problem would recompile the whole
+        solve+adjoint per request)."""
+        import jax.numpy as jnp
+
+        runner = loss_grad_runner(self.nx, self.ny, self.steps,
+                                  self.target, self.adjoint,
+                                  self.segment, self.method,
+                                  bool(self.reg))
+        mask = jnp.asarray(np.asarray(self.obs_mask, np.float32))
+        obs = jnp.asarray(np.asarray(self.obs_values, np.float32))
+        n_obs = jnp.asarray(float(np.count_nonzero(self.obs_mask)),
+                            jnp.float32)
+        reg = jnp.asarray(float(self.reg), jnp.float32)
+        if self.target == "init":
+            aux = (jnp.asarray(float(self.cx), jnp.float32),
+                   jnp.asarray(float(self.cy), jnp.float32))
+        else:
+            aux = (jnp.asarray(self.known_u0()),)
+        return functools.partial(runner, aux=aux, mask=mask, obs=obs,
+                                 n_obs=n_obs, reg=reg)
+
+    def solve(self, *, iterations: int = 100, lr: float = 0.05,
+              tol: Optional[float] = None, registry=None,
+              series_labels: Optional[dict] = None,
+              progress: Optional[Callable] = None) -> InverseSolution:
+        return adam_minimize(
+            self.value_and_grad(), self.initial_params(),
+            iterations=iterations, lr=lr, tol=tol,
+            project=self.project(), registry=registry,
+            series_labels=series_labels, progress=progress)

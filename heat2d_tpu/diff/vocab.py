@@ -1,0 +1,19 @@
+"""The diff subsystem's shared vocabulary — ONE definition each.
+
+jax-free on purpose: ``serving.py`` validates requests on the
+admission path and ``inverse.py`` is importable without jax; both need
+these tuples, and ``adjoint.py`` (jax-heavy) is the wrong place to
+make them import from.
+"""
+
+#: coefficient forms of the differentiable solve
+COEFFS = ("const", "var")
+
+#: reverse-mode storage strategies
+ADJOINTS = ("checkpoint", "full")
+
+#: primal multi-step routes
+METHODS = ("auto", "jnp", "band")
+
+#: inverse-problem recovery targets
+TARGETS = ("init", "diffusivity")

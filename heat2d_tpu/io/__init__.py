@@ -14,6 +14,8 @@ from heat2d_tpu.io.binary import (
     read_binary,
     save_checkpoint,
     load_checkpoint,
+    save_field,
+    load_field,
 )
 
 __all__ = [
@@ -30,4 +32,6 @@ __all__ = [
     "read_binary",
     "save_checkpoint",
     "load_checkpoint",
+    "save_field",
+    "load_field",
 ]

@@ -154,6 +154,94 @@ def commit_checkpoint_files(tmp_path, path, step: int, config,
     _fsync_path(os.path.dirname(os.path.abspath(str(path))))
 
 
+#: dtypes an auxiliary field file may carry. Bools (observation masks)
+#: store as uint8 bytes with dtype "bool" in the sidecar — raw files
+#: stay dtype-pure and the loader restores the bool view.
+FIELD_DTYPES = ("float32", "float64", "int32", "uint8", "bool")
+
+FIELD_FORMAT = "heat2d-tpu-field-v1"
+
+
+def save_field(a, path, name: str = "field", extra=None) -> None:
+    """Auxiliary parameter field (diffusivity grid, observation mask,
+    recovered inverse solution) as a raw binary + digest sidecar —
+    the checkpoint protocol generalized past the float32 state grid:
+    staged to ``path + '.tmp'``, digested, atomically promoted, then
+    the ``.meta.json`` sidecar (shape, dtype, sha256, ``name``, any
+    ``extra`` keys) replaces the same way. ``load_field`` verifies the
+    digest, so a torn copy can never load as a valid field.
+    """
+    a = np.asarray(a)
+    dtype = "bool" if a.dtype == np.bool_ else str(a.dtype)
+    if dtype not in FIELD_DTYPES:
+        raise ValueError(
+            f"field dtype must be one of {FIELD_DTYPES}, got {a.dtype}")
+    raw = a.astype(np.uint8) if dtype == "bool" else a
+    tmp = checkpoint_tmp_path(path)
+    raw.tofile(tmp)
+    digest = _sha256_file(tmp)
+    _fsync_path(tmp)
+    os.replace(tmp, path)
+    meta = {
+        "format": FIELD_FORMAT,
+        "name": str(name),
+        "shape": [int(s) for s in a.shape],
+        "dtype": dtype,
+        "sha256": digest,
+        **(dict(extra) if extra else {}),
+    }
+    meta_path = str(path) + ".meta.json"
+    meta_tmp = meta_path + ".tmp"
+    with open(meta_tmp, "w") as f:
+        json.dump(meta, f, indent=2)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(meta_tmp, meta_path)
+    _fsync_path(os.path.dirname(os.path.abspath(str(path))))
+
+
+def load_field(path, verify: bool = True):
+    """Load an auxiliary field saved by ``save_field``. Returns
+    ``(array, meta)``; digest mismatch, truncation, or an unreadable
+    sidecar raise ``CheckpointCorruptError`` (``verify=False`` skips
+    the digest check)."""
+    meta_path = str(path) + ".meta.json"
+    try:
+        with open(meta_path) as f:
+            meta = json.load(f)
+        shape = tuple(int(s) for s in meta["shape"])
+        dtype = str(meta["dtype"])
+        digest = meta.get("sha256")
+    except (OSError, json.JSONDecodeError, KeyError, ValueError,
+            TypeError) as e:
+        raise CheckpointCorruptError(f"{path}: {e}") from e
+    if dtype not in FIELD_DTYPES:
+        raise CheckpointCorruptError(
+            f"{path}: sidecar dtype {dtype!r} not in {FIELD_DTYPES}")
+    try:
+        with open(path, "rb") as f:
+            buf = f.read()
+    except OSError as e:
+        raise CheckpointCorruptError(f"{path}: {e}") from e
+    if verify and digest is not None:
+        actual = hashlib.sha256(buf).hexdigest()
+        if actual != digest:
+            raise CheckpointCorruptError(
+                f"{path}: sha256 mismatch (sidecar {digest[:12]}…, file "
+                f"{actual[:12]}…) — torn or corrupt field file")
+    raw_dtype = np.uint8 if dtype == "bool" else np.dtype(dtype)
+    a = np.frombuffer(buf, dtype=raw_dtype)
+    expected = int(np.prod(shape)) if shape else 1
+    if a.size != expected:
+        raise CheckpointCorruptError(
+            f"{path}: expected {expected} {dtype} values for shape "
+            f"{shape}, found {a.size}")
+    a = a.reshape(shape).copy()
+    if dtype == "bool":
+        a = a.astype(np.bool_)
+    return a, meta
+
+
 def save_checkpoint(u, step: int, config, path, shape=None) -> None:
     """State dump + sidecar, committed CRASH-CONSISTENTLY: the binary is
     staged to ``path + '.tmp'`` and promoted with ``os.replace``, then

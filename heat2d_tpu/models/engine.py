@@ -56,6 +56,22 @@ def run_fixed(step_fn: Callable, u0, steps: int):
     return u, jnp.asarray(steps, jnp.int32)
 
 
+def run_fixed_stacked(step_fn: Callable, u0, steps: int):
+    """Run exactly ``steps`` steps, additionally returning the state
+    BEFORE each step stacked on a leading axis: ``states[t]`` is the
+    input of step ``t`` (``states[0] == u0``), so a reverse sweep can
+    linearize every step at its true evaluation point. This is the
+    trajectory store of the full-storage adjoint and the per-segment
+    recompute of the checkpointed adjoint (heat2d_tpu/diff) — O(steps)
+    memory, which is exactly the cost the checkpointed schedule
+    amortizes to O(steps/K + K). Returns (u_final, states)."""
+    def body(u, _):
+        return step_fn(u), u
+
+    u, states = lax.scan(body, u0, None, length=steps)
+    return u, states
+
+
 def run_convergence(step_fn: Callable, residual_fn: Callable, u0,
                     steps: int, interval: int, sensitivity: float,
                     tap: Optional[Callable] = None):

@@ -22,9 +22,12 @@ RECORD_SCHEMA = "heat2d-tpu/run-record/v1"
 #: (benchmark harnesses), "serve" (heat2d-tpu-serve: launch log +
 #: serving telemetry snapshot rides in the same JSONL), "tune"
 #: (heat2d-tpu-tune: search summary + tune_* metric families), "fleet"
-#: (heat2d-tpu-fleet: supervisor/soak summary + fleet_* families).
+#: (heat2d-tpu-fleet: supervisor/soak summary + fleet_* families),
+#: "inverse" (heat2d-tpu-inverse: recovery summary — iteration count,
+#: final loss, convergence flag — + the inverse_* metric families and
+#: per-iteration loss/grad-norm series).
 RECORD_KINDS = ("run", "ensemble", "bench", "sweep", "serve", "tune",
-                "fleet")
+                "fleet", "inverse")
 
 
 def run_context() -> dict:

@@ -2,6 +2,7 @@ from heat2d_tpu.ops.init import inidat, inidat_block
 from heat2d_tpu.ops.stencil import (
     stencil_step,
     stencil_step_padded,
+    stencil_step_var,
     residual_sq,
 )
 
@@ -10,5 +11,6 @@ __all__ = [
     "inidat_block",
     "stencil_step",
     "stencil_step_padded",
+    "stencil_step_var",
     "residual_sq",
 ]

@@ -68,6 +68,35 @@ def stencil_step_padded(padded: jnp.ndarray, cx: float, cy: float,
     return _laplacian_update(padded, cx, cy, accum_dtype).astype(padded.dtype)
 
 
+def stencil_step_var(u: jnp.ndarray, kx: jnp.ndarray, ky: jnp.ndarray,
+                     accum_dtype=None) -> jnp.ndarray:
+    """One global time step with PER-CELL diffusivities — the
+    variable-coefficient (heterogeneous-material) forward update, and
+    the second differentiable route of ``heat2d_tpu/diff``.
+
+    ``kx``/``ky`` are full (nx, ny) fields; cell (i, j)'s update uses
+    ``kx[i, j]``/``ky[i, j]`` exactly where the constant route uses
+    cx/cy, so ``stencil_step_var(u, full(cx), full(cy))`` is bitwise
+    ``stencil_step(u, cx, cy, accum_dtype=None)``. Edge cells are held
+    (clamped BC), identical to ``stencil_step``; edge values of the
+    coefficient fields are therefore inert. ``accum_dtype=None``
+    accumulates in u's dtype (the all-f32 TPU-fast evaluation; pass
+    float64 under x64 for the C-promotion semantics).
+
+    Stability note (docs/DIFFERENTIABLE.md): the explicit scheme needs
+    ``kx + ky <= 1/2`` pointwise; the inverse driver projects its
+    recovered fields into that box after every optimizer step.
+    """
+    accum = u.dtype if accum_dtype is None else accum_dtype
+    c = u[1:-1, 1:-1].astype(accum)
+    sx = (u[2:, 1:-1] + u[:-2, 1:-1]).astype(accum)
+    sy = (u[1:-1, 2:] + u[1:-1, :-2]).astype(accum)
+    kxi = kx[1:-1, 1:-1].astype(accum)
+    kyi = ky[1:-1, 1:-1].astype(accum)
+    new_interior = c + kxi * (sx - 2.0 * c) + kyi * (sy - 2.0 * c)
+    return u.at[1:-1, 1:-1].set(new_interior.astype(u.dtype))
+
+
 def residual_sq(u_new: jnp.ndarray, u_old: jnp.ndarray,
                 accum_dtype=jnp.float32) -> jnp.ndarray:
     """Local convergence residual: sum of squared per-cell deltas.

@@ -46,6 +46,7 @@ from heat2d_tpu.resil.manager import CheckpointManager, is_manager_dir
 from heat2d_tpu.resil.retry import (DegradedMode, RetryPolicy,
                                     TransientError, Watchdog,
                                     call_with_retries, default_transient)
+from heat2d_tpu.resil.snapshot import snapshot_shards, snapshot_state
 from heat2d_tpu.resil.writer import AsyncCheckpointer
 
 __all__ = [
@@ -61,4 +62,6 @@ __all__ = [
     "call_with_retries",
     "default_transient",
     "is_manager_dir",
+    "snapshot_shards",
+    "snapshot_state",
 ]

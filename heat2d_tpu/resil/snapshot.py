@@ -1,0 +1,52 @@
+"""In-memory state snapshots — the device->host step of a checkpoint.
+
+Factored out of ``AsyncCheckpointer`` (which pairs these with staged
+file writes and atomic commits) so other consumers can reuse the ONE
+snapshot definition without the I/O half: the differentiable-solve
+subsystem tracks best-so-far optimizer iterates with
+``snapshot_state`` (heat2d_tpu/diff/inverse.py), and the writer's
+local/collective save paths both call in here. Pure host-side copies —
+nothing touches a traced value, and the returned arrays never alias
+device buffers (mutating them cannot corrupt a later checkpoint).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def snapshot_state(u, shape=None, dtype=np.float32) -> np.ndarray:
+    """Host-resident copy of a fully-addressable array, optionally
+    cropped to ``shape`` (the equal-shard padding strip of uneven
+    decompositions). The snapshot half of a local checkpoint: cheap
+    (one device->host copy), no file I/O. ``dtype`` defaults to the
+    checkpoint format's float32; pass ``None`` to keep the source
+    dtype (the optimizer's best-iterate tracking must not truncate an
+    f64 run through f32)."""
+    host = np.asarray(u, dtype=dtype)
+    if shape is not None and tuple(host.shape) != tuple(shape):
+        host = host[tuple(slice(0, s) for s in shape)]
+    # np.asarray may return a zero-copy view of a host-backed array;
+    # a snapshot must own its data (the caller will keep it across
+    # further device mutation / optimizer steps).
+    if host.base is not None or (isinstance(u, np.ndarray)
+                                 and np.shares_memory(host, u)):
+        host = host.copy()
+    return host
+
+
+def snapshot_shards(u) -> list:
+    """Per-shard host blocks of a (possibly host-spanning) jax.Array:
+    ``[(row0, col0, block), ...]`` for this process's addressable
+    shards, replica 0 only — the snapshot half of a collective
+    checkpoint (the writer's background thread turns these into
+    memmap writes at their global offsets). No collectives here: safe
+    to call from any thread."""
+    blocks = []
+    for sh in u.addressable_shards:
+        if sh.replica_id != 0:
+            continue
+        rs, cs = sh.index
+        blocks.append((rs.start or 0, cs.start or 0,
+                       np.asarray(sh.data, dtype=np.float32)))
+    return blocks

@@ -45,6 +45,7 @@ import numpy as np
 from heat2d_tpu.io.binary import (checkpoint_tmp_path,
                                   commit_checkpoint_files, write_binary)
 from heat2d_tpu.resil.manager import CheckpointManager
+from heat2d_tpu.resil.snapshot import snapshot_shards, snapshot_state
 
 log = logging.getLogger("heat2d_tpu.resil")
 
@@ -131,9 +132,7 @@ class AsyncCheckpointer:
         import jax
         if jax.process_index() != 0:
             return
-        host = np.asarray(u)
-        if self.shape is not None and tuple(host.shape) != self.shape:
-            host = host[:self.shape[0], :self.shape[1]]
+        host = snapshot_state(u, shape=self.shape)
         path = self._path_for(step)
         self._future = self._pool.submit(
             self._write_and_commit, host, step, path)
@@ -168,13 +167,7 @@ class AsyncCheckpointer:
                 f.truncate(nx * ny * 4)
         self._barrier(f"async-ckpt:create:{tmp}")
         # Rank-local snapshot (device->host copy, no collective).
-        blocks = []
-        for sh in u.addressable_shards:
-            if sh.replica_id != 0:
-                continue
-            rs, cs = sh.index
-            blocks.append((rs.start or 0, cs.start or 0,
-                           np.asarray(sh.data, dtype=np.float32)))
+        blocks = snapshot_shards(u)
         self._future = self._pool.submit(
             self._write_blocks, tmp, blocks, nx, ny)
         self._pending = _PendingCommit(

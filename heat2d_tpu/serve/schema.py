@@ -151,6 +151,14 @@ class SolveResult:
     coalesced: bool = False
     batch_size: int = 1
 
+    def as_cache_hit(self) -> "SolveResult":
+        """The stored result re-labeled for a cache-hit answer (the
+        grid is shared, not copied) — part of the generic serving
+        protocol every cacheable result type implements
+        (InverseResult mirrors it)."""
+        return dataclasses.replace(self, cache_hit=True,
+                                   coalesced=False)
+
     def summary(self) -> dict:
         """JSON-safe row for the CLI's results stream (the grid itself
         stays out — final_m<i>.dat-style dumps are the CLI's job)."""

@@ -39,8 +39,9 @@ engine layers record (docs/SERVING.md + docs/RESILIENCE.md tables).
 from __future__ import annotations
 
 import dataclasses
+import threading
 import time
-from concurrent.futures import Future
+from concurrent.futures import Future, ThreadPoolExecutor
 from typing import Optional
 
 from heat2d_tpu.resil.retry import (DegradedMode, RetryPolicy, Watchdog,
@@ -76,6 +77,15 @@ class SolveServer:
         self.flight = SingleFlight(registry=registry)
         self.engine = EnsembleEngine(registry=registry,
                                      max_batch=max_batch)
+        #: lazily-built inverse engine + its dedicated dispatch lane
+        #: (heat2d_tpu/diff): optimization loops are long-lived host
+        #: work, so they run on their own single-worker thread — an
+        #: InverseRequest can never head-of-line-block solve launches
+        #: on the scheduler thread. The stop event interrupts a
+        #: running loop at its next iteration on non-drain shutdown.
+        self._inv_engine = None
+        self._inv_pool = None
+        self._inv_stop = threading.Event()
         self.batcher = MicroBatcher(self._dispatch, max_batch=max_batch,
                                     max_delay=max_delay,
                                     max_queue=max_queue,
@@ -85,6 +95,7 @@ class SolveServer:
     # -- lifecycle ----------------------------------------------------- #
 
     def start(self) -> "SolveServer":
+        self._inv_stop.clear()
         self.batcher.start()
         self._started = True
         return self
@@ -93,10 +104,20 @@ class SolveServer:
         """Stop serving. ``drain=True`` is the graceful path (rolling
         worker restarts): admission closes, queued buckets flush, and
         every in-flight future is resolved before this returns — no
-        admitted request is dropped across a drain. Default (False)
-        rejects whatever is still queued with ``Rejected("shutdown")``."""
+        admitted request is dropped across a drain (inverse
+        optimizations run to completion). Default (False) rejects
+        whatever is still queued with ``Rejected("shutdown")`` and
+        interrupts a running inverse loop at its next iteration."""
         self._started = False
+        if not drain:
+            self._inv_stop.set()
         self.batcher.stop(drain=drain)
+        pool, self._inv_pool = self._inv_pool, None
+        if pool is not None:
+            # Joins the inverse lane: on drain every dispatched loop
+            # finished; otherwise the stop event aborts it within one
+            # iteration — either way all futures are resolved here.
+            pool.shutdown(wait=True)
 
     def __enter__(self) -> "SolveServer":
         return self.start()
@@ -109,7 +130,14 @@ class SolveServer:
     def submit(self, req: SolveRequest,
                timeout: Optional[float] = None) -> Future:
         """Admit one request; the returned future resolves to a
-        ``SolveResult`` or fails with a structured ``Rejected``."""
+        ``SolveResult`` or fails with a structured ``Rejected``.
+
+        Accepts any request implementing the serving protocol
+        (``validate``/``content_hash``/``signature``): plain solves
+        dispatch to the ensemble engine, requests tagged
+        ``request_kind == "inverse"`` (heat2d_tpu/diff) run their
+        optimization loop through the same cache, single-flight,
+        admission control, and retry/watchdog/breaker plumbing."""
         t0 = time.monotonic()
         timeout = self.default_timeout if timeout is None else timeout
         try:
@@ -122,13 +150,13 @@ class SolveServer:
         hit = self.cache.get(key)
         if hit is not None:
             # Cache hits are served even in degraded mode: the breaker
-            # sheds COMPUTE, not answers we already hold.
+            # sheds COMPUTE, not answers we already hold. as_cache_hit
+            # is the generic relabel every cacheable result type
+            # (SolveResult, diff's InverseResult) implements.
             self._count("cache_hit")
             self._latency(t0)
             fut = Future()
-            fut.set_result(SolveResult(
-                u=hit.u, steps_done=hit.steps_done, content_hash=key,
-                cache_hit=True, batch_size=hit.batch_size))
+            fut.set_result(hit.as_cache_hit())
             return fut
 
         fut, leader = self.flight.claim(key)
@@ -177,14 +205,50 @@ class SolveServer:
 
     # -- dispatch (scheduler thread) ----------------------------------- #
 
+    def _inverse_engine(self):
+        """The inverse-request executor, built on first use — the serve
+        package never imports heat2d_tpu/diff unless inverse traffic
+        actually arrives. The engine aborts a loop (structured
+        ``Rejected``) when it outlives ``launch_deadline`` or a
+        non-drain stop is requested."""
+        if self._inv_engine is None:
+            from heat2d_tpu.diff.serving import InverseEngine
+            self._inv_engine = InverseEngine(registry=self.registry,
+                                             deadline=self.launch_deadline,
+                                             stop_event=self._inv_stop)
+        return self._inv_engine
+
+    def _inverse_pool(self) -> ThreadPoolExecutor:
+        if self._inv_pool is None:
+            self._inv_pool = ThreadPoolExecutor(
+                max_workers=1, thread_name_prefix="heat2d-serve-inverse")
+        return self._inv_pool
+
     def _dispatch(self, sig, batch) -> None:
+        """Scheduler-thread entry: solve buckets run inline; inverse
+        buckets hop to the dedicated lane so a multi-minute
+        optimization loop cannot starve solve traffic into queue
+        timeouts (every request the lane is handed is still delivered
+        or failed by ``_dispatch_batch``)."""
+        kind = getattr(batch[0].req, "request_kind", "solve")
+        if kind == "inverse":
+            self._inverse_pool().submit(self._dispatch_batch, sig,
+                                        batch, kind)
+            return
+        self._dispatch_batch(sig, batch, kind)
+
+    def _dispatch_batch(self, sig, batch, kind) -> None:
         """Bucket -> one launch (retried, watchdogged) -> per-request
         results. Transient launch failures retry with capped backoff;
         a launch that outlives ``launch_deadline`` has its waiters
         failed with ``Rejected("watchdog_timeout")`` by the watchdog
         thread (the launch itself keeps running — if it eventually
         returns, its results still warm the cache). Terminal failures
-        fail every member's flight entry and feed the breaker."""
+        fail every member's flight entry and feed the breaker.
+        Inverse buckets (``request_kind == "inverse"``) run their
+        optimization loops through the InverseEngine under the SAME
+        retry/watchdog/breaker plumbing; their results are
+        ``InverseResult`` objects that cache and resolve identically."""
         reqs = [p.req for p in batch]
 
         def on_timeout() -> None:
@@ -204,11 +268,13 @@ class SolveServer:
                 self.registry.counter("serve_retries_total")
                 self.registry.counter("serve_launch_failures_total")
 
+        engine = (self._inverse_engine() if kind == "inverse"
+                  else self.engine)
         watchdog = Watchdog(self.launch_deadline, on_timeout)
         try:
             with watchdog:
                 results = call_with_retries(
-                    lambda: self.engine.solve_batch(reqs),
+                    lambda: engine.solve_batch(reqs),
                     self.retry_policy, on_retry=on_retry)
         except BaseException as e:  # noqa: BLE001 — routed, not dropped
             if self.registry is not None:
@@ -227,10 +293,17 @@ class SolveServer:
             # and a success here would reset the breaker a consistently
             # too-slow backend deserves to trip
             self.breaker.record_success()
-        for p, (u, steps_done) in zip(batch, results):
-            res = SolveResult(u=u, steps_done=steps_done,
-                              content_hash=p.key,
-                              batch_size=len(batch))
+        for p, r in zip(batch, results):
+            if kind == "inverse":
+                # The engine already built the full result; stamp the
+                # serving labels (the flight key is authoritative).
+                res = dataclasses.replace(r, content_hash=p.key,
+                                          batch_size=len(batch))
+            else:
+                u, steps_done = r
+                res = SolveResult(u=u, steps_done=steps_done,
+                                  content_hash=p.key,
+                                  batch_size=len(batch))
             self.cache.put(p.key, res)
             self.flight.resolve(p.key, res)
             self._count("completed_late" if watchdog.fired

@@ -162,6 +162,22 @@ def band_config(nrows: int, ny: int, dtype="float32",
     return out
 
 
+def adjoint_config(nrows: int, ny: int,
+                   dtype="float32") -> Optional[TunedConfig]:
+    """The tuning db's answer for a differentiable solve's fused
+    forward/recompute segments (heat2d_tpu/diff). The adjoint's band
+    route compiles the LEGACY batched band kernel (B=1, traced scalar
+    coefficients — models/ensemble._run_batch_band), which plans
+    through ``ops._resolve_bands`` and so already CONSUMES the db at
+    trace time; this wrapper is the provenance twin: the same
+    ``allow_window=False`` lookup, surfaced so inverse run records can
+    carry ``tuned_config`` like every other record kind. None when no
+    db is active or the entry fails live re-validation — behavior
+    then falls back to the heuristic plan, bitwise (the jaxpr-pinned
+    contract)."""
+    return band_config(nrows, ny, dtype, allow_window=False)
+
+
 def _record_applied(nrows: int, ny: int, dtype: str,
                     cfg: TunedConfig) -> None:
     key = (nrows, ny, dtype)
