@@ -51,6 +51,15 @@ def build_parser() -> argparse.ArgumentParser:
                    help="wide-halo depth T for distributed modes: one "
                         "T-deep ghost exchange per T steps (default auto; "
                         "1 = the reference's per-step exchange)")
+    d.add_argument("--halo", default="collective",
+                   choices=["collective", "fused"],
+                   help="halo-exchange route: 'collective' = exchange-"
+                        "then-compute (a ppermute barrier per chunk); "
+                        "'fused' = overlap edge communication with the "
+                        "interior sweep (in-kernel ICI async copies on "
+                        "TPU, explicit inner/boundary split elsewhere; "
+                        "bitwise-identical results, degrades to "
+                        "collective where unsupported — docs/SCALING.md)")
     e = p.add_argument_group(
         "ensemble (batched parameter sweep — one launch advances every "
         "(cx, cy) member; the reference needed one compile+run per "
@@ -333,7 +342,7 @@ def _run_ensemble_cli(args, cfg) -> int:
             cfg.nxprob, cfg.nyprob, cfg.steps, cxs, cys, sharded=sharded,
             convergence=cfg.convergence, interval=cfg.interval,
             sensitivity=cfg.sensitivity, spatial_grid=spatial_grid,
-            halo_depth=cfg.halo_depth,
+            halo_depth=cfg.halo_depth, halo=cfg.halo,
             tap=(telemetry.tap_members if telemetry is not None
                  and spatial_grid is None else None))
     except (ConfigError, ValueError) as e:
@@ -446,7 +455,7 @@ def main(argv=None) -> int:
             sensitivity=args.sensitivity, mode=args.mode,
             accum_dtype=args.accum_dtype, numworkers=args.numworkers,
             strict_baseline=args.strict_baseline, debug=args.debug,
-            halo_depth=args.halo_depth,
+            halo_depth=args.halo_depth, halo=args.halo,
             bitwise_parity=args.bitwise_parity)
     except ConfigError as e:
         print(f"{e}\nQuitting...", file=sys.stderr)

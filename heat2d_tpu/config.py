@@ -35,6 +35,22 @@ class ConfigError(ValueError):
 #:             (grad1612_hybrid_heat.c: MPI across chips + intra-chip tiling)
 MODES = ("serial", "pallas", "dist1d", "dist2d", "hybrid")
 
+#: Halo-exchange routes for the distributed modes:
+#:   collective — the existing lax.ppermute exchange followed by the
+#:                shard chunk (a collective barrier per chunk of T steps).
+#:   fused      — overlap route: edge-strip communication runs WHILE the
+#:                interior stencil sweep advances (the reference's
+#:                persistent-nonblocking-MPI inner/boundary split,
+#:                grad1612_mpi_heat.c:233-259). On TPU with async remote
+#:                copies the exchange moves INTO the Pallas kernel
+#:                (pltpu.make_async_remote_copy, docs/SCALING.md);
+#:                elsewhere the overlap schedule runs as a ppermute +
+#:                interior/frame split. Degrades automatically to the
+#:                collective route where neither applies (deep halos,
+#:                1-wide shards, band-streamed shards) — selecting
+#:                "fused" never fails, it only ever falls back.
+HALO_ROUTES = ("collective", "fused")
+
 
 @dataclasses.dataclass(frozen=True)
 class HeatConfig:
@@ -66,6 +82,13 @@ class HeatConfig:
     # analogue of the Pallas temporal blocking). None = auto (8, clamped to
     # the shard size). 1 reproduces the reference's per-step exchange.
     halo_depth: Optional[int] = None
+    # Halo-exchange route for the distributed modes (HALO_ROUTES):
+    # "collective" keeps the existing exchange-then-compute schedule
+    # (byte-identical program to builds before the fused route existed —
+    # jaxpr-pinned); "fused" overlaps edge communication with interior
+    # compute, bitwise-identical results, degrading to collective
+    # wherever the overlap geometry or backend support is missing.
+    halo: str = "collective"
     # f64 accumulation mirrors the C reference's promotion of the f32 stencil
     # through double (literals 0.1/2.0 — SURVEY.md Appendix B); f32 is the
     # TPU-fast path. Storage is always float32, as in the reference.
@@ -120,6 +143,9 @@ class HeatConfig:
             raise ConfigError("interval must be >= 1 when convergence is on")
         if self.halo_depth is not None and self.halo_depth < 1:
             raise ConfigError("halo_depth must be >= 1 (or None for auto)")
+        if self.halo not in HALO_ROUTES:
+            raise ConfigError(
+                f"halo must be one of {HALO_ROUTES}, got {self.halo!r}")
 
     # Convenience views ------------------------------------------------- #
 

@@ -25,9 +25,11 @@ RECORD_SCHEMA = "heat2d-tpu/run-record/v1"
 #: (heat2d-tpu-fleet: supervisor/soak summary + fleet_* families),
 #: "inverse" (heat2d-tpu-inverse: recovery summary — iteration count,
 #: final loss, convergence flag — + the inverse_* metric families and
-#: per-iteration loss/grad-norm series).
+#: per-iteration loss/grad-norm series), "multichip" (the strong-
+#: scaling gate: per-chip Mcells/s at 1 vs n chips + efficiency per
+#: halo route — parallel/scaling.py).
 RECORD_KINDS = ("run", "ensemble", "bench", "sweep", "serve", "tune",
-                "fleet", "inverse")
+                "fleet", "inverse", "multichip")
 
 
 def run_context() -> dict:

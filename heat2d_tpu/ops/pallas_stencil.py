@@ -1931,3 +1931,272 @@ def make_shard_chunk_kernel(config):
                                  nx, ny, step=step)
 
     return chunk_kernel
+
+
+# --------------------------------------------------------------------- #
+# Kernel F: fused in-kernel ICI halo exchange for mode='hybrid'
+# --------------------------------------------------------------------- #
+#
+# Every route above receives its halo strips as OPERANDS: the XLA-level
+# ppermute completes (a collective data dependency) before the chunk
+# kernel may launch — one barrier per chunk of T steps, the cost ROADMAP
+# item 2 names. Kernel F moves the exchange into the kernel itself,
+# reproducing the reference's persistent-nonblocking-MPI overlap
+# (grad1612_mpi_heat.c:233-259: MPI_Startall -> update inner cells ->
+# Waitall recv -> update boundary strips) at ICI speed:
+#
+# - One invocation per shard (inside shard_map), whole block VMEM-
+#   resident (the band-streamed fallback stays on the collective route —
+#   docs/SCALING.md fallback matrix).
+# - Entry barrier with the 4 neighbors (get_barrier_semaphore): a remote
+#   write may only land once its target has entered this invocation —
+#   the recv buffers are per-invocation scratch.
+# - Phase 1: async remote copies of the first/last T rows to the N/S
+#   neighbors' recv buffers (pltpu.make_async_remote_copy), then the
+#   INTERIOR sweep — T steps on the local block, exact at distance >= T
+#   from the block edge — runs while the row strips are in flight.
+# - Phase 2: N/S arrivals waited; the vertically-extended edge columns
+#   (which carry the corner data — the same two-phase scheme as
+#   parallel.halo.exchange_halo_strips) are assembled into send buffers
+#   and dispatched E/W; the N/S boundary frames are recomputed while the
+#   column strips fly.
+# - Phase 3: E/W arrivals waited; the full-height W/E frames (corners
+#   included) are computed and the four frames + interior stitched into
+#   the output. Send completions are drained before exit so the source
+#   block can be reused by the next chunk.
+#
+# Buffer slots are direction-keyed (0=N arrival, 1=S, 2=W, 3=E) on both
+# the send and recv semaphore arrays — double-buffered in the sense that
+# sends read the immutable input block / dedicated send staging while
+# arrivals land in dedicated recv scratch, so communication never
+# contends with the sweep's working set. Absent neighbors (mesh edge)
+# zero-fill their recv buffer instead of waiting — MPI_PROC_NULL
+# semantics, identical to the partial ppermute's zeros, so results stay
+# BITWISE equal to the collective hybrid route (each kept cell's
+# per-step arithmetic DAG is kernel D's; tpu_smoke pins this on
+# hardware).
+
+#: collective_id for kernel F's barrier/RDMA semaphores — any value
+#: agreed across devices; distinct from 0 to stay clear of other
+#: collectives a surrounding program might schedule.
+_FUSED_ICI_COLLECTIVE_ID = 7
+
+
+def _device_id_type():
+    """The DeviceIdType for mesh-coordinate-tuple device ids: MESH
+    where the enum still has it (jax<=0.4.x — LOGICAL there means a
+    single flat index), LOGICAL on builds that folded tuples into it."""
+    return (getattr(pltpu.DeviceIdType, "MESH", None)
+            or pltpu.DeviceIdType.LOGICAL)
+
+
+def _fused_compiler_params(params_cls):
+    """CompilerParams for kernel F across jax versions: collective_id
+    is required for the barrier/RDMA semaphores; has_side_effects only
+    exists (and is only needed) on newer builds."""
+    import dataclasses
+    names = {f.name for f in dataclasses.fields(params_cls)}
+    kw = {}
+    if "collective_id" in names:
+        kw["collective_id"] = _FUSED_ICI_COLLECTIVE_ID
+    if "has_side_effects" in names:
+        kw["has_side_effects"] = True
+    return params_cls(**kw)
+
+
+def remote_dma_supported() -> bool:
+    """True when in-kernel async remote copies can lower here: on TPU
+    (Mosaic — interpreter mode has no RDMA semantics) with a pallas
+    build exposing the remote-copy + semaphore API."""
+    return (_on_tpu()
+            and hasattr(pltpu, "make_async_remote_copy")
+            and hasattr(pltpu, "SemaphoreType")
+            and hasattr(pltpu, "get_barrier_semaphore")
+            and hasattr(pltpu, "DeviceIdType"))
+
+
+def fused_ici_est_bytes(bm: int, bn: int, t: int, itemsize: int = 4) -> int:
+    """VMEM working-set estimate for one kernel-F invocation: block +
+    output + the sweep carry (~3 block-sized arrays, as fits_vmem
+    charges the resident kernels), the N/S recv strips, and the four
+    column staging/recv buffers — whose t-wide minor dim Mosaic
+    lane-pads to 128 (the kernel-D lesson), plus the frame sweeps'
+    (bm+2t, 3t)-class temporaries charged at the same padded width."""
+    block = bm * bn * itemsize
+    row_strips = 2 * t * bn * itemsize
+    col_pad = max(3 * t, 128)
+    col_strips = 8 * (bm + 2 * t) * col_pad * itemsize
+    frame_rows = 4 * 3 * t * bn * itemsize
+    return 3 * block + row_strips + col_strips + frame_rows
+
+
+def fused_ici_viable(bm: int, bn: int, t: int, dtype=jnp.float32) -> bool:
+    """Gate for kernel F: remote DMA must lower, the overlap geometry
+    must tile the block (strict — empty regions have no Mosaic store),
+    and the working set must clear the hard limit. Non-viable fused
+    requests DEGRADE to the collective hybrid route (parallel.sharded
+    owns the fallback; it never errors)."""
+    if not remote_dma_supported():
+        return False
+    if t < 1 or bm <= 2 * t or bn <= 2 * t:
+        return False
+    return (fused_ici_est_bytes(bm, bn, t, jnp.dtype(dtype).itemsize)
+            <= vmem_hard_limit_bytes())
+
+
+def _fused_ici_kernel(s_ref, u_ref, out_ref, nrecv, srecv, wrecv, erecv,
+                      wsend, esend, send_sem, recv_sem, *,
+                      bm, bn, gx, gy, tsteps, nx, ny, cx, cy, step):
+    t = tsteps
+    ix, iy = s_ref[0], s_ref[1]
+    x0, y0 = s_ref[2], s_ref[3]
+    has_n, has_s = ix > 0, ix < gx - 1
+    has_w, has_e = iy > 0, iy < gy - 1
+
+    def advance(v, row_shift, col_shift):
+        """T masked steps on a region whose ext (0,0) sits at global
+        (x0+row_shift, y0+col_shift) — the kernel-D per-cell DAG, so
+        kernel F is bitwise-equal to the collective hybrid route."""
+        keep = _shard_keep_mask(x0, y0, v.shape, nx, ny,
+                                row_shift=row_shift, col_shift=col_shift)
+        return _unrolled_steps(
+            t, lambda w: jnp.where(keep, w, step(w, cx, cy)), v)
+
+    # PROC_NULL semantics: an absent neighbor's recv buffer reads as
+    # zeros (its matching sender is absent too, so no write can land).
+    for pred, buf in ((has_n, nrecv), (has_s, srecv),
+                      (has_w, wrecv), (has_e, erecv)):
+        @pl.when(jnp.logical_not(pred))
+        def _(buf=buf):
+            buf[...] = jnp.zeros_like(buf)
+
+    # Entry barrier with the existing neighbors.
+    barrier = pltpu.get_barrier_semaphore()
+    neighbors = ((has_n, -1, 0), (has_s, 1, 0),
+                 (has_w, 0, -1), (has_e, 0, 1))
+    for pred, dix, diy in neighbors:
+        @pl.when(pred)
+        def _(dix=dix, diy=diy):
+            pltpu.semaphore_signal(
+                barrier, inc=1, device_id=(ix + dix, iy + diy),
+                device_id_type=_device_id_type())
+    nnb = (has_n.astype(jnp.int32) + has_s.astype(jnp.int32)
+           + has_w.astype(jnp.int32) + has_e.astype(jnp.int32))
+    pltpu.semaphore_wait(barrier, nnb)
+
+    def start_copy(pred, src, dst, slot, dix, diy):
+        # Slot convention (agreed SPMD-wide): the slot names the
+        # ARRIVAL direction on the destination, so sender and receiver
+        # index the same semaphore cell.
+        @pl.when(pred)
+        def _():
+            pltpu.make_async_remote_copy(
+                src, dst, send_sem.at[slot], recv_sem.at[slot],
+                device_id=(ix + dix, iy + diy),
+                device_id_type=_device_id_type()).start()
+
+    # Phase 1: row strips fly south/north...
+    start_copy(has_s, u_ref.at[pl.ds(bm - t, t), :], nrecv, 0, 1, 0)
+    start_copy(has_n, u_ref.at[pl.ds(0, t), :], srecv, 1, -1, 0)
+    # ...while the interior sweep runs on local data only.
+    core = advance(u_ref[:], 0, 0)
+    out_ref[t:bm - t, t:bn - t] = core[t:bm - t, t:bn - t]
+
+    for pred, slot in ((has_n, 0), (has_s, 1)):
+        @pl.when(pred)
+        def _(slot=slot):
+            pltpu.semaphore_wait(recv_sem.at[slot], 1)
+
+    # Phase 2: vertically-extended edge columns (corners ride along)
+    # fly east/west while the N/S frames recompute.
+    esend[...] = jnp.concatenate(
+        [nrecv[:, bn - t:], u_ref[:, bn - t:], srecv[:, bn - t:]], axis=0)
+    wsend[...] = jnp.concatenate(
+        [nrecv[:, :t], u_ref[:, :t], srecv[:, :t]], axis=0)
+    start_copy(has_e, esend, wrecv, 2, 0, 1)
+    start_copy(has_w, wsend, erecv, 3, 0, -1)
+
+    nfr = advance(jnp.concatenate([nrecv[:], u_ref[:2 * t, :]], axis=0),
+                  -t, 0)
+    out_ref[0:t, t:bn - t] = nfr[t:2 * t, t:bn - t]
+    sfr = advance(jnp.concatenate([u_ref[bm - 2 * t:, :], srecv[:]],
+                                  axis=0), bm - 2 * t, 0)
+    out_ref[bm - t:bm, t:bn - t] = sfr[t:2 * t, t:bn - t]
+
+    for pred, slot in ((has_w, 2), (has_e, 3)):
+        @pl.when(pred)
+        def _(slot=slot):
+            pltpu.semaphore_wait(recv_sem.at[slot], 1)
+
+    # Phase 3: full-height W/E frames (corners included), then stitch.
+    wext = jnp.concatenate(
+        [wrecv[:], jnp.concatenate([nrecv[:, :2 * t], u_ref[:, :2 * t],
+                                    srecv[:, :2 * t]], axis=0)], axis=1)
+    wfr = advance(wext, -t, -t)
+    out_ref[0:bm, 0:t] = wfr[t:bm + t, t:2 * t]
+    eext = jnp.concatenate(
+        [jnp.concatenate([nrecv[:, bn - 2 * t:], u_ref[:, bn - 2 * t:],
+                          srecv[:, bn - 2 * t:]], axis=0), erecv[:]],
+        axis=1)
+    efr = advance(eext, -t, bn - 2 * t)
+    out_ref[0:bm, bn - t:bn] = efr[t:bm + t, t:2 * t]
+
+    # Drain send completions: the block may be rewritten next chunk.
+    for pred, slot in ((has_s, 0), (has_n, 1), (has_e, 2), (has_w, 3)):
+        @pl.when(pred)
+        def _(slot=slot):
+            pltpu.semaphore_wait(send_sem.at[slot], 1)
+
+
+def make_fused_chunk_kernel(config, axes_info):
+    """Kernel F entry for parallel.sharded: ``fused(u, t, ix, iy, x0,
+    y0) -> u_new`` advancing the (bm, bn) shard block t steps with the
+    halo exchange fused into the kernel as async remote copies, or
+    ``None`` when remote DMA cannot lower here (off-TPU, old pallas,
+    single-device mesh) — the caller then keeps the collective route.
+    ``fused.viable(t)`` gates per chunk depth (geometry + VMEM), so
+    remainder chunks degrade independently. ``axes_info`` is the
+    sharded runner's (ax, ay, gx, gy); kernel F only supports the
+    plain 2-axis hybrid mesh (device ids are (x, y) mesh coordinates).
+    """
+    if not remote_dma_supported():
+        return None
+    _, _, gx, gy = axes_info
+    if gx * gy == 1:
+        return None        # no neighbors — nothing to fuse
+    nx, ny = config.nxprob, config.nyprob
+    bm = (-(-nx // gx) * gx) // gx
+    bn = (-(-ny // gy) * gy) // gy
+    cx, cy = config.cx, config.cy
+    step = (_step_value_literal if getattr(config, "bitwise_parity", False)
+            else _step_value)
+    mspace, smem = _mem_spaces()
+    params = _compiler_params_cls()
+
+    def fused(u, t, ix, iy, x0, y0):
+        scalars = jnp.stack([jnp.asarray(ix, jnp.int32),
+                             jnp.asarray(iy, jnp.int32),
+                             jnp.asarray(x0, jnp.int32),
+                             jnp.asarray(y0, jnp.int32)])
+        return pl.pallas_call(
+            functools.partial(_fused_ici_kernel, bm=bm, bn=bn, gx=gx,
+                              gy=gy, tsteps=t, nx=nx, ny=ny, cx=cx,
+                              cy=cy, step=step),
+            out_shape=jax.ShapeDtypeStruct(u.shape, u.dtype),
+            in_specs=[pl.BlockSpec(**smem), pl.BlockSpec(**mspace)],
+            out_specs=pl.BlockSpec(**mspace),
+            scratch_shapes=[
+                pltpu.VMEM((t, bn), u.dtype),           # nrecv
+                pltpu.VMEM((t, bn), u.dtype),           # srecv
+                pltpu.VMEM((bm + 2 * t, t), u.dtype),   # wrecv
+                pltpu.VMEM((bm + 2 * t, t), u.dtype),   # erecv
+                pltpu.VMEM((bm + 2 * t, t), u.dtype),   # wsend
+                pltpu.VMEM((bm + 2 * t, t), u.dtype),   # esend
+                pltpu.SemaphoreType.DMA((4,)),          # send slots
+                pltpu.SemaphoreType.DMA((4,)),          # recv slots
+            ],
+            compiler_params=_fused_compiler_params(params),
+        )(scalars, u)
+
+    fused.viable = lambda t: fused_ici_viable(bm, bn, t)
+    return fused

@@ -16,10 +16,20 @@ ever being written anyway.
 
 "Persistence" (amortized request setup, MPI_Send_init) maps to jit: the
 exchange is traced once and compiled into the step program. Comm/compute
-overlap (grad1612_mpi_heat.c:233-259 inner/boundary split) is delegated to
-XLA's latency-hiding scheduler, which overlaps the ppermute DMA with the
-interior update automatically — documented here so nobody re-serializes it
-(SURVEY.md A.4).
+overlap (grad1612_mpi_heat.c:233-259 inner/boundary split) comes in two
+strengths (config.halo, docs/SCALING.md):
+
+- ``collective`` — exchange-then-compute; overlap is delegated to XLA's
+  latency-hiding scheduler, which may overlap the ppermute DMA with the
+  interior update (SURVEY.md A.4) but pays a collective data dependency
+  at every chunk boundary.
+- ``fused`` — the inner/boundary split made EXPLICIT: the interior sweep
+  (which needs no halo data) is traced with no data dependency on the
+  edge strips, so edge communication and interior compute overlap by
+  construction; the t-wide boundary frames are recomputed from the
+  strips afterwards and stitched in (sharded.make_local_chunk's fused
+  branch; on TPU the exchange additionally moves INTO the Pallas kernel
+  as async remote copies — ops.pallas_stencil kernel F).
 """
 
 from __future__ import annotations
@@ -82,6 +92,17 @@ def exchange_halo_strips(u, ax: str, ay: str, gx: int, gy: int, t: int):
     west = shift_from_lower(right_edge, ay, gy)
     east = shift_from_upper(left_edge, ay, gy)
     return north, south, west, east
+
+
+def fused_halo_viable(bm: int, bn: int, t: int) -> bool:
+    """Geometry gate for the fused (overlap) halo route at depth ``t``
+    on a (bm, bn) shard block: the interior/frame decomposition tiles
+    the block iff each t-wide boundary frame fits without overlapping
+    its opposite — ``bm >= 2t`` and ``bn >= 2t``. Deep halos relative
+    to the shard (halo_depth > interior) and 1-wide shards fail this
+    and DEGRADE to the collective route (the route never errors; the
+    deep-halo chunking tests pin the fallback bitwise)."""
+    return t >= 1 and bm >= 2 * t and bn >= 2 * t
 
 
 def exchange_halo_2d_wide(u, ax: str, ay: str, gx: int, gy: int, t: int):

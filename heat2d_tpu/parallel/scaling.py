@@ -1,0 +1,110 @@
+"""Strong-scaling measurement — the MULTICHIP gate's scaling metric.
+
+BENCH tracks the single-chip trajectory; MULTICHIP runs previously only
+proved the mesh program compiles and steps. This module adds the number
+that actually tracks pod-scale progress (ISSUE 8 / ROADMAP item 2): the
+same FIXED global problem measured on 1 device and on the full mesh,
+
+    strong_scaling_efficiency = rate_n / (n * rate_1)
+
+— 1.0 is perfect scaling; what the collective halo barrier eats at chunk
+boundaries (and what the fused route exists to win back) shows up as the
+gap. Records ride the unified run-record schema (kind="multichip") so
+the scaling trajectory is tracked like BENCH_r*.json, and the driver's
+MULTICHIP_r*.json captures the printed ``MULTICHIP_METRICS:`` line in
+its ``tail``.
+"""
+
+from __future__ import annotations
+
+import json
+
+
+def square_mesh(n: int) -> tuple[int, int]:
+    """Closest-to-square (gx, gy) factorization of ``n`` — the mesh
+    shape the reference hardcodes as GRIDX x GRIDY."""
+    gx = int(n ** 0.5)
+    while n % gx:
+        gx -= 1
+    return gx, n // gx
+
+
+def _rate(cfg, devices) -> float:
+    """Mcells/s of one sharded run under the reference timing protocol
+    (compile excluded — utils.timing.timed_call inside Solver.run)."""
+    from heat2d_tpu.models.solver import Heat2DSolver
+
+    r = Heat2DSolver(cfg, devices=devices).run(gather=False)
+    return r.mcells_per_s
+
+
+def measure_strong_scaling(n_devices: int | None = None,
+                           nx: int = 64, ny: int = 64, steps: int = 32,
+                           halo: str = "collective", halo_depth=None,
+                           mode: str = "dist2d", devices=None) -> dict:
+    """One strong-scaling measurement: the FIXED (nx, ny) global grid
+    advanced ``steps`` steps on 1 device and on an ``n_devices``
+    near-square mesh, same mode and halo route. Returns the
+    kind="multichip" record payload (per-chip Mcells/s at both points,
+    the efficiency ratio, and the resolved halo route/tier so a fused
+    request that degraded is visible in the record, not silent)."""
+    import jax
+
+    from heat2d_tpu.config import HeatConfig
+    from heat2d_tpu.parallel.mesh import make_mesh
+    from heat2d_tpu.parallel.sharded import resolve_halo_route
+
+    devices = list(devices if devices is not None else jax.devices())
+    n = n_devices or len(devices)
+    if len(devices) < n:
+        raise ValueError(f"strong scaling at n={n} needs {n} devices; "
+                         f"have {len(devices)}")
+    gx, gy = square_mesh(n)
+    base = dict(nxprob=nx, nyprob=ny, steps=steps, mode=mode,
+                halo_depth=halo_depth)
+    # The 1-chip baseline is the SAME program for every route
+    # (collective — on one device there is no exchange to overlap, only
+    # the fused route's seam-recompute tax): a route-specific baseline
+    # would let a route inflate its efficiency ratio by being slower at
+    # n=1, making cross-route efficiency comparisons (the acceptance
+    # gate: fused no worse than collective) meaningless.
+    cfg1 = HeatConfig(gridx=1, gridy=1, halo="collective", **base)
+    cfgn = HeatConfig(gridx=gx, gridy=gy, halo=halo, **base)
+    ck = None
+    if mode == "hybrid":
+        # The route resolves differently with a shard chunk kernel
+        # (window / kernel-F tiers) — resolve against the SAME kernel
+        # the solver will build, or the recorded tier describes a
+        # program that never runs.
+        from heat2d_tpu.ops.pallas_stencil import make_shard_chunk_kernel
+        ck = make_shard_chunk_kernel(cfgn)
+    route = resolve_halo_route(cfgn, make_mesh(gx, gy,
+                                               devices=devices[:n]),
+                               chunk_kernel=ck)
+    rate_1 = _rate(cfg1, devices[:1])
+    rate_n = _rate(cfgn, devices[:n])
+    eff = (rate_n / (n * rate_1)) if rate_1 > 0 else float("nan")
+    return {
+        "n_devices": n, "mesh": [gx, gy], "grid": [nx, ny],
+        "steps": steps, "mode": mode,
+        "halo": halo, "halo_route": route["route"],
+        "halo_tier": route["tier"], "halo_depth": route["depth"],
+        "mcells_per_s_1chip": rate_1,
+        "mcells_per_s_nchip": rate_n,
+        "per_chip_mcells_per_s_1chip": rate_1,
+        "per_chip_mcells_per_s_nchip": rate_n / n,
+        "strong_scaling_efficiency": eff,
+    }
+
+
+def scaling_record(payloads: list, out_path: str | None = None) -> dict:
+    """Wrap per-route scaling payloads in the unified run-record
+    envelope (kind="multichip") and optionally write it as JSON —
+    the MULTICHIP_r*.json companion the trajectory is tracked by."""
+    from heat2d_tpu.obs.record import build_record
+
+    rec = build_record("multichip", extra={"scaling": payloads})
+    if out_path:
+        with open(out_path, "w") as f:
+            json.dump(rec, f, indent=2, sort_keys=True)
+    return rec

@@ -29,7 +29,8 @@ from heat2d_tpu.models import engine
 from heat2d_tpu.ops.init import inidat_block
 from heat2d_tpu.ops.stencil import residual_sq, stencil_step_padded
 from heat2d_tpu.parallel.halo import (exchange_halo_2d_wide,
-                                      exchange_halo_strips)
+                                      exchange_halo_strips,
+                                      fused_halo_viable)
 from heat2d_tpu.parallel.mesh import shard_map_compat
 from heat2d_tpu.utils.profiling import phase
 
@@ -126,6 +127,64 @@ def make_local_chunk(config, mesh: Mesh, chunk_kernel=None, axes=None,
     if cxy is not None and chunk_kernel is not None:
         raise ValueError("per-member cxy requires the jnp chunk path "
                          "(chunk kernels bake their diffusivities)")
+    fused_req = getattr(config, "halo", "collective") == "fused"
+    fused_ici = None
+    if fused_req and chunk_kernel is not None:
+        from heat2d_tpu.ops import pallas_stencil as ps
+        fused_ici = ps.make_fused_chunk_kernel(config, (ax, ay, gx, gy))
+
+    def advance(v, row0, col0, t):
+        """t masked steps on a sub-block whose (0,0) sits at global
+        (row0, col0) — the ONE per-cell step expression both halo
+        routes share, so every kept cell's arithmetic DAG is identical
+        between them (the bitwise-parity contract)."""
+        keep = _keep_mask(v.shape, nx, ny, row0, col0)
+
+        def one(_, w):
+            newint = stencil_step_padded(w, cx, cy, accum)
+            mid = jnp.concatenate([w[1:-1, :1], newint, w[1:-1, -1:]],
+                                  axis=1)
+            full = jnp.concatenate([w[:1, :], mid, w[-1:, :]], axis=0)
+            return jnp.where(keep, w, full)
+
+        return lax.fori_loop(0, t, one, v, unroll=False)
+
+    def chunk_fused(u, t, x0, y0):
+        """Overlap schedule (config.halo='fused', jnp path): the
+        reference's inner/boundary split (grad1612_mpi_heat.c:233-259)
+        — the interior sweep is traced with NO data dependency on the
+        exchanged strips, so XLA runs the 4 ppermutes while the
+        interior advances; the four t-wide boundary frames are then
+        recomputed from strip-extended regions and stitched in. Every
+        kept cell's per-step arithmetic is the chunk() expression on
+        the same operand values (the temporal-blocking cone argument,
+        kernel C), so the result is BITWISE equal to the collective
+        route — at ~(6t(bm + bn)/(bm*bn)) recompute overhead per step,
+        the same seam tax the reference paid for its overlap."""
+        with phase("halo_overlap"):
+            north, south, west, east = exchange_halo_strips(
+                u, ax, ay, gx, gy, t)
+        with phase("interior_stencil"):
+            # Exact after t steps at distance >= t from the block edge.
+            core = advance(u, x0, y0, t)[t:bm - t, t:bn - t]
+        with phase("halo_overlap"):
+            # N/S frames: rows [0,t) / [bm-t,bm), interior cols only —
+            # their corner cols ride in the full-height W/E frames.
+            nfr = advance(jnp.concatenate([north, u[:2 * t]], axis=0),
+                          x0 - t, y0, t)[t:2 * t, t:bn - t]
+            sfr = advance(jnp.concatenate([u[bm - 2 * t:], south], axis=0),
+                          x0 + bm - 2 * t, y0, t)[t:2 * t, t:bn - t]
+            # W/E frames: all rows, cols [0,t) / [bn-t,bn) — assembled
+            # from the vertically-extended edge columns (the exchanged
+            # strips carry the corners, exchange_halo_strips).
+            vert = jnp.concatenate([north, u, south], axis=0)
+            wfr = advance(jnp.concatenate([west, vert[:, :2 * t]], axis=1),
+                          x0 - t, y0 - t, t)[t:bm + t, t:2 * t]
+            efr = advance(jnp.concatenate([vert[:, bn - 2 * t:], east],
+                                          axis=1),
+                          x0 - t, y0 + bn - 2 * t, t)[t:bm + t, t:2 * t]
+            mid = jnp.concatenate([nfr, core, sfr], axis=0)
+            return jnp.concatenate([wfr, mid, efr], axis=1)
 
     def chunk(u, t):
         # phase() spans: metadata-only HLO scope names so XProf/Perfetto
@@ -134,26 +193,45 @@ def make_local_chunk(config, mesh: Mesh, chunk_kernel=None, axes=None,
         x0 = lax.axis_index(ax) * bm
         y0 = lax.axis_index(ay) * bn
         if chunk_kernel is not None:
+            if fused_ici is not None and fused_ici.viable(t):
+                # Kernel F: the exchange itself moves into the Pallas
+                # kernel as async remote copies over ICI.
+                with phase("stencil_chunk"):
+                    return fused_ici(u, t, lax.axis_index(ax),
+                                     lax.axis_index(ay), x0, y0)
             with phase("halo_exchange"):
                 strips = exchange_halo_strips(u, ax, ay, gx, gy, t)
             with phase("stencil_chunk"):
                 return chunk_kernel(u, strips, t, x0, y0)
+        # gx*gy == 1: no neighbors, nothing to overlap — the seam
+        # recompute would be pure waste (and a route-dependent 1-chip
+        # baseline would skew the strong-scaling gate).
+        if fused_req and gx * gy > 1 and fused_halo_viable(bm, bn, t):
+            return chunk_fused(u, t, x0, y0)
         with phase("halo_exchange"):
             ext = exchange_halo_2d_wide(u, ax, ay, gx, gy, t)
-        keep = _keep_mask((bm + 2 * t, bn + 2 * t), nx, ny, x0 - t, y0 - t)
-
-        def one(_, v):
-            newint = stencil_step_padded(v, cx, cy, accum)
-            mid = jnp.concatenate([v[1:-1, :1], newint, v[1:-1, -1:]],
-                                  axis=1)
-            full = jnp.concatenate([v[:1, :], mid, v[-1:, :]], axis=0)
-            return jnp.where(keep, v, full)
 
         with phase("interior_stencil"):
-            ext = lax.fori_loop(0, t, one, ext, unroll=False)
+            ext = advance(ext, x0 - t, y0 - t, t)
         return ext[t:-t, t:-t]
 
     return chunk
+
+
+def _tuned_fused_depth(bm: int, bn: int, config):
+    """Tuned overlap depth for the fused halo route from the opt-in
+    tuning db (``HEAT2D_TUNE_DB``), or None — consulted only when the
+    fused route is REQUESTED and no explicit --halo-depth pins the
+    depth, so collective-route programs (and db-less builds) stay
+    byte-identical (the jaxpr-pinned contract). The answer is
+    re-validated by tune.runtime.fused_config against the live overlap
+    geometry + VMEM model before it may steer the schedule."""
+    try:
+        from heat2d_tpu.tune import runtime as _tune_runtime
+    except ImportError:  # pragma: no cover - partial install
+        return None
+    cfg = _tune_runtime.fused_config(bm, bn, "float32")
+    return cfg.tsteps if cfg is not None else None
 
 
 def effective_halo_depth(config, mesh: Mesh, axes=None) -> int:
@@ -161,7 +239,58 @@ def effective_halo_depth(config, mesh: Mesh, axes=None) -> int:
     pnx, pny = padded_global_shape(config, mesh, axes)
     bm, bn = pnx // gx, pny // gy
     want = config.halo_depth or DEFAULT_HALO_DEPTH
+    if (config.halo_depth is None
+            and getattr(config, "halo", "collective") == "fused"):
+        tuned = _tuned_fused_depth(bm, bn, config)
+        if tuned:
+            want = tuned
     return max(1, min(want, bm, bn))
+
+
+def resolve_halo_route(config, mesh: Mesh, chunk_kernel=None,
+                       axes=None) -> dict:
+    """Host-side description of the halo route a runner build will
+    actually take at the full chunk depth — the provenance block run
+    records/launch logs carry, and what the parity tests assert
+    degradation against. ``tier``:
+
+    - ``"collective"`` — the existing exchange-then-compute schedule
+      (also what a non-viable fused request degrades to);
+    - ``"overlap"``    — fused via the explicit inner/boundary split
+      (ppermute strips overlapped with the interior sweep);
+    - ``"ici"``        — fused via in-kernel async remote copies
+      (kernel F; TPU + resident shard only);
+    - ``"window"``     — the D2 gather-free sweep route (hybrid,
+      band-streamed shards) — its per-sweep exchange stays collective;
+      a fused request records the degradation here.
+    """
+    ax, ay, gx, gy = _mesh_axes(mesh, axes)
+    pnx, pny = padded_global_shape(config, mesh, axes)
+    bm, bn = pnx // gx, pny // gy
+    t = effective_halo_depth(config, mesh, axes)
+    requested = getattr(config, "halo", "collective")
+    out = dict(requested=requested, depth=t, shard=(bm, bn),
+               mesh=(gx, gy))
+    if requested != "fused":
+        out.update(route="collective", tier="collective")
+        return out
+    if chunk_kernel is not None:
+        window = make_window_multi(config, mesh)
+        if window is not None:
+            out.update(route="collective", tier="window")
+            return out
+        from heat2d_tpu.ops import pallas_stencil as ps
+        fused_ici = ps.make_fused_chunk_kernel(config, (ax, ay, gx, gy))
+        if fused_ici is not None and fused_ici.viable(t):
+            out.update(route="fused", tier="ici")
+            return out
+        out.update(route="collective", tier="collective")
+        return out
+    if gx * gy > 1 and fused_halo_viable(bm, bn, t):
+        out.update(route="fused", tier="overlap")
+        return out
+    out.update(route="collective", tier="collective")
+    return out
 
 
 def make_local_multi(config, mesh: Mesh, chunk_kernel=None, axes=None,

@@ -46,15 +46,32 @@ class EnsembleEngine:
     queue state of its own — the batcher owns scheduling; this owns the
     numerics and the launch accounting."""
 
-    def __init__(self, registry=None, max_batch: int = 8):
+    def __init__(self, registry=None, max_batch: int = 8,
+                 spatial_grid=None, halo: str = "collective"):
+        """``spatial_grid``/``halo``: deployment-level decomposition for
+        engines serving members bigger than one device (the pod-serving
+        direction, ROADMAP item 1): when set, every signature's halo
+        route (collective vs fused, tier, depth — incl. the tuning db's
+        fused entry) is pre-resolved alongside the tuned band config.
+        The plan is ADVISORY today — solve_batch still launches the
+        single-device batch runner, so the plan rides launch records
+        with ``compiled: False``; the mesh-aware engine (ROADMAP item
+        1) flips it when the spatial program actually compiles. None
+        (default): no halo plan resolved — behavior byte-identical to
+        engines built before the fused route existed."""
         self.registry = registry
         self.max_batch = max_batch
+        self.spatial_grid = spatial_grid
+        self.halo = halo
         self.launches = 0           # total ensemble launches performed
         self.launch_log: List[dict] = []   # one row per launch (tests)
         #: signature -> tuned-config dict (or None) resolved BEFORE the
         #: signature's first compile — warmup provenance for the
         #: per-signature compile cache (docs/TUNING.md).
         self.tuned: dict = {}
+        #: signature -> pre-resolved halo-route plan (spatial engines
+        #: only; see models.ensemble.spatial_halo_plan).
+        self.halo_plans: dict = {}
 
     def _preresolve_tuned(self, req0):
         """Resolve the tuning db's answer for this signature once,
@@ -81,6 +98,20 @@ class EnsembleEngine:
             if cfg is not None:
                 tuned = cfg.to_dict()
         self.tuned[sig] = tuned
+        if self.spatial_grid is not None:
+            # Fused-route twin of the band-config resolve: the halo
+            # plan (route/tier/depth, incl. a tuning-db fused entry) is
+            # decided per signature before its first compile, exactly
+            # like every other tuned plan (docs/SCALING.md).
+            # compiled=False is load-bearing: today's launches are
+            # single-device batch runners — the record must not claim
+            # a mesh program ran (review: provenance describes the
+            # program that actually compiles).
+            gx, gy = self.spatial_grid
+            self.halo_plans[sig] = dict(
+                ensemble.spatial_halo_plan(req0.nx, req0.ny, gx, gy,
+                                           halo=self.halo),
+                compiled=False)
         if self.registry is not None:
             self.registry.counter("tune_serve_signatures_total",
                                   tuned=str(tuned is not None).lower())
@@ -161,9 +192,11 @@ class EnsembleEngine:
                 steps_done = [req0.steps] * capacity
 
         self.launches += 1
-        self.launch_log.append({
-            "signature": req0.signature(), "occupancy": n,
-            "capacity": capacity, "tuned_config": tuned})
+        row = {"signature": req0.signature(), "occupancy": n,
+               "capacity": capacity, "tuned_config": tuned}
+        if self.spatial_grid is not None:
+            row["halo_plan"] = self.halo_plans.get(req0.signature())
+        self.launch_log.append(row)
         if self.registry is not None:
             self.registry.counter("serve_launches_total")
             self.registry.gauge("serve_compile_cache_size",

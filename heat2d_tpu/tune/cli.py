@@ -126,6 +126,13 @@ def search_problem(db: TuningDB, problem: Problem, *, backend=None,
     {"measured": n, "cached": n, "failed": n, "best": point|None}."""
     kind = _device_kind(backend)
     key = problem.key()
+
+    def key_for(c):
+        # Fused points measure a multi-chip mesh program — they live
+        # in their own frontier so a global-mesh rate can never win
+        # the single-chip best (and vice versa); see Problem.fused_key.
+        return problem.fused_key() if c.route == "fused" else key
+
     cands, pruned = candidate_space(
         problem, routes=routes, bm_grid=bm_grid, t_ladder=t_ladder,
         probe_past_envelope=probe_past_envelope,
@@ -133,13 +140,15 @@ def search_problem(db: TuningDB, problem: Problem, *, backend=None,
     # Never clobber a real measurement with a prune note: a prior
     # --probe-past-envelope run may hold measured data for points the
     # conservative model rejects (review r6).
-    measured_already = db.measured_keys(
-        kind, key, ("ok", "oom", "compile_error", "timeout", "error"))
+    measured_already = {
+        k: db.measured_keys(
+            kind, k, ("ok", "oom", "compile_error", "timeout", "error"))
+        for k in (key, problem.fused_key())}
     wrote_pruned = False
     for c, reason in pruned:
-        if (c.route, c.bm, c.tsteps) in measured_already:
+        if (c.route, c.bm, c.tsteps) in measured_already[key_for(c)]:
             continue
-        db.record_point(kind, key,
+        db.record_point(kind, key_for(c),
                         {"route": c.route, "bm": c.bm,
                          "tsteps": c.tsteps, "status": "pruned",
                          "error": reason})
@@ -151,24 +160,26 @@ def search_problem(db: TuningDB, problem: Problem, *, backend=None,
     # count as terminal then (review r6).
     terminal = (tuple(s for s in TERMINAL_STATUSES if s != "pruned")
                 if probe_past_envelope else TERMINAL_STATUSES)
-    done = db.measured_keys(kind, key, terminal)
+    done = {k: db.measured_keys(kind, k, terminal)
+            for k in (key, problem.fused_key())}
     measured = failed = cached = 0
     u = None
     if backend is None and any(
-            (c.route, c.bm, c.tsteps) not in done for c in cands):
+            (c.route, c.bm, c.tsteps) not in done[key_for(c)]
+            and c.route != "fused" for c in cands):
         import jax
         from heat2d_tpu.ops import inidat
         u = jax.block_until_ready(inidat(problem.nx, problem.ny))
     with probe_limits("lifted by the heat2d-tpu-tune probe"):
         for c in cands:
-            if (c.route, c.bm, c.tsteps) in done:
+            if (c.route, c.bm, c.tsteps) in done[key_for(c)]:
                 cached += 1
                 continue
             outc = measure_candidate(
                 problem, c, u=u, backend=backend, lo=lo, hi=hi,
                 reps=reps, compile_timeout_s=compile_timeout_s,
                 registry=registry)
-            db.record_point(kind, key, outc.to_point())
+            db.record_point(kind, key_for(c), outc.to_point())
             db.save()          # crash-safe resume: one point at risk
             measured += 1
             if outc.status != "ok":
@@ -182,22 +193,26 @@ def search_problem(db: TuningDB, problem: Problem, *, backend=None,
     if registry is not None and cached:
         registry.counter("tune_points_cached_total", value=cached)
 
-    entry = db.entry(kind, key)
-    ok_points = [p for p in (entry or {}).get("points", [])
-                 if p.get("status") == "ok"]
     best = None
-    if ok_points:
-        best = max(ok_points, key=lambda p: p["mcells_per_s"])
+    for k in (key, problem.fused_key()):
+        entry = db.entry(kind, k)
+        ok_points = [p for p in (entry or {}).get("points", [])
+                     if p.get("status") == "ok"]
+        if not ok_points:
+            continue
+        k_best = max(ok_points, key=lambda p: p["mcells_per_s"])
         db.set_best(
-            kind, key,
-            {"route": best["route"], "bm": best["bm"],
-             "tsteps": best["tsteps"]},
-            best["mcells_per_s"],
+            kind, k,
+            {"route": k_best["route"], "bm": k_best["bm"],
+             "tsteps": k_best["tsteps"]},
+            k_best["mcells_per_s"],
             _provenance(backend, lo, hi, reps))
         db.save()
         if registry is not None:
             registry.gauge("tune_best_mcells_per_s",
-                           best["mcells_per_s"], shape=key)
+                           k_best["mcells_per_s"], shape=k)
+        if k == key:
+            best = k_best
     return {"problem": key, "measured": measured, "cached": cached,
             "failed": failed, "best": best}
 
@@ -335,6 +350,26 @@ def run_selftest(args, registry=None) -> int:
     if not any(s["failed"] for s in first):
         failures.append("no candidate exercised a failure class "
                         "(envelope model dead?)")
+    # The fused halo route must be part of the search: at least one
+    # shape must land a measured-ok fused point in the db (the entry
+    # runtime.fused_config serves), and the resume purity check below
+    # then proves fused points resume from the db like every other
+    # route.
+    kind0 = backend.device_kind
+    fused_db = TuningDB(db_path)
+    fused_entries = [fused_db.entry(kind0, Problem(nx, ny).fused_key())
+                     for nx, ny in shapes]
+    fused_pts = [p for e in fused_entries if e
+                 for p in e.get("points", [])]
+    if not any(p.get("status") == "ok" for p in fused_pts):
+        failures.append("no fused-route point measured ok "
+                        f"(fused points: {fused_pts})")
+    # ...and the fused frontier stamps its own best, under its own
+    # key, so a global-mesh rate can never shadow the single-chip best.
+    if not any((e or {}).get("best", {}).get("route") == "fused"
+               for e in fused_entries):
+        failures.append("no fused-frontier best stamped under a "
+                        "fused: key")
 
     # Resume: a FRESH db object against the same file must skip every
     # completed point (the crash-resume contract).
@@ -360,7 +395,8 @@ def run_selftest(args, registry=None) -> int:
             continue
         want = (f"{b['route']:<5} {b['bm']:>4} {b['tsteps']:>3}")
         tagged = [ln for ln in table.splitlines()
-                  if "<-- best" in ln and f"{nx}x{ny}:" in ln]
+                  if "<-- best" in ln
+                  and ln.lstrip().startswith(f"{nx}x{ny}:")]
         if len(tagged) != 1 or want not in tagged[0]:
             failures.append(
                 f"frontier best row for {nx}x{ny} does not match the "

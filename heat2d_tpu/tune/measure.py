@@ -247,6 +247,51 @@ def measure_band_point(u, bm: int, t: int, lo: int = 4000,
     return min_of_two_point(fn, u, lo, hi, reps=reps)
 
 
+def _fused_mesh_fn(problem: Problem, t: int):
+    """(runner, u0) measuring the fused halo route on the ATTACHED
+    device mesh: the problem shape is the per-SHARD block, the global
+    grid spans a near-square mesh of every visible device, and the
+    runner is the sharded fused-route program at overlap depth ``t``
+    (static steps ride through make_local_multi's chunk schedule).
+    Needs >= 2 devices — there is no halo to overlap on one."""
+    import jax
+
+    from heat2d_tpu.config import ConfigError, HeatConfig
+    from heat2d_tpu.parallel import sharded as sh
+    from heat2d_tpu.parallel.mesh import make_mesh
+
+    from heat2d_tpu.parallel.scaling import square_mesh
+
+    devs = jax.devices()
+    if len(devs) < 2:
+        raise ConfigError(
+            "fused halo route needs >= 2 attached devices to measure "
+            "(no neighbor, no exchange to overlap)")
+    gx, gy = square_mesh(len(devs))
+    cfg = HeatConfig(nxprob=problem.nx * gx, nyprob=problem.ny * gy,
+                     steps=1, mode="dist2d", gridx=gx, gridy=gy,
+                     halo="fused", halo_depth=t)
+    mesh = make_mesh(gx, gy)
+    multi = sh.make_local_multi(cfg, mesh)
+    spec = jax.sharding.PartitionSpec("x", "y")
+    runners = {}
+
+    def fn(u, n):
+        # The step count is STATIC (baked into the chunk schedule), so
+        # it must close over the shard_map'd program, not ride through
+        # it as an operand — one compiled runner per distinct n, reused
+        # across the timing reps (the make_sharded_runner pattern).
+        if n not in runners:
+            mapped = sh.shard_map_compat(
+                lambda v, n=n: multi(v, n), mesh,
+                in_specs=spec, out_specs=spec, check_vma=False)
+            runners[n] = jax.jit(mapped)
+        return runners[n](u)
+
+    u0 = jax.block_until_ready(sh.sharded_inidat(cfg, mesh))
+    return fn, u0, gx * gy
+
+
 def _measure_real(u, problem: Problem, cand: Candidate, *, lo, hi, reps,
                   compile_timeout_s) -> MeasureOutcome:
     import jax
@@ -254,6 +299,27 @@ def _measure_real(u, problem: Problem, cand: Candidate, *, lo, hi, reps,
     from heat2d_tpu.ops import pallas_stencil as ps
     from heat2d_tpu.utils.timing import timed_call
 
+    if cand.route == "fused":
+        fn, u, ndev = _fused_mesh_fn(problem, cand.tsteps)
+        # Same compile-wall guard as every other route: an n-device
+        # mesh program is exactly the compile most likely to blow it,
+        # and a blown wall must record as 'timeout' so resume never
+        # pays it again.
+        first = timed_call(fn, u, lo)
+        warmup = first.warmup_s
+        if compile_timeout_s is not None and warmup is not None \
+                and warmup > compile_timeout_s:
+            return MeasureOutcome(
+                cand, "timeout", warmup_s=warmup,
+                error=f"compile+warmup {warmup:.1f}s over the "
+                      f"{compile_timeout_s:.0f}s wall")
+        step = min_of_two_point(fn, u, lo, hi, reps=reps)
+        # Global rate over the whole mesh; the db entry stays keyed by
+        # the per-shard shape the runtime hook looks up.
+        return MeasureOutcome(
+            cand, "ok", step_time_s=step,
+            mcells_per_s=problem.cells * ndev / step / 1e6,
+            warmup_s=warmup)
     if cand.route == "vmem":
         fn = jax.jit(lambda v, n: ps.multi_step_vmem(v, n, 0.1, 0.1),
                      static_argnums=1)
@@ -307,7 +373,10 @@ def measure_candidate(problem: Problem, cand: Candidate, *, u=None,
                 mcells_per_s=(problem.nx - 2) * (problem.ny - 2)
                 / step / 1e6)
         else:
-            if u is None:
+            if u is None and cand.route != "fused":
+                # (fused measures on its own sharded mesh state —
+                # _fused_mesh_fn — so a full-grid build here would be
+                # allocated only to be discarded.)
                 from heat2d_tpu.ops import inidat
                 import jax
                 u = jax.block_until_ready(inidat(problem.nx, problem.ny))
@@ -361,6 +430,12 @@ class SimulatedBackend:
     VPU_CELLS_PER_S = 8e11
     LAUNCH_S_PER_PROGRAM = 3e-7
     HARD_LIMIT_BYTES = 14 * 2 ** 20
+    #: ICI link bandwidth for the fused-route model (per-direction,
+    #: v5e-class order of magnitude — the model only needs the right
+    #: SHAPE: a fixed per-step edge-traffic term the interior sweep can
+    #: hide, a seam-recompute tax growing with T, and a launch term
+    #: shrinking with T, so the depth has an interior optimum).
+    ICI_BYTES_PER_S = 45e9
     #: ext-row compile envelope per row width (the probed-table analogue)
     EXT_ROWS = {32 * 1024: 64, 16 * 1024: 176, 8 * 1024: 336}
 
@@ -368,6 +443,26 @@ class SimulatedBackend:
         nx, ny, itemsize = problem.nx, problem.ny, problem.itemsize
         grid_bytes = nx * ny * itemsize
         compute = problem.cells / self.VPU_CELLS_PER_S
+        if cand.route == "fused":
+            # Per-SHARD model of the overlap route: interior compute
+            # hides the (per-step-constant) edge traffic; the boundary
+            # frames recompute ~6T(bm+bn) cells per step (the seam
+            # tax); one kernel launch per T-step chunk.
+            t = cand.tsteps
+            if nx <= 2 * t or ny <= 2 * t:
+                raise SimulatedCompileError(
+                    f"fused overlap frames exceed the {nx}x{ny} shard "
+                    f"at T={t}")
+            from heat2d_tpu.ops.pallas_stencil import fused_ici_est_bytes
+            est = fused_ici_est_bytes(nx, ny, t, itemsize)
+            if est > self.HARD_LIMIT_BYTES:
+                raise SimulatedOOM(
+                    f"fused working set {est / 2**20:.1f} MB over the "
+                    f"{self.HARD_LIMIT_BYTES / 2**20:.0f} MB core")
+            ici_s = 2 * (nx + ny) * itemsize / self.ICI_BYTES_PER_S
+            seam = 6 * t * (nx + ny) / problem.cells
+            return (max(compute, ici_s) + compute * seam
+                    + self.LAUNCH_S_PER_PROGRAM / t)
         if cand.route == "vmem":
             if 3 * grid_bytes > self.HARD_LIMIT_BYTES // 2:
                 raise SimulatedOOM(

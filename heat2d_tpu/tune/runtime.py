@@ -162,6 +162,65 @@ def band_config(nrows: int, ny: int, dtype="float32",
     return out
 
 
+def fused_config(bm: int, bn: int,
+                 dtype="float32") -> Optional[TunedConfig]:
+    """Tuned overlap depth for the FUSED halo route (config.halo=
+    'fused'), or None. Keyed by the SHARD block shape ``bm x bn`` —
+    the per-device problem the fused kernel/overlap schedule actually
+    runs — with route ``"fused"`` and ``tsteps`` = the measured best
+    overlap depth T (tune/space.py's fused candidate dimension).
+
+    Consulted only from the fused route's depth planner
+    (parallel.sharded.effective_halo_depth), so collective-route
+    programs never see it; with no active db it returns None instantly
+    (the byte-identical contract). A db answer is RE-VALIDATED against
+    the live overlap model before it may steer the schedule:
+
+    - the depth must satisfy the overlap geometry (bm >= 2T, bn >= 2T
+      — parallel.halo.fused_halo_viable); a too-deep entry (recorded
+      on other hardware or a nearest-shape match) degrades to None
+      (the static default depth), never to a broken decomposition;
+    - where the in-kernel ICI route would engage (remote DMA
+      supported), the kernel-F working-set estimate must clear the
+      live VMEM hard limit (ops.fused_ici_est_bytes) — the same
+      re-validation discipline band_config applies to C2 entries.
+    """
+    db = active_db()
+    if db is None:
+        return None
+    from heat2d_tpu.ops import pallas_stencil as ps
+    from heat2d_tpu.parallel.halo import fused_halo_viable
+
+    import jax.numpy as jnp
+
+    dt = jnp.dtype(dtype)
+    kind = ps._vmem_total()[1]
+    # Fused entries live in their own "fused:" key namespace (see
+    # space.Problem.fused_key: multi-chip mesh rates must never mix
+    # into the single-chip frontier) — exact-key only, no nearest tier
+    # (a neighboring shard shape's overlap optimum is not trusted);
+    # db.entry() already salt-filters stale code versions.
+    key = f"fused:{bm}x{bn}:{dt}"
+    e = db.entry(kind, key)
+    if e is None:
+        return None
+    b = e.get("best") or {}
+    if b.get("route") != "fused":
+        return None
+    t = int(b.get("tsteps", 0))
+    if not t or not fused_halo_viable(bm, bn, t):
+        return None
+    if (ps.remote_dma_supported()
+            and ps.fused_ici_est_bytes(bm, bn, t, dt.itemsize)
+            > ps.vmem_hard_limit_bytes()):
+        return None
+    out = TunedConfig(route="fused", bm=int(b.get("bm", 0)), tsteps=t,
+                      source="exact", matched_key=key,
+                      mcells_per_s=e.get("mcells_per_s"))
+    _record_applied(bm, bn, str(dt), out)
+    return out
+
+
 def adjoint_config(nrows: int, ny: int,
                    dtype="float32") -> Optional[TunedConfig]:
     """The tuning db's answer for a differentiable solve's fused

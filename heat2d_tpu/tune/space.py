@@ -9,6 +9,14 @@ the three single-chip kernel routes:
   knobs (bm, T).
 - ``C2``   — the gather-free window kernel; knobs (bm, T), plus the
   Mosaic alignment gates (lane-aligned width, 8-aligned bm and T).
+- ``fused`` — the fused-halo overlap route (config.halo="fused",
+  docs/SCALING.md): the problem shape is the per-SHARD block and the
+  knob is the overlap depth T (``tsteps``; the edge-buffer geometry —
+  2 T-row strips + 4 lane-padded T-column buffers — follows from it).
+  Pruned by the overlap-geometry gate (frames must tile the block:
+  bm >= 2T+1) and the kernel-F VMEM working-set estimate
+  (``ops.fused_ici_est_bytes``), so the search measures only depths
+  the route could actually take.
 
 The bm grid respects the ``plan_bands`` sublane/padding rules (bm is
 8-aligned, bm > 2T so a band can amortize its halo) and always includes
@@ -33,7 +41,11 @@ from heat2d_tpu.ops import pallas_stencil as ps
 DEFAULT_T_LADDER = (4, 8, 12, 16)
 DEFAULT_BM_GRID = (32, 48, 64, 96, 128, 160, 192, 224, 256, 320)
 
-ROUTES = ("vmem", "C", "C2")
+ROUTES = ("vmem", "C", "C2", "fused")
+
+#: Overlap-depth ladder for the fused halo route (candidate T values;
+#: the distributed default DEFAULT_HALO_DEPTH=8 rides in the middle).
+DEFAULT_FUSED_T_LADDER = (2, 4, 8, 16)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -47,6 +59,18 @@ class Problem:
         """The db problem key — shape and dtype; the route rides in the
         candidate/entry, not the key (one frontier per shape)."""
         return f"{self.nx}x{self.ny}:{self.dtype}"
+
+    def fused_key(self) -> str:
+        """The db key for this shape's FUSED-route frontier. Fused
+        points measure a multi-chip mesh program (global rate over the
+        whole mesh, shape = the per-shard block) — recording them into
+        the single-chip frontier would let an 8-device rate win the
+        cross-route best and shadow the measured band config (or vice
+        versa), so they live under their own namespace. The prefix
+        deliberately breaks the "NXxNY:dtype" parse, keeping these
+        entries invisible to the band lookup ladder's nearest-shape
+        tier; ``runtime.fused_config`` queries this key exactly."""
+        return f"fused:{self.nx}x{self.ny}:{self.dtype}"
 
     @property
     def itemsize(self) -> int:
@@ -138,6 +162,23 @@ def candidate_space(problem: Problem, routes=None, bm_grid=None,
             cands.append(c)
         else:
             pruned.append((c, "grid exceeds the VMEM residency budget"))
+
+    if "fused" in routes:
+        # Overlap-depth dimension of the fused halo route: the problem
+        # shape is the per-shard block; only the depth varies.
+        for t in DEFAULT_FUSED_T_LADDER:
+            c = Candidate("fused", 0, t)
+            if nx <= 2 * t or ny <= 2 * t:
+                pruned.append((c, "overlap frames exceed the shard "
+                                  "(needs bm > 2T and bn > 2T)"))
+            elif (ps.fused_ici_est_bytes(nx, ny, t, itemsize) > limit
+                  and not probe_past_envelope):
+                est = ps.fused_ici_est_bytes(nx, ny, t, itemsize)
+                pruned.append((c, f"fused working set "
+                                  f"{est / 2**20:.1f} MB over the "
+                                  f"{limit / 2**20:.0f} MB VMEM limit"))
+            else:
+                cands.append(c)
 
     # Seed the bm axis with the heuristic planners' own picks so the
     # search result can only match or beat the static policy.

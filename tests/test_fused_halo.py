@@ -1,0 +1,454 @@
+"""Fused (overlap) halo-route tests — ISSUE 8.
+
+The fused route must be BITWISE-identical to the collective route on
+every path (the overlap decomposition recomputes the t-wide boundary
+frames from strip-extended regions, but every kept cell's per-step
+arithmetic DAG is unchanged — the temporal-blocking cone argument), and
+must DEGRADE byte-identically to the collective program wherever the
+overlap geometry fails (deep halos, 1-wide shards). Runs on the 8
+virtual CPU devices of conftest; CI additionally runs this file under an
+explicit ``XLA_FLAGS=--xla_force_host_platform_device_count=8`` job
+(multichip-sim) so mesh control flow gates every PR.
+"""
+
+import jax
+import numpy as np
+import pytest
+
+from heat2d_tpu.config import ConfigError, HeatConfig
+from heat2d_tpu.models.solver import Heat2DSolver
+from heat2d_tpu.parallel.halo import fused_halo_viable
+from heat2d_tpu.parallel.mesh import make_mesh
+from heat2d_tpu.parallel.sharded import (effective_halo_depth,
+                                         make_sharded_runner,
+                                         resolve_halo_route,
+                                         sharded_inidat)
+
+MESHES = [(1, 2), (2, 2), (2, 4)]
+
+
+def _run(cfg):
+    return Heat2DSolver(cfg).run(timed=False)
+
+
+def _serial(nx, ny, steps, **kw):
+    return _run(HeatConfig(nxprob=nx, nyprob=ny, steps=steps,
+                           mode="serial", **kw))
+
+
+# ------------------------------------------------------------------ #
+# Bitwise parity: fused vs collective vs serial
+# ------------------------------------------------------------------ #
+
+@pytest.mark.parametrize("gx,gy", MESHES)
+def test_fused_fixed_step_bitwise(gx, gy):
+    nx, ny, steps = 32, 32, 23
+    base = dict(nxprob=nx, nyprob=ny, steps=steps, mode="dist2d",
+                gridx=gx, gridy=gy, halo_depth=3)
+    fused = _run(HeatConfig(halo="fused", **base))
+    col = _run(HeatConfig(**base))
+    serial = _serial(nx, ny, steps)
+    # The overlap tier must actually engage (not a vacuous pass through
+    # full degradation).
+    route = resolve_halo_route(HeatConfig(halo="fused", **base),
+                               make_mesh(gx, gy))
+    assert route["tier"] == "overlap"
+    np.testing.assert_array_equal(fused.u, col.u)
+    np.testing.assert_array_equal(fused.u, serial.u)
+
+
+@pytest.mark.parametrize("gx,gy", MESHES)
+def test_fused_convergence_bitwise(gx, gy):
+    """Convergence path (the chunked residual loop — on CPU the D2R
+    window route cannot lower, so this IS the residual path the mesh
+    runs here): step counts and fields must match the collective route
+    exactly."""
+    base = dict(nxprob=32, nyprob=32, steps=100000, convergence=True,
+                interval=20, sensitivity=0.1, mode="dist2d",
+                gridx=gx, gridy=gy, halo_depth=3)
+    fused = _run(HeatConfig(halo="fused", **base))
+    col = _run(HeatConfig(**base))
+    assert fused.steps_done == col.steps_done
+    np.testing.assert_array_equal(fused.u, col.u)
+
+
+def test_fused_residual_f64_accum_bitwise():
+    """The float64-accumulation residual branch (the f64 gate that
+    keeps D2R off even on TPU) — fused vs collective bitwise, and the
+    same early-exit step count as serial."""
+    base = dict(nxprob=16, nyprob=16, steps=100000, convergence=True,
+                interval=10, sensitivity=0.1, accum_dtype="float64",
+                mode="dist2d", gridx=2, gridy=2, halo_depth=2)
+    fused = _run(HeatConfig(halo="fused", **base))
+    col = _run(HeatConfig(**base))
+    serial = _serial(16, 16, 100000, convergence=True, interval=10,
+                     sensitivity=0.1, accum_dtype="float64")
+    assert fused.steps_done == col.steps_done == serial.steps_done
+    np.testing.assert_array_equal(fused.u, col.u)
+
+
+def test_fused_interval_one_residual_path():
+    """interval=1: every chunk is a tracked-step + residual pair — the
+    densest residual schedule the engine has."""
+    base = dict(nxprob=24, nyprob=24, steps=300, convergence=True,
+                interval=1, sensitivity=1e-30, mode="dist2d",
+                gridx=2, gridy=2, halo_depth=2)
+    fused = _run(HeatConfig(halo="fused", **base))
+    col = _run(HeatConfig(**base))
+    assert fused.steps_done == col.steps_done
+    np.testing.assert_array_equal(fused.u, col.u)
+
+
+def test_fused_remainder_chunk_bitwise():
+    """Odd step counts exercise the remainder chunk (depth n % T) on
+    the fused route — remainder depths degrade independently."""
+    base = dict(nxprob=32, nyprob=32, steps=19, mode="dist2d",
+                gridx=2, gridy=2, halo_depth=4)
+    fused = _run(HeatConfig(halo="fused", **base))
+    np.testing.assert_array_equal(fused.u, _serial(32, 32, 19).u)
+
+
+def test_fused_dist1d_row_strips_bitwise():
+    """dist1d (row-strip mesh, N/S traffic only) through the fused
+    route — the (numworkers, 1) mesh has gy=1, so E/W strips are the
+    zero-fill path."""
+    base = dict(nxprob=40, nyprob=12, steps=25, mode="dist1d",
+                numworkers=4, halo_depth=3)
+    fused = _run(HeatConfig(halo="fused", **base))
+    col = _run(HeatConfig(**base))
+    np.testing.assert_array_equal(fused.u, col.u)
+    np.testing.assert_array_equal(fused.u, _serial(40, 12, 25).u)
+
+
+def test_fused_hybrid_degrades_bitwise():
+    """mode='hybrid' + halo='fused' off-TPU: kernel F cannot lower
+    (remote DMA needs Mosaic), so the route must degrade to the
+    collective hybrid path — bitwise vs serial under bitwise_parity."""
+    cfg = HeatConfig(nxprob=16, nyprob=32, steps=9, mode="hybrid",
+                     gridx=2, gridy=2, halo_depth=3, halo="fused",
+                     bitwise_parity=True)
+    r = _run(cfg)
+    np.testing.assert_array_equal(r.u, _serial(16, 32, 9).u)
+    from heat2d_tpu.ops.pallas_stencil import make_shard_chunk_kernel
+    route = resolve_halo_route(cfg, make_mesh(2, 2),
+                               chunk_kernel=make_shard_chunk_kernel(cfg))
+    assert route["tier"] == "collective"
+
+
+# ------------------------------------------------------------------ #
+# jaxpr pins: degradation is BYTE-identical, collective is untouched
+# ------------------------------------------------------------------ #
+
+def _runner_jaxpr(cfg, mesh):
+    u0 = sharded_inidat(cfg, mesh)
+    runner, _ = make_sharded_runner(cfg, mesh)
+    return str(jax.make_jaxpr(runner.__wrapped__)(u0))
+
+
+def test_jaxpr_pin_collective_route_unchanged():
+    """Selecting the collective route traces the EXACT program a config
+    that never mentions halo traces (the field's default) — the fused
+    subsystem costs the existing sharded runner nothing."""
+    mesh = make_mesh(2, 2)
+    base = dict(nxprob=16, nyprob=16, steps=12, mode="dist2d",
+                gridx=2, gridy=2)
+    explicit = _runner_jaxpr(HeatConfig(halo="collective", **base), mesh)
+    default = _runner_jaxpr(HeatConfig(**base), mesh)
+    assert explicit == default
+
+
+def test_jaxpr_pin_degraded_fused_is_collective():
+    """A fused request whose geometry fails at EVERY chunk depth
+    (1-row shards: no depth can tile an overlap frame) must trace the
+    collective program BYTE-identically — degradation is not 'nearly
+    the same route', it IS the route. (Deep-halo configs degrade only
+    their full-depth chunks; remainder chunks stay fused where viable,
+    so they are parity-tested, not jaxpr-pinned.)"""
+    mesh = make_mesh(8, 1)
+    base = dict(nxprob=8, nyprob=16, steps=12, mode="dist2d",
+                gridx=8, gridy=1, halo_depth=100)
+    fused = _runner_jaxpr(HeatConfig(halo="fused", **base), mesh)
+    col = _runner_jaxpr(HeatConfig(halo="collective", **base), mesh)
+    assert fused == col
+
+
+def test_jaxpr_pin_viable_fused_differs():
+    """Sanity for the pins above: a VIABLE fused request traces a
+    different program (otherwise the parity tests prove nothing)."""
+    mesh = make_mesh(2, 2)
+    base = dict(nxprob=32, nyprob=32, steps=12, mode="dist2d",
+                gridx=2, gridy=2, halo_depth=3)
+    fused = _runner_jaxpr(HeatConfig(halo="fused", **base), mesh)
+    col = _runner_jaxpr(HeatConfig(halo="collective", **base), mesh)
+    assert fused != col
+
+
+# ------------------------------------------------------------------ #
+# Deep-halo / degenerate-shard edge cases (previously unpinned)
+# ------------------------------------------------------------------ #
+
+def test_effective_halo_depth_clamps_to_shard():
+    cfg = HeatConfig(nxprob=16, nyprob=16, mode="dist2d", gridx=4,
+                     gridy=2, halo_depth=100)
+    assert effective_halo_depth(cfg, make_mesh(4, 2)) == 4  # min(bm, bn)
+    cfg2 = cfg.replace(halo_depth=None)
+    assert effective_halo_depth(cfg2, make_mesh(4, 2)) == 4
+
+
+def test_deep_halo_fused_degrades_and_matches():
+    """halo_depth far beyond the shard interior: clamped, fused
+    degrades, result still bitwise vs serial."""
+    base = dict(nxprob=16, nyprob=16, steps=12, mode="dist2d",
+                gridx=4, gridy=2, halo_depth=100)
+    for halo in ("collective", "fused"):
+        r = _run(HeatConfig(halo=halo, **base))
+        np.testing.assert_array_equal(r.u, _serial(16, 16, 12).u)
+
+
+def test_one_wide_shards_both_routes():
+    """1-row shards (bm=1, depth clamps to 1): the overlap frames can
+    never tile a 1-wide block, so fused degrades — and both routes stay
+    bitwise vs serial (the corner the issue calls out as unpinned)."""
+    base = dict(nxprob=8, nyprob=16, steps=10, mode="dist2d",
+                gridx=8, gridy=1)
+    serial = _serial(8, 16, 10)
+    for halo in ("collective", "fused"):
+        cfg = HeatConfig(halo=halo, **base)
+        assert effective_halo_depth(cfg, make_mesh(8, 1)) == 1
+        r = _run(cfg)
+        np.testing.assert_array_equal(r.u, serial.u)
+    assert not fused_halo_viable(1, 16, 1)
+
+
+def test_depth_equals_half_shard_boundary():
+    """bm == 2T exactly: the interior region is empty but the frames
+    still tile — the geometry gate's boundary (viable) — and bm < 2T
+    (non-viable) right next to it."""
+    assert fused_halo_viable(8, 8, 4)
+    assert not fused_halo_viable(7, 8, 4)
+    base = dict(nxprob=16, nyprob=16, steps=9, mode="dist2d",
+                gridx=2, gridy=2, halo_depth=4)   # shard 8x8, T=4
+    fused = _run(HeatConfig(halo="fused", **base))
+    np.testing.assert_array_equal(fused.u, _serial(16, 16, 9).u)
+
+
+def test_fused_uneven_padded_shards():
+    """Pad-to-multiple decomposition (10 rows over 4 shards) under the
+    fused route: pad rows sit outside the keep mask on every region."""
+    base = dict(nxprob=10, nyprob=16, steps=14, mode="dist1d",
+                numworkers=4, halo_depth=1)
+    fused = _run(HeatConfig(halo="fused", **base))
+    col = _run(HeatConfig(**base))
+    np.testing.assert_array_equal(fused.u, col.u)
+    np.testing.assert_array_equal(fused.u, _serial(10, 16, 14).u)
+
+
+# ------------------------------------------------------------------ #
+# Ensemble / serving integration
+# ------------------------------------------------------------------ #
+
+def test_ensemble_spatial_fused_bitwise():
+    from heat2d_tpu.models.ensemble import run_ensemble_spatial
+    cxs, cys = [0.1, 0.2], [0.1, 0.05]
+    got, ks = run_ensemble_spatial(16, 16, 12, cxs, cys, gridx=2,
+                                   gridy=2, halo="fused", halo_depth=2)
+    want, kw = run_ensemble_spatial(16, 16, 12, cxs, cys, gridx=2,
+                                    gridy=2, halo_depth=2)
+    assert [int(k) for k in ks] == [int(k) for k in kw]
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+def test_spatial_halo_plan_preresolves():
+    from heat2d_tpu.models.ensemble import spatial_halo_plan
+    plan = spatial_halo_plan(32, 32, 2, 2, halo="fused", halo_depth=3)
+    assert plan["route"] == "fused" and plan["tier"] == "overlap"
+    plan = spatial_halo_plan(32, 32, 2, 2, halo="collective")
+    assert plan["route"] == "collective"
+    # Deep halo: the plan records the degradation, not the request.
+    plan = spatial_halo_plan(8, 8, 4, 4, halo="fused")
+    assert plan["route"] == "collective"
+
+
+def test_serve_engine_preresolves_halo_plan():
+    """A spatial serve engine resolves the halo plan per signature
+    before first compile and stamps it on every launch row; the default
+    engine records nothing new (byte-identical launch rows)."""
+    from heat2d_tpu.serve.engine import EnsembleEngine
+    from heat2d_tpu.serve.schema import SolveRequest
+
+    reqs = [SolveRequest(nx=16, ny=16, steps=4, cx=0.1, cy=0.1),
+            SolveRequest(nx=16, ny=16, steps=4, cx=0.2, cy=0.1)]
+    eng = EnsembleEngine(spatial_grid=(2, 2), halo="fused")
+    eng.solve_batch(reqs)
+    sig = reqs[0].signature()
+    assert sig in eng.halo_plans
+    assert eng.halo_plans[sig]["requested"] == "fused"
+    # Advisory until the mesh-aware engine lands: the record must not
+    # claim a spatial program compiled (the launch was a single-device
+    # batch runner).
+    assert eng.halo_plans[sig]["compiled"] is False
+    assert eng.launch_log[-1]["halo_plan"] == eng.halo_plans[sig]
+
+    plain = EnsembleEngine()
+    plain.solve_batch(reqs)
+    assert "halo_plan" not in plain.launch_log[-1]
+    assert plain.halo_plans == {}
+
+
+def test_serve_engine_halo_plan_is_advisory_never_fatal():
+    """A shape the spatial decomposition cannot take (15 % 2 != 0) must
+    still SERVE — the plan is advisory: it records the failure instead
+    of raising out of solve_batch (the single-device runner that
+    actually launches handles the shape fine)."""
+    from heat2d_tpu.serve.engine import EnsembleEngine
+    from heat2d_tpu.serve.schema import SolveRequest
+
+    reqs = [SolveRequest(nx=15, ny=16, steps=3, cx=0.1, cy=0.1)]
+    eng = EnsembleEngine(spatial_grid=(2, 2), halo="fused")
+    out = eng.solve_batch(reqs)          # must not raise
+    assert len(out) == 1
+    plan = eng.halo_plans[reqs[0].signature()]
+    assert plan["tier"] == "unplannable" and "error" in plan
+    assert plan["route"] == "collective"
+
+
+# ------------------------------------------------------------------ #
+# Tune integration: the fused candidate dimension
+# ------------------------------------------------------------------ #
+
+def test_candidate_space_covers_fused():
+    from heat2d_tpu.tune.space import Problem, candidate_space
+    cands, pruned = candidate_space(Problem(640, 512),
+                                    routes=("fused",), assume_tpu=True)
+    assert {c.route for c in cands} == {"fused"}
+    assert all(c.tsteps >= 1 for c in cands)
+    # Geometry prune: a shard too small for the deepest ladder entries.
+    cands2, pruned2 = candidate_space(Problem(24, 24),
+                                      routes=("fused",), assume_tpu=True)
+    reasons = [r for c, r in pruned2 if c.route == "fused"]
+    assert any("overlap frames" in r for r in reasons)
+    assert all(c.tsteps <= 8 for c in cands2)
+
+
+def test_simulated_backend_fused_deterministic():
+    from heat2d_tpu.tune.measure import SimulatedBackend
+    from heat2d_tpu.tune.space import Candidate, Problem
+    b = SimulatedBackend()
+    p = Problem(640, 512)
+    t1 = b.step_time(p, Candidate("fused", 0, 8))
+    assert t1 == b.step_time(p, Candidate("fused", 0, 8))
+    # Failure mode: frames exceed the shard.
+    from heat2d_tpu.tune.measure import SimulatedCompileError
+    with pytest.raises(SimulatedCompileError):
+        b.step_time(Problem(12, 12), Candidate("fused", 0, 8))
+
+
+def test_fused_config_validation_ladder(tmp_path, monkeypatch):
+    """runtime.fused_config: no db -> None; a fused best -> applied
+    (and effective_halo_depth consumes it); a too-deep entry -> None
+    (degrades to the static depth); a non-fused best -> None."""
+    from heat2d_tpu.ops import pallas_stencil as ps
+    from heat2d_tpu.tune import runtime as rt
+    from heat2d_tpu.tune.db import TuningDB
+
+    monkeypatch.setattr(rt, "_explicit", None)
+    rt.set_tuning_db(None)
+    assert rt.fused_config(16, 16) is None
+
+    kind = ps._vmem_total()[1]
+    db = TuningDB(str(tmp_path / "db.json"))
+    fkey = "fused:16x16:float32"    # the fused-frontier namespace
+    db.record_point(kind, fkey,
+                    {"route": "fused", "bm": 0, "tsteps": 2,
+                     "status": "ok", "mcells_per_s": 100.0})
+    db.set_best(kind, fkey,
+                {"route": "fused", "bm": 0, "tsteps": 2}, 100.0, {})
+    db.save()
+    try:
+        rt.set_tuning_db(db)
+        cfg = rt.fused_config(16, 16)
+        assert cfg is not None and cfg.tsteps == 2
+        # The depth planner consumes it (fused requests only).
+        hc = HeatConfig(nxprob=32, nyprob=32, mode="dist2d", gridx=2,
+                        gridy=2, halo="fused")
+        assert effective_halo_depth(hc, make_mesh(2, 2)) == 2
+        col = hc.replace(halo="collective")
+        assert effective_halo_depth(col, make_mesh(2, 2)) == 8
+        # Too-deep for the shard: re-validation rejects it.
+        db.set_best(kind, fkey,
+                    {"route": "fused", "bm": 0, "tsteps": 12}, 90.0, {})
+        rt.set_tuning_db(db)
+        assert rt.fused_config(16, 16) is None
+        # A plain-frontier (single-chip) best never answers for fused —
+        # the namespaces are disjoint by design (global-mesh rates must
+        # not shadow band configs and vice versa).
+        db.set_best(kind, "16x16:float32",
+                    {"route": "C", "bm": 32, "tsteps": 8}, 80.0, {})
+        rt.set_tuning_db(db)
+        assert rt.fused_config(16, 16) is None
+        assert rt.band_config(16, 16) is not None   # band side intact
+    finally:
+        rt.set_tuning_db(None)
+
+
+def test_tuned_depth_steers_fused_run_bitwise(tmp_path):
+    """A db-steered overlap depth changes the schedule, never the
+    answer: fused with tuned T=2 stays bitwise-equal to collective."""
+    from heat2d_tpu.ops import pallas_stencil as ps
+    from heat2d_tpu.tune import runtime as rt
+    from heat2d_tpu.tune.db import TuningDB
+
+    kind = ps._vmem_total()[1]
+    db = TuningDB(str(tmp_path / "db.json"))
+    db.record_point(kind, "fused:16x16:float32",
+                    {"route": "fused", "bm": 0, "tsteps": 2,
+                     "status": "ok", "mcells_per_s": 100.0})
+    db.set_best(kind, "fused:16x16:float32",
+                {"route": "fused", "bm": 0, "tsteps": 2}, 100.0, {})
+    base = dict(nxprob=32, nyprob=32, steps=13, mode="dist2d",
+                gridx=2, gridy=2)
+    col = _run(HeatConfig(**base))
+    try:
+        rt.set_tuning_db(db)
+        fused = _run(HeatConfig(halo="fused", **base))
+    finally:
+        rt.set_tuning_db(None)
+    np.testing.assert_array_equal(fused.u, col.u)
+
+
+# ------------------------------------------------------------------ #
+# Strong-scaling measurement (the MULTICHIP gate metric)
+# ------------------------------------------------------------------ #
+
+def test_measure_strong_scaling_record(tmp_path):
+    from heat2d_tpu.parallel.scaling import (measure_strong_scaling,
+                                             scaling_record)
+    payloads = [measure_strong_scaling(4, nx=32, ny=32, steps=8,
+                                       halo=h)
+                for h in ("collective", "fused")]
+    for p in payloads:
+        assert p["n_devices"] == 4 and p["mesh"] == [2, 2]
+        assert p["per_chip_mcells_per_s_nchip"] > 0
+        assert np.isfinite(p["strong_scaling_efficiency"])
+    assert payloads[1]["halo"] == "fused"
+    assert payloads[1]["halo_tier"] in ("overlap", "ici")
+    out = tmp_path / "multichip.json"
+    rec = scaling_record(payloads, out_path=str(out))
+    assert rec["kind"] == "multichip" and out.exists()
+    import json
+    loaded = json.loads(out.read_text())
+    assert loaded["schema"] == rec["schema"]
+    assert len(loaded["scaling"]) == 2
+
+
+def test_scaling_square_mesh():
+    from heat2d_tpu.parallel.scaling import square_mesh
+    assert square_mesh(8) == (2, 4)
+    assert square_mesh(4) == (2, 2)
+    assert square_mesh(7) == (1, 7)
+    assert square_mesh(1) == (1, 1)
+
+
+def test_config_rejects_bad_halo():
+    with pytest.raises(ConfigError, match="halo must be"):
+        HeatConfig(nxprob=8, nyprob=8, halo="nonsense")

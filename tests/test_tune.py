@@ -500,8 +500,15 @@ def test_frontier_table_matches_entries(tmp_path):
     table = frontier_table(db, backend.device_kind)
     best = db.entry(backend.device_kind, "640x512:float32")["best"]
     tagged = [ln for ln in table.splitlines() if "<-- best" in ln]
-    assert len(tagged) == 1
-    assert best["route"] in tagged[0]
+    # One best per FRONTIER: the single-chip shape entry plus the
+    # fused-route namespace ("fused:640x512", its own frontier so
+    # global-mesh rates never contend with the single-chip best).
+    assert len(tagged) == 2
+    plain = [ln for ln in tagged
+             if ln.lstrip().startswith("640x512:")]
+    assert len(plain) == 1 and best["route"] in plain[0]
+    fused = [ln for ln in tagged if ln.lstrip().startswith("fused:")]
+    assert len(fused) == 1 and "fused" in fused[0]
 
 
 def test_selftest_cli_idempotent(tmp_path, capsys):

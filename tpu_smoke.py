@@ -421,6 +421,48 @@ def main() -> int:
     assert [int(k) for k in ks] == [25, 25]
     print("PASS ensemble batch x spatial ((1,1,1) mesh) steps")
 
+    # Fused halo route (ISSUE 8, docs/SCALING.md) on a real multi-chip
+    # mesh: dist2d overlap tier AND hybrid kernel F (in-kernel ICI
+    # async remote copies) must be BITWISE-identical to the collective
+    # route; resolve_halo_route must report the tier actually engaged.
+    ndev = len(jax.devices())
+    if ndev >= 2:
+        from heat2d_tpu.config import HeatConfig
+        from heat2d_tpu.parallel.mesh import make_mesh
+        from heat2d_tpu.parallel.scaling import square_mesh
+        from heat2d_tpu.parallel.sharded import resolve_halo_route
+
+        gxs, gys = square_mesh(ndev)
+        base = dict(nxprob=128 * gxs, nyprob=128 * gys, steps=20,
+                    gridx=gxs, gridy=gys)
+        for mode in ("dist2d", "hybrid"):
+            fcfg = HeatConfig(mode=mode, halo="fused", **base)
+            ck = None
+            if mode == "hybrid":
+                ck = ps.make_shard_chunk_kernel(fcfg)
+            route = resolve_halo_route(fcfg, make_mesh(gxs, gys),
+                                       chunk_kernel=ck)
+            fu = run(mode, base["nxprob"], base["nyprob"], 20,
+                     gridx=gxs, gridy=gys, halo="fused")
+            cu = run(mode, base["nxprob"], base["nyprob"], 20,
+                     gridx=gxs, gridy=gys)
+            np.testing.assert_array_equal(np.asarray(fu),
+                                          np.asarray(cu))
+            print(f"PASS fused halo {mode} bitwise vs collective "
+                  f"({gxs}x{gys} mesh, tier={route['tier']})")
+        # The hybrid resident shard must actually take kernel F here —
+        # a silent degradation would make the parity above vacuous.
+        hcfg = HeatConfig(mode="hybrid", halo="fused", **base)
+        hroute = resolve_halo_route(
+            hcfg, make_mesh(gxs, gys),
+            chunk_kernel=ps.make_shard_chunk_kernel(hcfg))
+        assert hroute["tier"] == "ici", (
+            f"expected kernel F on a resident shard, got {hroute}")
+        print("PASS fused halo hybrid engages kernel F (in-kernel ICI)")
+    else:
+        print("SKIP fused halo mesh checks (1 device attached)",
+              file=sys.stderr)
+
     print("ALL TPU SMOKE PATHS PASS")
     return 0
 
