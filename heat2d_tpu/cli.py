@@ -125,6 +125,13 @@ def build_parser() -> argparse.ArgumentParser:
                         "run (the mpiP analogue; digest it with "
                         "heat2d-tpu-prof LOGDIR, or view with "
                         "tensorboard --logdir / ui.perfetto.dev)")
+    o.add_argument("--trace-dir", default=None, metavar="DIR",
+                   help="arm distributed tracing (obs/tracing.py): "
+                        "host-side spans — run root, phase() entries — "
+                        "land as JSONL in DIR; merge with "
+                        "heat2d-tpu-trace DIR. Opt-in and free when "
+                        "off: the compiled programs are byte-identical "
+                        "either way. The run record gains trace_id")
     p.add_argument("--log-level", default=None,
                    choices=["debug", "info", "warning", "error"],
                    help="python logging level for the heat2d_tpu loggers")
@@ -389,6 +396,10 @@ def _run_ensemble_cli(args, cfg) -> int:
         tuned = _tune_runtime.applied_configs()
         if tuned:
             record["tuned_config"] = tuned
+        if getattr(args, "trace_span", None) is not None:
+            # run-record schema row: the request's trace — merge the
+            # span files with heat2d-tpu-trace (docs/OBSERVABILITY.md)
+            record["trace_id"] = args.trace_span.ctx.trace_id
         if registry is not None:
             registry.gauge("elapsed_s", float(elapsed))
             registry.gauge("members", len(cxs))
@@ -412,6 +423,23 @@ def main(argv=None) -> int:
         logging.getLogger("heat2d_tpu").setLevel(
             getattr(logging, args.log_level.upper()))
     _apply_platform(args)
+
+    args.trace_span = None
+    if args.trace_dir:
+        # HEAT2D_TRACE_DIR keeps subprocess semantics identical to the
+        # fleet's (children inherit the campaign), and the root span
+        # gives phase()/serve spans a parent + the record a trace_id.
+        # Explicit assignment, not setdefault: an explicit --trace-dir
+        # must win over a stale env var or the campaign silently
+        # splits across two directories.
+        os.environ["HEAT2D_TRACE_DIR"] = args.trace_dir
+        from heat2d_tpu.obs import tracing
+        tracing.install(tracing.Tracer(args.trace_dir, service="cli"))
+        args.trace_span = tracing.begin(
+            "cli.run", kind="request", mode=args.mode,
+            grid=f"{args.nxprob}x{args.nyprob}", steps=args.steps)
+        # phase() entries on this thread nest under the run root
+        tracing.set_ambient(args.trace_span.ctx)
 
     multihost = (args.multihost or args.coordinator is not None
                  or args.num_processes is not None
@@ -465,6 +493,8 @@ def main(argv=None) -> int:
         try:
             return _run_ensemble_cli(args, cfg)
         finally:
+            if args.trace_span is not None:
+                args.trace_span.end()
             if multihost:
                 from heat2d_tpu.parallel.multihost import (
                     shutdown_distributed)
@@ -710,6 +740,8 @@ def main(argv=None) -> int:
             record["resume_from_step"] = start_step
         if ckpt_writer is not None:
             record["checkpoints_written"] = ckpt_writer.saves
+        if getattr(args, "trace_span", None) is not None:
+            record["trace_id"] = args.trace_span.ctx.trace_id
         if solver.mesh is not None:
             from heat2d_tpu.parallel.mesh import mesh_devices_summary
             record["mesh"] = mesh_devices_summary(solver.mesh)
@@ -744,6 +776,8 @@ def main(argv=None) -> int:
             print(json.dumps(record, indent=2))
         return 0
     finally:
+        if args.trace_span is not None:
+            args.trace_span.end()
         if multihost:
             from heat2d_tpu.parallel.multihost import shutdown_distributed
             shutdown_distributed()

@@ -74,6 +74,29 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--metrics-out", default=None, metavar="PATH",
                    help="write telemetry JSONL (fleet_* families + the "
                         "kind='fleet' run record)")
+    p.add_argument("--trace-dir", default=None, metavar="DIR",
+                   help="arm fleet-wide distributed tracing AND the "
+                        "workers' crash flight recorders: the router "
+                        "and every worker write span JSONL into DIR "
+                        "(workers inherit HEAT2D_TRACE_DIR/"
+                        "HEAT2D_FLIGHT_DIR through the supervisor); "
+                        "merge with heat2d-tpu-trace DIR. A chaos-"
+                        "killed worker leaves a digest-sidecar'd "
+                        "post-mortem of its last seconds")
+    p.add_argument("--worker-env", action="append", default=[],
+                   metavar="SLOT:KEY=VAL",
+                   help="extra env for ONE worker slot (repeatable) — "
+                        "e.g. 0:HEAT2D_CHAOS_WORKER_KILL_AFTER=5 aims "
+                        "a chaos self-kill at worker 0 (unlike the "
+                        "supervisor-side --chaos SIGKILL, a self-kill "
+                        "flushes the worker's flight recorder)")
+    p.add_argument("--slo-p99", type=float, default=None, metavar="S",
+                   help="per-signature p99 latency target; evaluation "
+                        "lands in the run record's 'slo' rows and the "
+                        "slo_* gauges (docs/OBSERVABILITY.md)")
+    p.add_argument("--slo-error-budget", type=float, default=0.001,
+                   metavar="F",
+                   help="allowed failure fraction per signature")
     p.add_argument("--platform", default=None, choices=["cpu", "tpu"],
                    help="force a JAX platform for the workers "
                         "(default cpu: the soak is a logic gate, not a "
@@ -98,6 +121,20 @@ def _requests(args, n: int):
             cx=0.05 + 0.0003 * (i % 256), cy=0.1, method="jnp")
 
 
+def _parse_worker_env(specs) -> dict:
+    """--worker-env SLOT:KEY=VAL flags -> per_worker_env dict."""
+    out: dict = {}
+    for spec in specs:
+        try:
+            slot, kv = spec.split(":", 1)
+            key, val = kv.split("=", 1)
+            out.setdefault(int(slot), {})[key] = val
+        except ValueError:
+            raise SystemExit(f"bad --worker-env {spec!r} "
+                             f"(want SLOT:KEY=VAL)") from None
+    return out
+
+
 def run_soak(args, registry) -> int:
     from heat2d_tpu.fleet.router import FleetServer
     from heat2d_tpu.serve.schema import Rejected
@@ -118,7 +155,8 @@ def run_soak(args, registry) -> int:
         # not cache service (which has its own tests).
         cache_size=0, worker_cache_size=0,
         env=({"JAX_PLATFORMS": args.platform} if args.platform
-             else {"JAX_PLATFORMS": "cpu"}))
+             else {"JAX_PLATFORMS": "cpu"}),
+        per_worker_env=_parse_worker_env(args.worker_env))
     killed = []
     submitted = 0
     sem = threading.Semaphore(args.concurrency)
@@ -338,6 +376,20 @@ def _oracle_check(args, responses) -> int:
 
 def _write_metrics(args, registry, extra) -> None:
     from heat2d_tpu.obs.record import write_run_jsonl
+    if args.slo_p99 is not None and registry is not None:
+        from heat2d_tpu.obs import slo
+        slo.stamp_record(extra, slo.evaluate(
+            registry, prefix="fleet",
+            default=slo.SLOPolicy(latency_p99_s=args.slo_p99,
+                                  error_budget=args.slo_error_budget)))
+    if args.trace_dir:
+        from heat2d_tpu.obs import flight, tracing
+        t = tracing.tracer()
+        extra["trace"] = {
+            "dir": args.trace_dir,
+            "router_spans": t.spans_emitted if t is not None else 0,
+            "postmortems": len(flight.find_postmortems(args.trace_dir)),
+        }
     write_run_jsonl(registry, args.metrics_out, "fleet", extra)
 
 
@@ -352,6 +404,21 @@ def main(argv=None) -> int:
     # The router/oracle process stays on CPU unless told otherwise —
     # workers get their platform via env (run_soak).
     os.environ.setdefault("JAX_PLATFORMS", args.platform or "cpu")
+    if args.trace_dir:
+        # Router tracer here; workers inherit the campaign through the
+        # environment (the supervisor copies os.environ into each
+        # worker): every process writes spans into the ONE directory,
+        # and each worker arms a flight recorder the chaos kill points
+        # will flush (docs/OBSERVABILITY.md).
+        # explicit flag wins over any stale env vars: if setdefault
+        # kept an old HEAT2D_TRACE_DIR, the workers (which inherit the
+        # env) would write spans into a DIFFERENT directory than the
+        # router traces and --require-postmortem checks — a silently
+        # split campaign
+        os.environ["HEAT2D_TRACE_DIR"] = args.trace_dir
+        os.environ["HEAT2D_FLIGHT_DIR"] = args.trace_dir
+        from heat2d_tpu.obs import tracing
+        tracing.install(tracing.Tracer(args.trace_dir, service="router"))
 
     from heat2d_tpu.obs import MetricsRegistry
     registry = MetricsRegistry()

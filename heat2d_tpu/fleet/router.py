@@ -74,10 +74,11 @@ from typing import Dict, List, Optional
 
 from heat2d_tpu.fleet import wire
 from heat2d_tpu.fleet.supervisor import Supervisor, WorkerGone
+from heat2d_tpu.obs import tracing
 from heat2d_tpu.resil.retry import DegradedMode, RetryPolicy
 from heat2d_tpu.serve.cache import ResultCache, SingleFlight
 from heat2d_tpu.serve.schema import Rejected, SolveRequest, SolveResult
-from heat2d_tpu.serve.server import coalesced_future
+from heat2d_tpu.serve.server import _outcome_of, coalesced_future
 from heat2d_tpu.serve.server import failed_future as _failed
 
 log = logging.getLogger("heat2d_tpu.fleet")
@@ -110,6 +111,13 @@ class TenantPolicy:
                 f"priority must be >= 0, got {self.priority}")
 
 
+def _end_wire(rec, **attrs) -> None:
+    """Close the record's open wire span, if any (idempotent)."""
+    ws, rec.wire_span = rec.wire_span, None
+    if ws is not None:
+        ws.end(**attrs)
+
+
 def route_signature(sig: str, alive: List[int]) -> int:
     """Rendezvous hashing: the alive worker with the highest
     hash(sig, worker) wins. Deterministic, coordination-free, and
@@ -137,6 +145,13 @@ class _Inflight:
     rid: Optional[int] = None
     replays: int = 0
     warmup: bool = False
+    #: tracing (obs/tracing.py): the request's root span, and the
+    #: OPEN wire span of the current dispatch (a replay closes the old
+    #: one and opens a fresh one — one wire span per hop). Warmup
+    #: records never trace: they are the router's own business, not a
+    #: request's causal chain.
+    span: "object" = None
+    wire_span: "object" = None
 
 
 class FleetServer:
@@ -238,6 +253,7 @@ class FleetServer:
             self._records.clear()
             self._parked.clear()
         for rec in leftovers:
+            _end_wire(rec, outcome="shutdown")
             self.flight.fail(rec.key, Rejected(
                 "shutdown", "fleet stopping", content_hash=rec.key))
             self._count("rejected_shutdown")
@@ -264,6 +280,15 @@ class FleetServer:
             return _failed(e)
         key = req.content_hash()
 
+        # Tracing: the fleet-level root span — every dispatch/replay
+        # wire span and (cross-process) every worker-side span in this
+        # request's causal tree descends from it.
+        span = tracing.NULL_SPAN
+        if tracing.enabled():
+            span = tracing.begin(
+                "fleet.request", kind="request", content_hash=key,
+                signature=str(req.signature()), tenant=tenant)
+
         hit = self.cache.get(key)
         if hit is not None:
             # Served no matter what state the fleet is in: quota,
@@ -271,6 +296,7 @@ class FleetServer:
             # the fleet already holds.
             self._count("cache_hit")
             self._latency(t0)
+            span.end(outcome="cache_hit")
             fut = Future()
             fut.set_result(dataclasses.replace(
                 hit, cache_hit=True, coalesced=False))
@@ -281,9 +307,15 @@ class FleetServer:
             # worker will ever pick up (cache hits above still serve —
             # answers the router holds cost nothing)
             self._count("rejected_shutdown")
+            span.end(outcome="rejected_shutdown")
             return _failed(Rejected("shutdown", "fleet is stopped"))
 
         fut, leader = self.flight.claim(key)
+        if span is not tracing.NULL_SPAN:
+            if not leader:
+                span.set(coalesced=True)
+            fut.add_done_callback(
+                lambda f: span.end(outcome=_outcome_of(f)))
         if not leader:
             self._count("coalesced")
             out = coalesced_future(fut)
@@ -299,7 +331,8 @@ class FleetServer:
         rec = _Inflight(
             key=key, sig=str(req.signature()), tenant=tenant,
             req_dict=req.spec(), t0=t0,
-            deadline=None if timeout is None else t0 + timeout)
+            deadline=None if timeout is None else t0 + timeout,
+            span=span)
         fut.add_done_callback(lambda _f: self._release(tenant, t0))
         self._dispatch(rec)
         return fut
@@ -423,10 +456,19 @@ class FleetServer:
             msg = {"id": rid, "req": rec.req_dict}
             if rec.warmup:
                 msg["event"] = "warmup"
+            elif getattr(rec.span, "ctx", None) is not None:
+                # one wire span per HOP: begun at send, closed by the
+                # response / death / deadline — its context rides the
+                # DISPATCH line so the worker's spans nest under it
+                rec.wire_span = tracing.begin(
+                    "fleet.dispatch", kind="wire", parent=rec.span.ctx,
+                    slot=slot, rid=rid, replay=rec.replays)
+                msg["trace"] = rec.wire_span.ctx.to_wire()
             try:
                 self.sup.send(slot, msg)
                 return
             except WorkerGone:
+                _end_wire(rec, outcome="worker_gone_at_send")
                 with self._lock:
                     owned = self._records.pop(rid, None) is not None
                     if rec.warmup:
@@ -445,10 +487,13 @@ class FleetServer:
             rec = self._records.pop(msg.get("id"), None)
         if rec is None:
             return      # late line from a fenced worker, or a replayed
-            #             request already answered — dropped by design
+            #             request already answered — dropped by design:
+            #             no record, no span — a fenced worker's lines
+            #             can never attach spans to a replay's trace
         if rec.warmup:
             self._warmup_done(rec)
             return
+        _end_wire(rec, outcome="ok" if msg.get("ok") else "rejected")
         if msg.get("ok"):
             try:
                 res = wire.decode_result(msg)
@@ -462,6 +507,14 @@ class FleetServer:
             self.flight.resolve(rec.key, res)
             self.breaker.record_success()
             self._count("completed")
+            if self.registry is not None:
+                # per-signature latency/outcome: obs/slo.py's sources
+                self.registry.observe(
+                    "fleet_signature_latency_s",
+                    time.monotonic() - rec.t0, signature=rec.sig)
+                self.registry.counter(
+                    "fleet_signature_requests_total",
+                    signature=rec.sig, outcome="completed")
         else:
             # A structured worker-side rejection is an ANSWER (queue
             # full, watchdog timeout...), not a fleet fault: it must
@@ -469,6 +522,10 @@ class FleetServer:
             exc = wire.decode_rejection(msg)
             self.flight.fail(rec.key, exc)
             self._count("rejected_" + exc.code)
+            if self.registry is not None:
+                self.registry.counter(
+                    "fleet_signature_requests_total",
+                    signature=rec.sig, outcome="rejected_" + exc.code)
 
     def _on_worker_lost(self, slot: int) -> None:
         with self._lock:
@@ -488,6 +545,7 @@ class FleetServer:
         for rec in lost:
             rec.replays += 1
             self.replays += 1
+            _end_wire(rec, outcome="worker_lost")
             if self.registry is not None:
                 self.registry.counter("fleet_failover_replays_total")
             if rec.replays > self.max_replays:
@@ -498,6 +556,12 @@ class FleetServer:
                     content_hash=rec.key))
                 self._count("rejected_worker_lost")
             else:
+                if getattr(rec.span, "ctx", None) is not None:
+                    # the failover decision as an instant marker in the
+                    # request's trace — the "replay" critical-path
+                    # segment is the gap this event sits in
+                    tracing.event("fleet.replay", parent=rec.span.ctx,
+                                  from_slot=slot, replay=rec.replays)
                 self._dispatch(rec)
 
     def _on_worker_ready(self, slot: int,
@@ -581,6 +645,7 @@ class FleetServer:
                 # an overdue warmup must not wedge the slot cold
                 self._warmup_done(rec)
                 continue
+            _end_wire(rec, outcome="timeout")
             self.flight.fail(rec.key, Rejected(
                 "timeout", "request exceeded its fleet deadline",
                 content_hash=rec.key,

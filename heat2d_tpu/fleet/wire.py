@@ -11,8 +11,18 @@ desynchronized binary stream.
 
 supervisor -> worker::
 
-    {"id": 7, "req": {...SolveRequest.spec()...}}
+    {"id": 7, "req": {...SolveRequest.spec()...},
+     "trace": {"trace_id": "...", "span_id": "..."}}   # optional
     {"event": "shutdown"}              # drain and exit 0
+
+``trace`` is the OPTIONAL distributed-tracing context of the router's
+dispatch span (obs/tracing.py): a worker parents its serving spans on
+it so ``heat2d-tpu-trace`` can stitch the cross-process timeline.
+Strictly additive and envelope-level: lines without it parse
+unchanged (an old supervisor drives a new worker untraced, a new
+supervisor's trace field is ignored by an old worker's
+``msg.get``-based reader), and it never enters the request spec —
+trace context must not perturb content hashes or batch buckets.
 
 worker -> supervisor::
 
@@ -33,6 +43,14 @@ import base64
 from heat2d_tpu.serve.schema import Rejected, SolveResult
 
 PROTOCOL = "heat2d-tpu/fleet-wire/v1"
+
+
+def decode_trace(msg: dict):
+    """The dispatch line's tracing context, or None — malformed and
+    absent are the same non-event (back-compat is load-bearing: a
+    fenced old worker's lines must never fail to parse)."""
+    from heat2d_tpu.obs.tracing import TraceContext
+    return TraceContext.from_wire(msg.get("trace"))
 
 
 def encode_result(rid: int, res: SolveResult) -> dict:

@@ -75,16 +75,27 @@ def _warm_signature(server, emit, rid: int, spec: dict) -> None:
 def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
     from heat2d_tpu.fleet import wire
-    from heat2d_tpu.obs import MetricsRegistry
+    from heat2d_tpu.obs import MetricsRegistry, flight, tracing
     from heat2d_tpu.resil import chaos
-    from heat2d_tpu.serve.schema import Rejected, SolveRequest
+    from heat2d_tpu.serve.schema import (Rejected, SolveRequest,
+                                         attach_trace)
     from heat2d_tpu.serve.server import SolveServer
+
+    registry = MetricsRegistry()
+    service = f"worker{args.worker_id}"
+    # Observability is env-armed (both opt-in, both free when unset):
+    # the router CLI sets HEAT2D_TRACE_DIR / HEAT2D_FLIGHT_DIR and the
+    # supervisor passes the environment through, so every worker joins
+    # the tracing campaign and carries a black box the chaos kill
+    # points will flush (docs/OBSERVABILITY.md).
+    tracing.activate_from_env(service=service)
+    flight.maybe_install_from_env(service=service, registry=registry)
 
     server = SolveServer(
         max_batch=args.max_batch, max_delay=args.max_delay,
         max_queue=args.queue_depth, cache_size=args.cache_size,
         default_timeout=args.timeout,
-        registry=MetricsRegistry()).start()
+        registry=registry).start()
 
     wlock = threading.Lock()
 
@@ -139,6 +150,15 @@ def main(argv=None) -> int:
             warm_threads.append(t)
             t.start()
             continue
+        # The dispatch's trace context (absent on old-supervisor
+        # lines). The pickup marker is emitted BEFORE the chaos point:
+        # when HEAT2D_CHAOS_WORKER_KILL_AFTER fires here, the flight
+        # recorder's flushed ring already holds the in-flight
+        # request's span — the post-mortem names what died with us.
+        ctx = wire.decode_trace(msg)
+        if ctx is not None and tracing.enabled():
+            tracing.event("fleet.recv", parent=ctx, rid=rid,
+                          worker=args.worker_id)
         # Fault-injection point: slow-worker latency and the mid-load
         # hard kill both land here — the request is accepted (the
         # supervisor holds it in flight) but may never be answered.
@@ -148,14 +168,19 @@ def main(argv=None) -> int:
         except Rejected as e:
             emit(wire.encode_rejection(rid, e))
             continue
+        if ctx is not None:
+            attach_trace(req, ctx)  # serve spans nest under the wire's
         fut = server.submit(req)
 
-        def _done(f, rid=rid):
+        def _done(f, rid=rid, ctx=ctx):
             exc = f.exception()
             if exc is None:
                 emit(wire.encode_result(rid, f.result()))
             else:
                 emit(wire.encode_rejection(rid, exc))
+            if ctx is not None and tracing.enabled():
+                tracing.event("fleet.reply", parent=ctx, rid=rid,
+                              ok=exc is None, worker=args.worker_id)
 
         fut.add_done_callback(_done)
 

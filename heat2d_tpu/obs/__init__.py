@@ -19,6 +19,25 @@ the framework (SURVEY.md §5.1):
                      ``jax.profiler.trace`` logdir into the mpiP-style
                      digest (top ops by self-time, compute vs collective
                      vs host shares per device).
+- ``tracing``      — Dapper-style distributed request tracing: a
+                     TraceContext minted at admission rides through the
+                     batcher, engine, fleet wire, and failover replays;
+                     per-process span JSONL merged by
+                     ``heat2d-tpu-trace`` (``trace_cli``) into one
+                     cross-process timeline + per-request critical
+                     path. Opt-in (``HEAT2D_TRACE_DIR``), free when
+                     off (jaxpr-pinned).
+- ``flight``       — crash flight recorder: a bounded ring of recent
+                     spans/events flushed to a digest-sidecar'd
+                     post-mortem on SIGTERM, unhandled exceptions, and
+                     chaos kills (``HEAT2D_FLIGHT_DIR``).
+- ``slo``          — per-signature SLO objectives (latency targets +
+                     error-budget burn rate) evaluated from the
+                     registry's histograms, exported as ``slo_*``
+                     gauges and stamped into run records.
+- ``trace_cli``    — the ``heat2d-tpu-trace`` merger/exporter (Chrome
+                     trace-event / Perfetto output, connectivity and
+                     post-mortem assertions for CI).
 
 Metric families by producer (names are stable; docs/OBSERVABILITY.md
 and docs/SERVING.md carry the full tables):
@@ -46,6 +65,7 @@ and docs/SERVING.md carry the full tables):
                 total``, ``serve_breaker_trips_total``).
 """
 
+from heat2d_tpu.obs import flight, slo, tracing
 from heat2d_tpu.obs.metrics import MetricsRegistry, get_registry
 from heat2d_tpu.obs.record import (RECORD_KINDS, RECORD_SCHEMA,
                                    attach_context, build_record)
@@ -53,4 +73,5 @@ from heat2d_tpu.obs.stream import TelemetryStream, flush_taps
 
 __all__ = ["MetricsRegistry", "get_registry", "TelemetryStream",
            "flush_taps", "RECORD_KINDS", "RECORD_SCHEMA",
-           "attach_context", "build_record"]
+           "attach_context", "build_record", "tracing", "flight",
+           "slo"]

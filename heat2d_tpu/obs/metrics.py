@@ -22,11 +22,18 @@ import datetime
 import json
 import logging
 import math
+import random
 import re
 import threading
 import time
 
 log = logging.getLogger("heat2d_tpu.obs")
+
+#: histogram sample cap: below it quantiles are EXACT; above it the
+#: reservoir keeps a uniform sample (Algorithm R) while count/sum/min/
+#: max/mean stay exact — bounded memory under fleet soak (a plain
+#: append-forever list was a leak).
+HIST_RESERVOIR_CAP = 4096
 
 
 def _utc_now_iso() -> str:
@@ -68,6 +75,44 @@ def quantile(sorted_samples: list, q: float) -> float:
     return float(sorted_samples[i])
 
 
+class Reservoir:
+    """Bounded histogram storage: exact count/sum/min/max always;
+    the raw samples exactly up to ``cap``, then Algorithm R uniform
+    reservoir sampling (each of the n observations has cap/n odds of
+    being retained), so quantiles stay unbiased ESTIMATES above the
+    cap and EXACT below it. Deterministically seeded: two registries
+    fed the same stream summarize identically."""
+
+    __slots__ = ("cap", "count", "sum", "min", "max", "samples", "_rng")
+
+    def __init__(self, cap: int = HIST_RESERVOIR_CAP):
+        self.cap = cap
+        self.count = 0
+        self.sum = 0.0
+        self.min = math.inf
+        self.max = -math.inf
+        self.samples: list = []
+        self._rng = random.Random(0x1612)
+
+    def add(self, v: float) -> None:
+        self.count += 1
+        self.sum += v
+        if v < self.min:
+            self.min = v
+        if v > self.max:
+            self.max = v
+        if len(self.samples) < self.cap:
+            self.samples.append(v)
+        else:
+            i = self._rng.randrange(self.count)
+            if i < self.cap:
+                self.samples[i] = v
+
+    def exact(self) -> bool:
+        """True while quantiles are exact (no sample was evicted)."""
+        return self.count <= self.cap
+
+
 class MetricsRegistry:
     """Counters, gauges, timing histograms and labeled series.
 
@@ -76,11 +121,12 @@ class MetricsRegistry:
     labels is a different time series, as in Prometheus.
     """
 
-    def __init__(self):
+    def __init__(self, hist_cap: int = HIST_RESERVOIR_CAP):
         self._lock = threading.Lock()
+        self._hist_cap = hist_cap
         self._counters: dict = {}
         self._gauges: dict = {}
-        self._histograms: dict = {}
+        self._histograms: dict = {}     # key -> Reservoir (bounded)
         self._series: dict = {}
         self._events: list = []
 
@@ -98,10 +144,16 @@ class MetricsRegistry:
             self._gauges[(name, _label_key(labels))] = float(value)
 
     def observe(self, name: str, value: float, **labels) -> None:
-        """Add one sample to the (timing) histogram."""
+        """Add one sample to the (timing) histogram. Storage is a
+        bounded ``Reservoir`` — a soak observing forever holds at most
+        ``hist_cap`` samples per series while count/sum/min/max/mean
+        stay exact."""
         k = (name, _label_key(labels))
         with self._lock:
-            self._histograms.setdefault(k, []).append(float(value))
+            r = self._histograms.get(k)
+            if r is None:
+                r = self._histograms[k] = Reservoir(self._hist_cap)
+            r.add(float(value))
 
     def series(self, name: str, x, y, **labels) -> None:
         """Append an (x, y) point to a labeled series — e.g. the residual
@@ -128,14 +180,15 @@ class MetricsRegistry:
     # -- views --------------------------------------------------------- #
 
     @staticmethod
-    def _hist_summary(samples: list) -> dict:
-        s = sorted(samples)
+    def _hist_summary(res: "Reservoir") -> dict:
+        s = sorted(res.samples)
         return {
-            "count": len(s),
-            "sum": float(sum(s)),
-            "min": float(s[0]),
-            "max": float(s[-1]),
-            "mean": float(sum(s) / len(s)),
+            "count": res.count,
+            "sum": float(res.sum),
+            "min": float(res.min),
+            "max": float(res.max),
+            "mean": float(res.sum / res.count) if res.count else
+            float("nan"),
             "p50": quantile(s, 0.50),
             "p90": quantile(s, 0.90),
             "p99": quantile(s, 0.99),
@@ -185,13 +238,20 @@ class MetricsRegistry:
                   len(events), len(tuple(extra_records)), path)
 
     def prometheus_text(self) -> str:
-        """Prometheus text exposition of counters, gauges, and histogram
-        sum/count (the scrape-friendly view of the same registry)."""
+        """Prometheus text exposition: counters, gauges, and summaries.
+        Each histogram emits its EXACT running ``_sum``/``_count``
+        (rates — requests/s, mean latency — are computable from two
+        scrapes) plus ``{quantile="..."}`` sample lines per the
+        summary convention. Every series' pre-existing ``_sum``/
+        ``_count`` lines are byte-unchanged — the quantile lines are
+        strictly additive per series — so existing scrapers keep
+        working."""
         lines = []
         with self._lock:
             counters = dict(self._counters)
             gauges = dict(self._gauges)
-            hists = {k: list(v) for k, v in self._histograms.items()}
+            hists = {k: (v.sum, v.count, sorted(v.samples))
+                     for k, v in self._histograms.items()}
         seen = set()
 
         def typ(name, kind):
@@ -207,13 +267,35 @@ class MetricsRegistry:
             n = _prom_name(name)
             typ(n, "gauge")
             lines.append(f"{n}{_prom_labels(labels)} {v}")
-        for (name, labels), samples in sorted(hists.items()):
+        for (name, labels), (total, count, samples) in sorted(
+                hists.items()):
             n = _prom_name(name)
             typ(n, "summary")
-            lines.append(
-                f"{n}_sum{_prom_labels(labels)} {float(sum(samples))}")
-            lines.append(f"{n}_count{_prom_labels(labels)} {len(samples)}")
+            lines.append(f"{n}_sum{_prom_labels(labels)} {float(total)}")
+            lines.append(f"{n}_count{_prom_labels(labels)} {count}")
+            for q in (0.5, 0.9, 0.99):
+                ql = labels + (("quantile", f"{q}"),)
+                lines.append(f"{n}{_prom_labels(ql)} "
+                             f"{quantile(samples, q)}")
         return "\n".join(lines) + "\n"
+
+    # -- programmatic lookups (obs/slo.py) ----------------------------- #
+
+    def find_histograms(self, name: str) -> dict:
+        """{label-pairs tuple: summary} for every series of ``name`` —
+        the structured accessor (snapshot keys flatten labels into
+        strings, which is ambiguous for label VALUES containing
+        commas, e.g. signature tuples)."""
+        with self._lock:
+            keys = [k for k in self._histograms if k[0] == name]
+            return {k[1]: self._hist_summary(self._histograms[k])
+                    for k in keys}
+
+    def find_counters(self, name: str) -> dict:
+        """{label-pairs tuple: value} for every series of ``name``."""
+        with self._lock:
+            return {k[1]: v for k, v in self._counters.items()
+                    if k[0] == name}
 
     # -- multihost aggregation ----------------------------------------- #
 

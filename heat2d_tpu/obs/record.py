@@ -8,6 +8,16 @@ jax version, the device summary, and the multihost world — the execution
 context the reference only printf'd (SURVEY.md §5.5). Payload keys
 (config, timings, throughput, suite columns) ride beside the envelope so
 existing consumers keep working.
+
+Observability payload rows (PR 9, docs/OBSERVABILITY.md):
+
+- ``trace_id`` — the run's distributed-tracing root (present when the
+  emitter ran with ``--trace-dir``): the join key into the span files a
+  ``heat2d-tpu-trace`` merge reads.
+- ``trace`` — the emitting CLI's tracing summary (span dir, spans
+  emitted, post-mortem count for fleets).
+- ``slo`` — per-signature SLO evaluation rows (obs/slo.py: p50/p99 vs
+  target, error rate, burn rate, ok) when an SLO target was given.
 """
 
 from __future__ import annotations

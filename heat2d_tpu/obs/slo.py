@@ -1,0 +1,121 @@
+"""Per-signature SLO objectives — latency targets and error-budget
+burn, computed from the metrics registry.
+
+An SLO is a promise per compiled signature: "p99 end-to-end latency
+under T seconds, failure ratio under B". The serving layers already
+record everything needed — per-signature latency histograms
+(``serve_signature_latency_s{signature=...}`` /
+``fleet_signature_latency_s``) and per-signature outcome counters —
+so evaluation is pure registry arithmetic, run at export time (the
+CLIs call it once before writing the run record), never on the
+serving hot path.
+
+Burn rate is the SRE convention: ``error_rate / error_budget`` — 1.0
+means failures are consuming the budget exactly as fast as allowed,
+>1 means the objective will be violated if the rate holds. Results
+are exported twice: as ``slo_*`` gauges through the registry (so a
+Prometheus scrape sees them beside the raw histograms) and as the
+``slo`` row list stamped into run records (docs/OBSERVABILITY.md has
+the schema)."""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Optional
+
+#: outcomes that spend error budget: structured rejections that mean
+#: the SERVER failed the request (shed/timeout/fault), not that the
+#: request was invalid.
+FAILURE_OUTCOMES_EXCLUDED = ("completed", "cache_hit", "coalesced",
+                             "rejected_invalid")
+
+
+@dataclasses.dataclass(frozen=True)
+class SLOPolicy:
+    """One objective: a p99 latency target (seconds) and an error
+    budget (allowed failure fraction, e.g. 0.001 == 99.9%)."""
+
+    latency_p99_s: float
+    error_budget: float = 0.001
+
+    def __post_init__(self):
+        if self.latency_p99_s <= 0:
+            raise ValueError(f"latency_p99_s must be > 0, got "
+                             f"{self.latency_p99_s}")
+        if not (0 < self.error_budget <= 1):
+            raise ValueError(f"error_budget must be in (0, 1], got "
+                             f"{self.error_budget}")
+
+
+def evaluate(registry, *, prefix: str = "serve",
+             default: Optional[SLOPolicy] = None,
+             policies: Optional[Dict[str, SLOPolicy]] = None) -> list:
+    """Evaluate SLOs against the ``<prefix>_signature_*`` families.
+
+    ``policies`` maps signature strings to objectives; ``default``
+    covers every signature not named (None = signatures without a
+    policy are reported but not judged). Returns one row per observed
+    signature and exports the ``slo_*`` gauges as a side effect."""
+    policies = policies or {}
+    rows = []
+    hists = registry.find_histograms(prefix + "_signature_latency_s")
+    counts = registry.find_counters(prefix + "_signature_requests_total")
+
+    sigs = sorted(({dict(k).get("signature") for k in hists}
+                   | {dict(k).get("signature") for k in counts})
+                  - {None})
+    for sig in sigs:
+        pol = policies.get(sig, default)
+        summary = None
+        for k, v in hists.items():
+            if dict(k).get("signature") == sig:
+                summary = v
+                break
+        total = failures = 0.0
+        for k, v in counts.items():
+            kd = dict(k)
+            if kd.get("signature") != sig:
+                continue
+            total += v
+            if kd.get("outcome") not in FAILURE_OUTCOMES_EXCLUDED:
+                failures += v
+        row = {
+            "signature": sig,
+            "requests": total,
+            "failures": failures,
+            "error_rate": (failures / total) if total else 0.0,
+            "p50_s": summary["p50"] if summary else None,
+            "p99_s": summary["p99"] if summary else None,
+        }
+        if pol is not None:
+            burn = row["error_rate"] / pol.error_budget
+            latency_ok = (summary is None
+                          or summary["p99"] <= pol.latency_p99_s)
+            row.update(
+                latency_target_p99_s=pol.latency_p99_s,
+                latency_ok=latency_ok,
+                error_budget=pol.error_budget,
+                burn_rate=burn,
+                budget_ok=burn <= 1.0,
+                ok=latency_ok and burn <= 1.0)
+            if registry is not None:
+                if summary is not None:
+                    # no latency samples (e.g. every request failed):
+                    # no p99 gauge — a NaN would poison strict JSON
+                    # consumers of the metrics snapshot
+                    registry.gauge("slo_latency_p99_s",
+                                   summary["p99"], signature=sig)
+                registry.gauge("slo_latency_target_s",
+                               pol.latency_p99_s, signature=sig)
+                registry.gauge("slo_burn_rate", burn, signature=sig)
+                registry.gauge("slo_ok", 1.0 if row["ok"] else 0.0,
+                               signature=sig)
+        rows.append(row)
+    return rows
+
+
+def stamp_record(extra: dict, rows: list) -> dict:
+    """Attach the SLO evaluation to a run-record payload IN PLACE
+    (returns it) — the ``slo`` schema row in docs/OBSERVABILITY.md."""
+    extra["slo"] = rows
+    return extra

@@ -1,0 +1,386 @@
+"""Distributed request tracing — Dapper-style spans with cross-process
+causality over the whole serving stack.
+
+The reference's only profiling artifact is a per-rank mpiP digest
+(Report.pdf p.34-37; mirrored by ``obs/trace_report.py``) — a
+per-process AGGREGATE. A served request now crosses the fleet router,
+a JSONL wire, a worker ``SolveServer``, the micro-batcher, and an
+engine launch; an aggregate cannot say where *one slow request* spent
+its time. This module is the per-request view: a ``TraceContext``
+(``trace_id``/``span_id``/``parent_id``) is minted at request
+admission and propagated through every layer — the batcher's queue,
+the engine's launches, the fleet wire's DISPATCH lines, failover
+replays — so ``heat2d-tpu-trace`` (obs/trace_cli.py) can merge the
+per-process span files into ONE timeline with cross-process edges and
+a per-request critical-path breakdown (queue wait vs compile vs
+launch vs wire vs replay).
+
+**Free when off — the obs prime directive.** Every hook site checks
+``tracing.enabled()`` (one module-level bool) first; spans are pure
+host-side bookkeeping and never touch a traced value, so the compiled
+programs are byte-identical with tracing on or off
+(tests/test_tracing.py pins the solver, band-runner, and serve
+batch-runner jaxprs). Activation is opt-in: programmatic
+(``install(Tracer(...))``) or ``HEAT2D_TRACE_DIR`` in the environment
+(how fleet workers inherit the campaign from the router's CLI).
+
+Span records are one JSON object per line in
+``<dir>/spans-<service>-<pid>.jsonl``::
+
+    {"event": "span", "schema": ..., "service": "worker0", "pid": 123,
+     "trace_id": "4bf9...", "span_id": "00f3...", "parent_id": "...",
+     "name": "serve.launch", "kind": "launch", "t0": ..., "t1": ...,
+     "attrs": {"signature": "...", "first_launch": true}}
+
+``t0``/``t1`` are epoch seconds derived from one per-process
+monotonic->epoch anchor, so intervals are monotonic-accurate and
+cross-process alignment is wall-clock-accurate (same-host fleets; see
+docs/OBSERVABILITY.md on clock skew). Every finished span is also
+teed into the flight recorder's ring buffer (obs/flight.py) when one
+is installed — the black box a chaos-killed worker leaves behind.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import secrets
+import threading
+import time
+from typing import Optional
+
+TRACE_SCHEMA = "heat2d-tpu/trace-span/v1"
+
+#: span kinds the critical-path breakdown buckets by
+#: (obs/trace_cli.py); "internal" is everything else.
+SPAN_KINDS = ("request", "queue", "launch", "wire", "replay", "phase",
+              "event", "internal")
+
+
+@dataclasses.dataclass(frozen=True)
+class TraceContext:
+    """One node of a request's causal tree: the globally-unique
+    ``trace_id`` names the request, ``span_id`` names this operation.
+    Plain data — it crosses the fleet wire as two hex strings."""
+
+    trace_id: str
+    span_id: str
+
+    def to_wire(self) -> dict:
+        return {"trace_id": self.trace_id, "span_id": self.span_id}
+
+    @classmethod
+    def from_wire(cls, d) -> Optional["TraceContext"]:
+        """A context from a wire dict, or None for anything malformed —
+        an old supervisor's trace-less line must parse as 'no trace',
+        never as an error (fleet back-compat)."""
+        if not isinstance(d, dict):
+            return None
+        tid, sid = d.get("trace_id"), d.get("span_id")
+        if not (isinstance(tid, str) and isinstance(sid, str)
+                and tid and sid):
+            return None
+        return cls(trace_id=tid, span_id=sid)
+
+
+def _new_trace_id() -> str:
+    return secrets.token_hex(16)
+
+
+def _new_span_id() -> str:
+    return secrets.token_hex(8)
+
+
+class Span:
+    """One in-progress operation. Created by ``Tracer.begin`` (or the
+    ``span()`` context manager); ``end()`` stamps the close time and
+    emits the record. Spans may be ended from a DIFFERENT thread than
+    they began on (a queue span begins on the submitting thread and
+    ends on the scheduler thread) — the tracer's emit path is
+    thread-safe and ``end()`` is idempotent."""
+
+    __slots__ = ("tracer", "name", "kind", "ctx", "parent_id", "t0",
+                 "attrs", "_done")
+
+    def __init__(self, tracer: "Tracer", name: str, kind: str,
+                 ctx: TraceContext, parent_id: Optional[str],
+                 t0: float, attrs: dict):
+        self.tracer = tracer
+        self.name = name
+        self.kind = kind
+        self.ctx = ctx
+        self.parent_id = parent_id
+        self.t0 = t0
+        self.attrs = attrs
+        self._done = False
+
+    def set(self, **attrs) -> "Span":
+        self.attrs.update(attrs)
+        return self
+
+    def end(self, **attrs) -> None:
+        """Close the span (idempotent — a future's done-callback may
+        race a failure path; first close wins)."""
+        if self._done:
+            return
+        self._done = True
+        if attrs:
+            self.attrs.update(attrs)
+        self.tracer._emit(self, time.monotonic())
+
+
+class _NullSpan:
+    """The disabled-path stand-in: every method a no-op, ``ctx`` is
+    None, so hook sites can run unconditionally after one enabled()
+    check."""
+
+    ctx = None
+    attrs: dict = {}
+
+    def set(self, **attrs):
+        return self
+
+    def end(self, **attrs):
+        pass
+
+
+NULL_SPAN = _NullSpan()
+
+
+class Tracer:
+    """Per-process span sink. ``dir`` is the shared trace directory
+    (one file per process inside it); ``sink`` (a callable taking the
+    record dict) replaces the file for in-process tests. ``service``
+    names this process's lane in the merged timeline ("router",
+    "worker0", "cli")."""
+
+    def __init__(self, dir: Optional[str] = None, *,
+                 service: str = "main", sink=None):
+        if dir is None and sink is None:
+            raise ValueError("Tracer needs a dir or a sink")
+        self.dir = dir
+        self.service = service
+        self.sink = sink
+        self.pid = os.getpid()
+        # ONE monotonic->epoch anchor per tracer: every span timestamp
+        # is epoch0 + (mono - mono0), so in-process intervals are
+        # monotonic-exact and never jump with wall-clock adjustments.
+        self._epoch0 = time.time()
+        self._mono0 = time.monotonic()
+        self._lock = threading.Lock()
+        self._file = None
+        self.path = (None if dir is None else os.path.join(
+            dir, f"spans-{service}-{self.pid}.jsonl"))
+        self.spans_emitted = 0
+
+    # -- time ---------------------------------------------------------- #
+
+    def epoch_of(self, mono: float) -> float:
+        """Epoch seconds for a ``time.monotonic()`` stamp (how
+        retroactive spans — queue waits recorded at dispatch — get
+        consistent timestamps)."""
+        return self._epoch0 + (mono - self._mono0)
+
+    # -- span lifecycle ------------------------------------------------ #
+
+    def mint(self, parent: Optional[TraceContext] = None) -> TraceContext:
+        """A fresh context: same trace as ``parent`` (new span id), or
+        a brand-new trace when there is no parent — request admission
+        mints the root here."""
+        return TraceContext(
+            trace_id=parent.trace_id if parent else _new_trace_id(),
+            span_id=_new_span_id())
+
+    def begin(self, name: str, *, kind: str = "internal",
+              parent: Optional[TraceContext] = None, **attrs) -> Span:
+        ctx = self.mint(parent)
+        sp = Span(self, name, kind, ctx,
+                  parent.span_id if parent else None,
+                  time.monotonic(), dict(attrs))
+        # A span_start record the moment the span opens: a process
+        # killed mid-span (the chaos scenario this subsystem exists
+        # for) still leaves its open spans in the file/ring, so the
+        # merged trace stays CONNECTED — the reader synthesizes an
+        # "unfinished" span for any start without a matching end.
+        self._write({
+            "event": "span_start", "schema": TRACE_SCHEMA,
+            "service": self.service, "pid": self.pid,
+            "trace_id": ctx.trace_id, "span_id": ctx.span_id,
+            "parent_id": sp.parent_id, "name": name, "kind": kind,
+            "t0": self.epoch_of(sp.t0), "attrs": dict(sp.attrs),
+        })
+        return sp
+
+    def emit_span(self, name: str, t0_mono: float, t1_mono: float, *,
+                  kind: str = "internal",
+                  parent: Optional[TraceContext] = None,
+                  **attrs) -> TraceContext:
+        """A retroactively-timed, already-finished span (e.g. the
+        queue wait, known only at dispatch). Returns its context."""
+        sp = Span(self, name, kind, self.mint(parent),
+                  parent.span_id if parent else None, t0_mono,
+                  dict(attrs))
+        sp._done = True
+        self._emit(sp, t1_mono)
+        return sp.ctx
+
+    def event(self, name: str, *, parent: Optional[TraceContext] = None,
+              **attrs) -> TraceContext:
+        """An instantaneous marker span (kind="event") — e.g. a wire
+        line's receipt, a failover replay decision."""
+        now = time.monotonic()
+        return self.emit_span(name, now, now, kind="event",
+                              parent=parent, **attrs)
+
+    # -- emission ------------------------------------------------------ #
+
+    def _emit(self, span: Span, t1_mono: float) -> None:
+        rec = {
+            "event": "span", "schema": TRACE_SCHEMA,
+            "service": self.service, "pid": self.pid,
+            "trace_id": span.ctx.trace_id, "span_id": span.ctx.span_id,
+            "parent_id": span.parent_id,
+            "name": span.name, "kind": span.kind,
+            "t0": self.epoch_of(span.t0),
+            "t1": self.epoch_of(t1_mono),
+            "attrs": span.attrs,
+        }
+        self.spans_emitted += 1
+        self._write(rec)
+
+    def _write(self, rec: dict) -> None:
+        from heat2d_tpu.obs import flight
+        flight.note_span(rec)
+        with self._lock:
+            if self.sink is not None:
+                self.sink(rec)
+                return
+            try:
+                if self._file is None:
+                    os.makedirs(self.dir, exist_ok=True)
+                    self._file = open(self.path, "a")
+                # one line per record, flushed: a killed process's file
+                # is complete up to the kill (torn-line tolerant
+                # readers skip at most the final line)
+                self._file.write(json.dumps(rec) + "\n")
+                self._file.flush()
+            except OSError:
+                pass    # tracing must never take the serving path down
+
+    def close(self) -> None:
+        with self._lock:
+            if self._file is not None:
+                try:
+                    self._file.close()
+                except OSError:
+                    pass
+                self._file = None
+
+
+# -- the process-global tracer (chaos.py's install/env pattern) -------- #
+
+_lock = threading.Lock()
+_tracer: Optional[Tracer] = None
+_enabled = False        # fast-path guard: False == all hooks no-op
+_env_checked = False
+
+ENV_DIR = "HEAT2D_TRACE_DIR"
+
+
+def install(tracer: Optional[Tracer]) -> None:
+    """Activate a tracer programmatically; ``None`` disarms. A tracer
+    being replaced is closed (its span file handle released)."""
+    global _tracer, _enabled, _env_checked
+    with _lock:
+        if _tracer is not None and _tracer is not tracer:
+            _tracer.close()
+        _env_checked = True
+        _tracer, _enabled = tracer, tracer is not None
+
+
+def uninstall() -> None:
+    """Disarm and forget; the environment is re-read on next use
+    (fresh processes pick their campaign up from ``HEAT2D_TRACE_DIR``)."""
+    global _tracer, _enabled, _env_checked
+    with _lock:
+        if _tracer is not None:
+            _tracer.close()
+        _tracer, _enabled, _env_checked = None, False, False
+
+
+def activate_from_env(service: str = "main") -> Optional[Tracer]:
+    """Install a tracer iff ``HEAT2D_TRACE_DIR`` is set (how worker
+    subprocesses join the router's campaign — the supervisor passes
+    the environment through). Idempotent: an already-installed tracer
+    wins."""
+    global _tracer, _enabled, _env_checked
+    with _lock:
+        if _tracer is not None:
+            return _tracer
+        d = os.environ.get(ENV_DIR)
+        if d:
+            _tracer = Tracer(d, service=service)
+            _enabled = True
+        _env_checked = True
+        return _tracer
+
+
+def tracer() -> Optional[Tracer]:
+    """The active tracer, consulting the environment on first use."""
+    if not _env_checked:
+        activate_from_env()
+    return _tracer
+
+
+def enabled() -> bool:
+    if not _env_checked:
+        activate_from_env()
+    return _enabled
+
+
+# -- ambient context (thread-local) ------------------------------------ #
+
+_ambient = threading.local()
+
+
+def set_ambient(ctx: Optional[TraceContext]) -> None:
+    """Set THIS thread's ambient parent context — what free-floating
+    spans (``phase()`` entries) attach to when nothing explicit is in
+    scope. The CLI's run-root sets it; server/worker paths never do
+    (their parents are always explicit)."""
+    _ambient.ctx = ctx
+
+
+def ambient() -> Optional[TraceContext]:
+    return getattr(_ambient, "ctx", None)
+
+
+# -- hook-site conveniences (cheap no-ops when off) -------------------- #
+
+def begin(name: str, *, kind: str = "internal",
+          parent: Optional[TraceContext] = None, **attrs):
+    """A live span, or ``NULL_SPAN`` when tracing is off — hook sites
+    call ``.end()`` unconditionally."""
+    t = tracer() if _enabled or not _env_checked else None
+    if t is None:
+        return NULL_SPAN
+    return t.begin(name, kind=kind, parent=parent, **attrs)
+
+
+def emit(name: str, t0_mono: float, t1_mono: float, *,
+         kind: str = "internal", parent: Optional[TraceContext] = None,
+         **attrs) -> Optional[TraceContext]:
+    t = tracer() if _enabled or not _env_checked else None
+    if t is None:
+        return None
+    return t.emit_span(name, t0_mono, t1_mono, kind=kind,
+                       parent=parent, **attrs)
+
+
+def event(name: str, *, parent: Optional[TraceContext] = None,
+          **attrs) -> Optional[TraceContext]:
+    t = tracer() if _enabled or not _env_checked else None
+    if t is None:
+        return None
+    return t.event(name, parent=parent, **attrs)

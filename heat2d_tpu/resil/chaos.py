@@ -57,6 +57,18 @@ class ChaosError(RuntimeError):
     retryable, like the real launch transients it stands in for)."""
 
 
+def _flight_flush(reason: str) -> None:
+    """Flush the crash flight recorder, if one is installed, before a
+    hard kill. Cold path only (runs once, just before ``os._exit``);
+    guarded so a broken recorder can never stop the kill — the chaos
+    contract is that the process DIES."""
+    try:
+        from heat2d_tpu.obs import flight
+        flight.crash_flush(reason)
+    except BaseException:   # noqa: BLE001 — the kill must proceed
+        pass
+
+
 @dataclasses.dataclass
 class ChaosConfig:
     """One injection campaign. All fields off by default; an explicit
@@ -160,6 +172,12 @@ class _Controller:
                 and phase == cfg.kill_ckpt_phase):
             # Hard kill: no atexit, no finally blocks — the closest a
             # test harness gets to power loss / SIGKILL preemption.
+            # The flight recorder (obs/flight.py) is the ONE exception:
+            # a black box that doesn't survive the crash records
+            # nothing, so the kill points flush it explicitly — it
+            # writes only its own sidecar'd file, never the checkpoint
+            # state whose torn-write windows this kill exists to test.
+            _flight_flush("chaos_kill_ckpt")
             os._exit(137)
 
     def launch_point(self) -> None:
@@ -190,8 +208,12 @@ class _Controller:
                 and n == cfg.worker_kill_after):
             # Hard kill mid-pickup: the request was accepted but will
             # never be answered — the supervisor sees the death and the
-            # router must replay the in-flight work to a survivor.
+            # router must replay the in-flight work to a survivor. The
+            # flight recorder flushes first (checkpoint_point on why):
+            # the post-mortem must contain the in-flight request's
+            # spans.
             self._count("worker_kill")
+            _flight_flush("chaos_worker_kill")
             os._exit(137)
 
     def heartbeat_point(self) -> bool:

@@ -34,7 +34,8 @@ import threading
 import time
 from typing import Callable, Optional
 
-from heat2d_tpu.serve.schema import Rejected, SolveRequest
+from heat2d_tpu.obs import tracing
+from heat2d_tpu.serve.schema import Rejected, SolveRequest, request_trace
 
 log = logging.getLogger("heat2d_tpu.serve")
 
@@ -288,10 +289,18 @@ class MicroBatcher:
             self.registry.gauge("serve_queue_depth", self.depth())
 
     def _record_batch(self, sig, batch) -> None:
+        now = time.monotonic()
+        if tracing.enabled():
+            # the queue-wait span, retro-stamped admission -> dispatch
+            # (begun on the submitting thread, known-finished here on
+            # the scheduler thread — tracing.emit covers that shape)
+            for p in batch:
+                tracing.emit("serve.queue", p.enqueued, now,
+                             kind="queue", parent=request_trace(p.req),
+                             signature=str(sig))
         r = self.registry
         if r is None:
             return
-        now = time.monotonic()
         r.counter("serve_dispatch_total")
         r.observe("serve_batch_occupancy", len(batch))
         r.observe("serve_batch_fill", len(batch) / self.max_batch)

@@ -59,6 +59,20 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--metrics-out", default=None, metavar="PATH",
                    help="write telemetry JSONL (events + snapshot + the "
                         "kind='serve' run record)")
+    p.add_argument("--trace-dir", default=None, metavar="DIR",
+                   help="arm distributed request tracing: per-request "
+                        "spans (admission, queue, launch) land as JSONL "
+                        "in DIR; merge with heat2d-tpu-trace DIR "
+                        "(docs/OBSERVABILITY.md). Free when off")
+    s2 = p.add_argument_group("SLO objectives (docs/OBSERVABILITY.md)")
+    s2.add_argument("--slo-p99", type=float, default=None, metavar="S",
+                    help="per-signature p99 latency target in seconds; "
+                         "evaluation lands in the run record's 'slo' "
+                         "rows and the slo_* gauges")
+    s2.add_argument("--slo-error-budget", type=float, default=0.001,
+                    metavar="F",
+                    help="allowed failure fraction per signature "
+                         "(default 0.001 = 99.9%%)")
     p.add_argument("--platform", default=None, choices=["cpu", "tpu"],
                    help="force a JAX platform (selftest defaults to cpu)")
     p.add_argument("--log-level", default=None,
@@ -201,6 +215,28 @@ def run_requests(args, registry) -> int:
 
 
 def _write_metrics(args, registry, server, extra=None) -> None:
+    extra = dict(extra or {})
+    if args.slo_p99 is not None:
+        # SLO evaluation at export time (never on the serving path):
+        # slo_* gauges into the registry + the 'slo' record rows.
+        from heat2d_tpu.obs import slo
+        rows = slo.evaluate(
+            registry, prefix="serve",
+            default=slo.SLOPolicy(latency_p99_s=args.slo_p99,
+                                  error_budget=args.slo_error_budget))
+        slo.stamp_record(extra, rows)
+        for r in rows:
+            if not r.get("ok", True):
+                print(f"SLO VIOLATION: {r['signature']}: p99 "
+                      f"{r['p99_s']} vs target "
+                      f"{r['latency_target_p99_s']}, burn rate "
+                      f"{r['burn_rate']:.2f}", file=sys.stderr)
+    if args.trace_dir:
+        from heat2d_tpu.obs import tracing
+        t = tracing.tracer()
+        extra["trace"] = {"dir": args.trace_dir,
+                          "spans_emitted": (t.spans_emitted
+                                            if t is not None else 0)}
     if not args.metrics_out:
         return
     from heat2d_tpu.obs.record import build_record
@@ -235,6 +271,13 @@ def main(argv=None) -> int:
         os.environ["JAX_PLATFORMS"] = platform
         import jax
         jax.config.update("jax_platforms", platform)
+
+    if args.trace_dir:
+        # explicit flag wins over any stale env var — otherwise the
+        # campaign silently splits across two directories
+        os.environ["HEAT2D_TRACE_DIR"] = args.trace_dir
+        from heat2d_tpu.obs import tracing
+        tracing.install(tracing.Tracer(args.trace_dir, service="serve"))
 
     from heat2d_tpu.obs import MetricsRegistry
     registry = MetricsRegistry()

@@ -65,6 +65,11 @@ class EnsembleEngine:
         self.halo = halo
         self.launches = 0           # total ensemble launches performed
         self.launch_log: List[dict] = []   # one row per launch (tests)
+        #: signatures that have launched at least once in THIS process
+        #: — a signature's first launch pays the jit compile, so the
+        #: launch row (and the tracing span built from it) flags it:
+        #: the trace CLI attributes first-launch time to "compile".
+        self._launched: set = set()
         #: signature -> tuned-config dict (or None) resolved BEFORE the
         #: signature's first compile — warmup provenance for the
         #: per-signature compile cache (docs/TUNING.md).
@@ -192,8 +197,15 @@ class EnsembleEngine:
                 steps_done = [req0.steps] * capacity
 
         self.launches += 1
+        # per (signature, capacity): the padded ladder compiles one
+        # program per capacity, so a known signature at a NEW capacity
+        # still pays a compile
+        compile_key = (req0.signature(), capacity)
+        first_launch = compile_key not in self._launched
+        self._launched.add(compile_key)
         row = {"signature": req0.signature(), "occupancy": n,
-               "capacity": capacity, "tuned_config": tuned}
+               "capacity": capacity, "tuned_config": tuned,
+               "first_launch": first_launch}
         if self.spatial_grid is not None:
             row["halo_plan"] = self.halo_plans.get(req0.signature())
         self.launch_log.append(row)

@@ -63,6 +63,15 @@ class SolveRequest:
     convergence: bool = False
     interval: int = 20
     sensitivity: float = 0.1
+    #: distributed-tracing context (obs/tracing.TraceContext) riding
+    #: BESIDE the problem spec: compare=False keeps it out of eq/hash,
+    #: and spec()/content_hash()/signature() never read it — two
+    #: requests differing only in trace are the SAME computation
+    #: (same cache entry, same bucket). Not a wire field: from_dict
+    #: rejects it (the fleet wire carries trace in its own envelope
+    #: key, never inside the request spec).
+    trace: "object" = dataclasses.field(
+        default=None, compare=False, repr=False)
 
     def validate(self) -> "SolveRequest":
         if self.nx < 3 or self.ny < 3:
@@ -125,7 +134,10 @@ class SolveRequest:
 
     @classmethod
     def from_dict(cls, d: dict) -> "SolveRequest":
-        known = {f.name for f in dataclasses.fields(cls)}
+        # 'trace' is deliberately NOT a request field on the wire: the
+        # spec is the computation, the trace context is an envelope
+        # concern (fleet/wire.py carries it beside the spec).
+        known = {f.name for f in dataclasses.fields(cls)} - {"trace"}
         bad = set(d) - known
         if bad:
             raise Rejected("invalid",
@@ -134,6 +146,24 @@ class SolveRequest:
             return cls(**d).validate()
         except TypeError as e:
             raise Rejected("invalid", str(e)) from None
+
+
+def attach_trace(req, ctx) -> None:
+    """Attach a tracing context to a (frozen) request IN PLACE. Works
+    for any request implementing the serving protocol (SolveRequest,
+    diff's InverseRequest) — the context is observability metadata,
+    excluded from hash/signature/eq by contract, so mutating it never
+    changes what the request MEANS."""
+    try:
+        object.__setattr__(req, "trace", ctx)
+    except (AttributeError, TypeError):
+        pass    # slotted duck-types without the field: trace is lost,
+        #         the request still serves
+
+
+def request_trace(req):
+    """The attached tracing context, or None."""
+    return getattr(req, "trace", None)
 
 
 @dataclasses.dataclass

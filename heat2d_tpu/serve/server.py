@@ -44,12 +44,14 @@ import time
 from concurrent.futures import Future, ThreadPoolExecutor
 from typing import Optional
 
+from heat2d_tpu.obs import tracing
 from heat2d_tpu.resil.retry import (DegradedMode, RetryPolicy, Watchdog,
                                     call_with_retries)
 from heat2d_tpu.serve.batcher import MicroBatcher
 from heat2d_tpu.serve.cache import ResultCache, SingleFlight
 from heat2d_tpu.serve.engine import EnsembleEngine
-from heat2d_tpu.serve.schema import Rejected, SolveRequest, SolveResult
+from heat2d_tpu.serve.schema import (Rejected, SolveRequest, SolveResult,
+                                     attach_trace, request_trace)
 
 
 class SolveServer:
@@ -147,6 +149,19 @@ class SolveServer:
             return _failed(e)
         key = req.content_hash()
 
+        # Tracing: one "serve.request" span per admission, child of any
+        # context that arrived WITH the request (a fleet worker's wire
+        # dispatch) — every downstream span (queue, launch) descends
+        # from it via the attached context. NULL_SPAN when off: zero
+        # bookkeeping, programs untouched (tests pin the jaxprs).
+        span = tracing.NULL_SPAN
+        if tracing.enabled():
+            span = tracing.begin(
+                "serve.request", kind="request",
+                parent=request_trace(req), content_hash=key,
+                signature=str(req.signature()))
+            attach_trace(req, span.ctx)
+
         hit = self.cache.get(key)
         if hit is not None:
             # Cache hits are served even in degraded mode: the breaker
@@ -155,11 +170,19 @@ class SolveServer:
             # (SolveResult, diff's InverseResult) implements.
             self._count("cache_hit")
             self._latency(t0)
+            span.end(outcome="cache_hit")
             fut = Future()
             fut.set_result(hit.as_cache_hit())
             return fut
 
         fut, leader = self.flight.claim(key)
+        if span is not tracing.NULL_SPAN:
+            # one close per admission, whatever path answers it (a
+            # follower's span closes when the leader's future does)
+            if not leader:
+                span.set(coalesced=True)
+            fut.add_done_callback(
+                lambda f: span.end(outcome=_outcome_of(f)))
         if leader and not self.breaker.allow():
             # Shed only work that would COST a launch: cache hits
             # (above) and coalesced followers of an already-in-flight
@@ -251,6 +274,8 @@ class SolveServer:
         ``InverseResult`` objects that cache and resolve identically."""
         reqs = [p.req for p in batch]
 
+        sig_str = str(sig)
+
         def on_timeout() -> None:
             if self.registry is not None:
                 self.registry.counter("serve_watchdog_timeouts_total")
@@ -261,6 +286,7 @@ class SolveServer:
             for p in batch:
                 self.flight.fail(p.key, exc)
                 self._count("rejected_watchdog_timeout")
+                self._sig_count(sig_str, "rejected_watchdog_timeout")
             self.breaker.record_failure()
 
         def on_retry(i: int, exc: BaseException) -> None:
@@ -271,6 +297,7 @@ class SolveServer:
         engine = (self._inverse_engine() if kind == "inverse"
                   else self.engine)
         watchdog = Watchdog(self.launch_deadline, on_timeout)
+        t_launch0 = time.monotonic()
         try:
             with watchdog:
                 results = call_with_retries(
@@ -283,10 +310,15 @@ class SolveServer:
                 # a fired watchdog already charged this launch to the
                 # breaker in on_timeout — one launch, one verdict
                 self.breaker.record_failure()
+            self._emit_launch_spans(batch, t_launch0, time.monotonic(),
+                                    kind, error=repr(e))
             for p in batch:
                 self.flight.fail(p.key, e)
                 self._count("error")
+                self._sig_count(sig_str, "error")
             return
+        t_launch1 = time.monotonic()
+        self._emit_launch_spans(batch, t_launch0, t_launch1, kind)
         if not watchdog.fired:
             # a launch that outlived its deadline is a failure even if
             # it eventually returned: its waiters were already rejected,
@@ -308,8 +340,48 @@ class SolveServer:
             self.flight.resolve(p.key, res)
             self._count("completed_late" if watchdog.fired
                         else "completed")
+            if not watchdog.fired:
+                # A fired watchdog already charged every member to the
+                # per-signature failure counters (on_timeout); a late
+                # resolve must not ALSO count them completed or feed
+                # failed-request latencies into the SLO sources — that
+                # would halve the burn rate and pollute the p99.
+                self._sig_count(sig_str, "completed")
+                if self.registry is not None:
+                    # admission -> launch-complete, per signature: the
+                    # SLO evaluation's latency source (obs/slo.py)
+                    self.registry.observe("serve_signature_latency_s",
+                                          time.monotonic() - p.enqueued,
+                                          signature=sig_str)
+
+    # -- tracing ------------------------------------------------------- #
+
+    def _emit_launch_spans(self, batch, t0: float, t1: float,
+                           kind: str, error=None) -> None:
+        """One "serve.launch" span per member, parented on that
+        member's request span — a batch launch serves N traces, and
+        per-request critical paths need the segment in each. The
+        engine's launch row flags first launches (jit compile paid),
+        which the trace CLI buckets as "compile"."""
+        if not tracing.enabled():
+            return
+        attrs = {"occupancy": len(batch)}
+        if error is not None:
+            attrs["error"] = error
+        elif kind != "inverse" and self.engine.launch_log:
+            row = self.engine.launch_log[-1]
+            attrs.update(capacity=row["capacity"],
+                         first_launch=row.get("first_launch", False))
+        for p in batch:
+            tracing.emit("serve.launch", t0, t1, kind="launch",
+                         parent=request_trace(p.req), **attrs)
 
     # -- metrics ------------------------------------------------------- #
+
+    def _sig_count(self, sig_str: str, outcome: str) -> None:
+        if self.registry is not None:
+            self.registry.counter("serve_signature_requests_total",
+                                  signature=sig_str, outcome=outcome)
 
     def _count(self, outcome: str) -> None:
         if self.registry is not None:
@@ -343,6 +415,15 @@ class Client:
         elif fields:
             raise ValueError("pass a SolveRequest or fields, not both")
         return self.server.submit(req, timeout=timeout)
+
+
+def _outcome_of(f: Future) -> str:
+    """The span/metric outcome label of a resolved future."""
+    exc = f.exception()
+    if exc is None:
+        return "completed"
+    return ("rejected_" + exc.code if isinstance(exc, Rejected)
+            else "error")
 
 
 def _failed(exc: BaseException) -> Future:

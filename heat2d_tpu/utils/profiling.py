@@ -51,8 +51,23 @@ def phase(name: str):
     ``heat2d-tpu-prof`` can attribute them. Metadata only: the compiled
     computation is unchanged, so annotated hot paths cost nothing.
     ``TraceAnnotation`` additionally marks the span when entered outside
-    a trace (eager host-side phases)."""
+    a trace (eager host-side phases).
+
+    When distributed tracing is armed (obs/tracing.py), each entry
+    additionally emits a host-side ``phase.<name>`` span — inside
+    jit-traced code that stamps TRACE time (i.e. compile-side phase
+    attribution), outside it wall time. Pure host bookkeeping either
+    way: the traced program is byte-identical with tracing on or off
+    (tests/test_tracing.py pins the jaxpr)."""
     import jax
 
-    with jax.named_scope(name), jax.profiler.TraceAnnotation(name):
-        yield
+    from heat2d_tpu.obs import tracing
+
+    span = (tracing.begin("phase." + name, kind="phase",
+                          parent=tracing.ambient())
+            if tracing.enabled() else tracing.NULL_SPAN)
+    try:
+        with jax.named_scope(name), jax.profiler.TraceAnnotation(name):
+            yield
+    finally:
+        span.end()

@@ -1,0 +1,678 @@
+"""Distributed tracing + crash flight recorder + SLO (heat2d_tpu/obs/
+tracing.py, flight.py, slo.py, trace_cli.py — ISSUE 9).
+
+Tiers: tracer/flight/SLO units; the bounded-histogram and Prometheus
+satellites; serve-path integration against an in-process server with a
+sink tracer; the jaxpr pins (tracing enabled/disabled leaves the
+forward solver, band runner, and serve batch runner byte-identical);
+wire back-compat; and ONE end-to-end fleet test — a chaos kill
+mid-flight whose post-mortem must be present, digest-valid, and
+contain the in-flight request's spans, with the merged timeline
+connected across processes."""
+
+import json
+import os
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from heat2d_tpu.obs import flight, slo, tracing
+from heat2d_tpu.obs.metrics import MetricsRegistry
+from heat2d_tpu.obs.tracing import TraceContext, Tracer
+from heat2d_tpu.serve.schema import SolveRequest, attach_trace
+
+pytestmark = pytest.mark.filterwarnings("ignore::DeprecationWarning")
+
+
+@pytest.fixture
+def sink():
+    """An installed in-memory tracer; uninstalls after the test."""
+    recs = []
+    tracing.install(Tracer(sink=recs.append, service="test"))
+    yield recs
+    tracing.uninstall()
+
+
+@pytest.fixture(autouse=True)
+def _clean_tracing():
+    yield
+    tracing.uninstall()
+    flight.uninstall()
+
+
+def spans(recs):
+    return [r for r in recs if r["event"] == "span"]
+
+
+# --------------------------------------------------------------------- #
+# tracer core
+# --------------------------------------------------------------------- #
+
+def test_span_lifecycle_and_parenting(sink):
+    t = tracing.tracer()
+    root = t.begin("root", kind="request", content_hash="h")
+    child = t.begin("child", kind="queue", parent=root.ctx)
+    child.end(n=3)
+    root.end(outcome="completed")
+    ss = spans(sink)
+    assert [s["name"] for s in ss] == ["child", "root"]
+    c, r = ss
+    assert c["trace_id"] == r["trace_id"]       # one trace
+    assert c["parent_id"] == r["span_id"]       # causality
+    assert r["parent_id"] is None
+    assert c["attrs"]["n"] == 3 and r["attrs"]["outcome"] == "completed"
+    assert r["t1"] >= r["t0"] and c["t0"] >= r["t0"]
+    # begin() additionally leaves a span_start marker (crash safety)
+    starts = [x for x in sink if x["event"] == "span_start"]
+    assert {s["span_id"] for s in starts} == {c["span_id"],
+                                              r["span_id"]}
+
+
+def test_end_is_idempotent(sink):
+    sp = tracing.begin("once")
+    sp.end()
+    sp.end()
+    assert len(spans(sink)) == 1
+
+
+def test_retroactive_emit_and_event(sink):
+    t = tracing.tracer()
+    t0 = time.monotonic() - 1.0
+    ctx = t.emit_span("serve.queue", t0, time.monotonic(), kind="queue")
+    t.event("fleet.recv", parent=ctx, rid=7)
+    q, e = spans(sink)
+    assert 0.9 < q["t1"] - q["t0"] < 1.5
+    assert e["kind"] == "event" and e["parent_id"] == ctx.span_id
+    assert e["t1"] == e["t0"]
+
+
+def test_disabled_hooks_are_noops():
+    tracing.uninstall()
+    os.environ.pop("HEAT2D_TRACE_DIR", None)
+    assert not tracing.enabled()
+    sp = tracing.begin("nope")
+    assert sp is tracing.NULL_SPAN
+    sp.set(x=1).end()
+    assert tracing.emit("nope", 0.0, 1.0) is None
+    assert tracing.event("nope") is None
+
+
+def test_env_activation_and_file_output(tmp_path, monkeypatch):
+    tracing.uninstall()
+    monkeypatch.setenv("HEAT2D_TRACE_DIR", str(tmp_path))
+    t = tracing.activate_from_env(service="envtest")
+    assert tracing.enabled() and t is not None
+    tracing.begin("a").end()
+    assert os.path.exists(t.path)
+    recs = [json.loads(l) for l in open(t.path)]
+    assert [r["event"] for r in recs] == ["span_start", "span"]
+    assert recs[1]["service"] == "envtest"
+
+
+def test_wire_context_roundtrip_and_malformed():
+    ctx = TraceContext(trace_id="ab" * 16, span_id="cd" * 8)
+    assert TraceContext.from_wire(ctx.to_wire()) == ctx
+    for bad in (None, {}, {"trace_id": "x"}, {"span_id": "y"},
+                {"trace_id": 1, "span_id": 2}, "junk", 42):
+        assert TraceContext.from_wire(bad) is None
+
+
+def test_trace_attachment_never_changes_request_identity():
+    a = SolveRequest(nx=16, ny=16, steps=4, cx=0.3, method="jnp")
+    b = SolveRequest(nx=16, ny=16, steps=4, cx=0.3, method="jnp")
+    ctx = TraceContext(trace_id="t" * 32, span_id="s" * 16)
+    attach_trace(b, ctx)
+    assert b.trace is ctx
+    assert a == b and hash(a) == hash(b)
+    assert a.content_hash() == b.content_hash()
+    assert a.signature() == b.signature()
+    assert "trace" not in a.spec() and "trace" not in b.spec()
+    # and the wire spec never carries it: from_dict REJECTS the key
+    from heat2d_tpu.serve.schema import Rejected
+    with pytest.raises(Rejected):
+        SolveRequest.from_dict(dict(b.spec(), trace={"trace_id": "x"}))
+
+
+# --------------------------------------------------------------------- #
+# flight recorder
+# --------------------------------------------------------------------- #
+
+def test_flight_ring_is_bounded_and_flush_digest_valid(tmp_path):
+    reg = MetricsRegistry()
+    reg.counter("x_total", 3)
+    rec = flight.FlightRecorder(str(tmp_path / "flight-t-1.jsonl"),
+                                ring=16, service="t", registry=reg)
+    for i in range(100):
+        rec.note("tick", i=i)
+    assert len(rec) == 16                       # bounded under soak
+    path = rec.flush("test")
+    assert path and os.path.exists(path + ".digest.json")
+    entries = flight.load_postmortem(path)
+    assert entries[0]["event"] == "flight_header"
+    assert entries[0]["reason"] == "test"
+    ticks = [e for e in entries if e["event"] == "tick"]
+    assert [e["i"] for e in ticks] == list(range(84, 100))  # newest 16
+    snap = [e for e in entries if e["event"] == "metrics_snapshot"]
+    assert snap and snap[0]["counters"]["x_total"] == 3
+    # first flush wins
+    assert rec.flush("again") is None
+
+
+def test_flight_postmortem_corruption_detected(tmp_path):
+    rec = flight.FlightRecorder(str(tmp_path / "flight-t-2.jsonl"),
+                                service="t")
+    rec.note("tick")
+    path = rec.flush("test")
+    with open(path, "a") as f:
+        f.write('{"event": "forged"}\n')
+    with pytest.raises(flight.PostmortemCorruptError):
+        flight.load_postmortem(path)
+    os.remove(path + ".digest.json")
+    with pytest.raises(flight.PostmortemCorruptError):
+        flight.load_postmortem(path)
+    assert flight.load_postmortem(path, verify=False)  # escape hatch
+
+
+def test_tracer_tees_spans_into_flight_ring(tmp_path, sink):
+    rec = flight.FlightRecorder(str(tmp_path / "flight-t-3.jsonl"),
+                                service="t")
+    flight.install(rec, crash_hooks=False)
+    tracing.begin("traced.op", kind="launch").end()
+    path = rec.flush("test")
+    names = [e.get("name") for e in flight.load_postmortem(path)
+             if e.get("event") in ("span", "span_start")]
+    assert names == ["traced.op", "traced.op"]  # start + end
+
+
+def test_crash_flush_noop_without_recorder():
+    flight.uninstall()
+    assert flight.crash_flush("nothing") is None
+
+
+# --------------------------------------------------------------------- #
+# satellites: bounded histograms + Prometheus exposition
+# --------------------------------------------------------------------- #
+
+def test_histogram_memory_bounded_under_soak():
+    """The regression for the append-forever leak: 100k observations
+    hold at most hist_cap samples while count/sum/min/max/mean stay
+    exact."""
+    r = MetricsRegistry(hist_cap=512)
+    n = 100_000
+    for i in range(n):
+        r.observe("soak_s", float(i % 1000))
+    res = list(r._histograms.values())[0]
+    assert len(res.samples) == 512              # bounded
+    s = r.snapshot()["histograms"]["soak_s"]
+    assert s["count"] == n                      # exact
+    assert s["sum"] == float(sum(i % 1000 for i in range(n)))
+    assert s["min"] == 0.0 and s["max"] == 999.0
+    assert 0.0 <= s["p50"] <= 999.0             # sane estimate
+
+
+def test_histogram_quantiles_exact_below_cap():
+    r = MetricsRegistry(hist_cap=4096)
+    vals = [float(v) for v in range(1, 101)]
+    for v in vals:
+        r.observe("lat_s", v)
+    s = r.snapshot()["histograms"]["lat_s"]
+    assert s["p50"] == 50.0 and s["p90"] == 90.0 and s["p99"] == 99.0
+    assert s["count"] == 100 and s["mean"] == 50.5
+
+
+def test_prometheus_exposition_quantiles_and_backcompat():
+    r = MetricsRegistry()
+    for v in (0.1, 0.2, 0.3, 0.4):
+        r.observe("lat_s", v, route="a")
+    text = r.prometheus_text()
+    # the pre-existing lines are unchanged (backward compatibility)
+    assert "# TYPE lat_s summary" in text
+    assert 'lat_s_sum{route="a"} 1.0' in text
+    assert 'lat_s_count{route="a"} 4' in text
+    # new: quantile sample lines per the summary convention
+    assert 'lat_s{route="a",quantile="0.5"} 0.2' in text
+    assert 'lat_s{route="a",quantile="0.99"} 0.4' in text
+
+
+def test_find_histograms_and_counters_structured_labels():
+    r = MetricsRegistry()
+    sig = "(16, 16, 4, 'float32', 'jnp', False, 0, 0.0)"  # commas!
+    r.observe("serve_signature_latency_s", 0.5, signature=sig)
+    r.counter("serve_signature_requests_total", 2, signature=sig,
+              outcome="completed")
+    h = r.find_histograms("serve_signature_latency_s")
+    assert [dict(k)["signature"] for k in h] == [sig]
+    c = r.find_counters("serve_signature_requests_total")
+    (labels, v), = c.items()
+    assert dict(labels) == {"signature": sig, "outcome": "completed"}
+    assert v == 2
+
+
+# --------------------------------------------------------------------- #
+# SLO objectives
+# --------------------------------------------------------------------- #
+
+def _slo_registry(p99=0.5, failures=0, completed=100):
+    r = MetricsRegistry()
+    sig = "sigA"
+    for _ in range(completed):
+        r.counter("serve_signature_requests_total", signature=sig,
+                  outcome="completed")
+        r.observe("serve_signature_latency_s", p99, signature=sig)
+    for _ in range(failures):
+        r.counter("serve_signature_requests_total", signature=sig,
+                  outcome="rejected_watchdog_timeout")
+    return r
+
+
+def test_slo_policy_validation():
+    with pytest.raises(ValueError):
+        slo.SLOPolicy(latency_p99_s=0)
+    with pytest.raises(ValueError):
+        slo.SLOPolicy(latency_p99_s=1, error_budget=0)
+    with pytest.raises(ValueError):
+        slo.SLOPolicy(latency_p99_s=1, error_budget=1.5)
+
+
+def test_slo_pass_and_gauges():
+    r = _slo_registry(p99=0.1)
+    rows = slo.evaluate(r, default=slo.SLOPolicy(latency_p99_s=1.0,
+                                                 error_budget=0.01))
+    (row,) = rows
+    assert row["ok"] and row["latency_ok"] and row["budget_ok"]
+    assert row["burn_rate"] == 0.0
+    g = r.snapshot()["gauges"]
+    assert g["slo_ok{signature=sigA}"] == 1.0
+    assert g["slo_latency_target_s{signature=sigA}"] == 1.0
+
+
+def test_slo_burn_rate_and_latency_violation():
+    r = _slo_registry(p99=2.0, failures=5, completed=95)
+    rows = slo.evaluate(r, default=slo.SLOPolicy(latency_p99_s=1.0,
+                                                 error_budget=0.01))
+    (row,) = rows
+    assert not row["latency_ok"]            # p99 2.0 > target 1.0
+    assert row["error_rate"] == 0.05
+    assert row["burn_rate"] == pytest.approx(5.0)   # 5% vs 1% budget
+    assert not row["budget_ok"] and not row["ok"]
+
+
+def test_slo_invalid_requests_spend_no_budget():
+    r = _slo_registry(completed=10)
+    r.counter("serve_signature_requests_total", 5, signature="sigA",
+              outcome="rejected_invalid")
+    (row,) = slo.evaluate(r, default=slo.SLOPolicy(latency_p99_s=1.0))
+    assert row["failures"] == 0 and row["burn_rate"] == 0.0
+
+
+def test_watchdog_fired_batch_spends_budget_exactly_once():
+    """Review regression: a launch that outlives the watchdog deadline
+    charges its members to the per-signature FAILURE counters once —
+    the late resolve must not also count them completed or feed the
+    failed requests' latencies into the SLO sources (that would halve
+    the burn rate and pollute the p99)."""
+    from heat2d_tpu.resil import chaos
+    from heat2d_tpu.serve.schema import Rejected
+    from heat2d_tpu.serve.server import SolveServer
+
+    reg = MetricsRegistry()
+    chaos.install(chaos.ChaosConfig(launch_latency_s=0.6))
+    try:
+        with SolveServer(registry=reg, launch_deadline=0.1) as s:
+            fut = s.submit(SolveRequest(nx=16, ny=16, steps=3, cx=0.23,
+                                        method="jnp"))
+            with pytest.raises(Rejected) as ei:
+                fut.result(timeout=60)
+            assert ei.value.code == "watchdog_timeout"
+            # wait for the LATE launch to resolve (completed_late)
+            deadline = time.monotonic() + 60
+            while time.monotonic() < deadline:
+                c = reg.snapshot()["counters"]
+                if c.get("serve_requests_total{outcome=completed_late}"):
+                    break
+                time.sleep(0.05)
+            else:
+                pytest.fail("late launch never resolved")
+    finally:
+        chaos.uninstall()
+    outcomes = {dict(k)["outcome"]: v for k, v in reg.find_counters(
+        "serve_signature_requests_total").items()}
+    assert outcomes == {"rejected_watchdog_timeout": 1.0}
+    assert not reg.find_histograms("serve_signature_latency_s")
+    (row,) = slo.evaluate(reg, default=slo.SLOPolicy(
+        latency_p99_s=1.0, error_budget=0.5))
+    assert row["error_rate"] == 1.0     # one request, one failure
+
+
+def test_slo_stamp_record():
+    extra = {}
+    rows = [{"signature": "s", "ok": True}]
+    assert slo.stamp_record(extra, rows) is extra
+    assert extra["slo"] == rows
+
+
+# --------------------------------------------------------------------- #
+# serve-path integration (in-process, sink tracer)
+# --------------------------------------------------------------------- #
+
+def test_serve_request_traced_end_to_end(sink):
+    from heat2d_tpu.serve.server import Client, SolveServer
+
+    reg = MetricsRegistry()
+    with SolveServer(registry=reg) as s:
+        c = Client(s)
+        r = SolveRequest(nx=16, ny=16, steps=4, cx=0.41, method="jnp")
+        c.solve(r, timeout=60)
+        c.solve(r, timeout=60)          # cache hit
+    ss = spans(sink)
+    by_name = {}
+    for sp in ss:
+        by_name.setdefault(sp["name"], []).append(sp)
+    assert set(by_name) == {"serve.request", "serve.queue",
+                            "serve.launch"}
+    cold, hit = by_name["serve.request"]
+    assert cold["attrs"]["outcome"] == "completed"
+    assert hit["attrs"]["outcome"] == "cache_hit"
+    (queue,) = by_name["serve.queue"]
+    (launch,) = by_name["serve.launch"]
+    # causal chain: queue and launch are children of the cold request
+    assert queue["parent_id"] == cold["span_id"]
+    assert launch["parent_id"] == cold["span_id"]
+    assert launch["attrs"]["first_launch"] is True
+    # per-signature SLO sources landed
+    assert reg.find_histograms("serve_signature_latency_s")
+    assert reg.find_counters("serve_signature_requests_total")
+
+
+def test_serve_untraced_emits_nothing_and_no_sig_spam():
+    tracing.uninstall()
+    os.environ.pop("HEAT2D_TRACE_DIR", None)
+    from heat2d_tpu.serve.server import Client, SolveServer
+
+    reg = MetricsRegistry()
+    with SolveServer(registry=reg) as s:
+        Client(s).solve(SolveRequest(nx=16, ny=16, steps=4, cx=0.43,
+                                     method="jnp"), timeout=60)
+    # tracing off: the request still records per-signature metrics
+    # (they are cheap host counters), but no span machinery ran
+    assert not tracing.enabled()
+
+
+# --------------------------------------------------------------------- #
+# the jaxpr pins: tracing is FREE when off — and when on
+# --------------------------------------------------------------------- #
+
+def _solver_jaxpr():
+    from heat2d_tpu.config import HeatConfig
+    from heat2d_tpu.models.solver import Heat2DSolver
+    from heat2d_tpu.ops.init import inidat
+
+    cfg = HeatConfig(nxprob=12, nyprob=12, steps=8, mode="serial")
+    u0 = inidat(12, 12)
+    return str(jax.make_jaxpr(Heat2DSolver(cfg).make_runner())(u0))
+
+
+def _batch_runner_jaxpr():
+    from heat2d_tpu.models import ensemble
+
+    fn = ensemble.batch_runner(16, 16, 4, "jnp")
+    u0 = jnp.zeros((2, 16, 16), jnp.float32)
+    cxs = jnp.asarray([0.1, 0.2], jnp.float32)
+    return str(jax.make_jaxpr(fn)(u0, cxs, cxs))
+
+
+def _band_runner_jaxpr():
+    from heat2d_tpu.models.ensemble import _run_batch_band
+
+    u0 = jnp.zeros((2, 64, 128), jnp.float32)
+    cxs = jnp.asarray([0.1, 0.2], jnp.float32)
+    fn = lambda u, a, b: _run_batch_band(u, a, b, steps=10)  # noqa: E731
+    return str(jax.make_jaxpr(fn)(u0, cxs, cxs))
+
+
+def test_jaxpr_pin_solver_band_and_batch_runner(monkeypatch, sink):
+    """The ISSUE acceptance pin: with a tracer INSTALLED and spans
+    actively emitting (phase() included), the forward solver, the
+    batched band runner, and the serve batch runner trace to programs
+    byte-identical to the untraced ones — tracing is host-side
+    bookkeeping only."""
+    from heat2d_tpu.ops import pallas_stencil as ps
+    from heat2d_tpu.utils.profiling import phase
+
+    monkeypatch.setattr(ps, "VMEM_BUDGET_BYTES", 256 * 1024)
+
+    with_tracing = {}
+    assert tracing.enabled()
+    with phase("interior_stencil"):     # a live phase span under trace
+        pass
+    with_tracing["solver"] = _solver_jaxpr()
+    with_tracing["batch"] = _batch_runner_jaxpr()
+    with_tracing["band"] = _band_runner_jaxpr()
+    assert spans(sink)                  # spans actually emitted
+
+    tracing.uninstall()
+    os.environ.pop("HEAT2D_TRACE_DIR", None)
+    assert not tracing.enabled()
+    assert _solver_jaxpr() == with_tracing["solver"]
+    assert _batch_runner_jaxpr() == with_tracing["batch"]
+    assert _band_runner_jaxpr() == with_tracing["band"]
+
+
+def test_phase_emits_host_span_only_when_traced(sink):
+    from heat2d_tpu.utils.profiling import phase
+
+    @jax.jit
+    def f(x):
+        with phase("residual_reduction"):
+            return x * 2.0
+
+    f(jnp.ones((4, 4))).block_until_ready()
+    names = [s["name"] for s in spans(sink)]
+    assert "phase.residual_reduction" in names
+
+
+# --------------------------------------------------------------------- #
+# wire back-compat + fenced-worker isolation
+# --------------------------------------------------------------------- #
+
+def test_wire_lines_without_trace_parse_unchanged():
+    """Old-supervisor/new-worker mix: a DISPATCH line with no trace
+    field decodes to 'no context'; a result line with an unexpected
+    trace-era field still decodes (readers are .get-based)."""
+    from heat2d_tpu.fleet import wire
+    from heat2d_tpu.serve.schema import SolveResult
+
+    assert wire.decode_trace({"id": 1, "req": {"nx": 16}}) is None
+    assert wire.decode_trace({"id": 1, "trace": "garbage"}) is None
+    ctx = wire.decode_trace(
+        {"id": 1, "trace": {"trace_id": "a" * 32,
+                            "span_id": "b" * 16}})
+    assert ctx is not None and ctx.trace_id == "a" * 32
+    # new-supervisor/old-worker direction: extra envelope keys ride
+    # through the result codec untouched
+    u = np.ones((3, 3), np.float32)
+    msg = wire.encode_result(5, SolveResult(u=u, steps_done=2,
+                                            content_hash="h"))
+    msg["trace"] = {"trace_id": "a" * 32, "span_id": "b" * 16}
+    back = wire.decode_result(msg)
+    assert np.asarray(back.u).tobytes() == u.tobytes()
+
+
+def test_late_line_from_fenced_worker_attaches_no_span(sink):
+    """A late answer for an unknown wire id (a fenced worker racing
+    its replacement) is dropped WITHOUT touching any trace — spans
+    can never be attributed to a replay by a zombie."""
+    import tests.test_fleet as tf
+
+    fs = tf.make_router()
+    f = fs.submit(tf.req(cx=0.71))
+    slot, msg = fs.sup.sent[0]
+    n_before = len(sink)
+    # a line with a wire id nobody is waiting on
+    tf.answer(fs, slot, {"id": 999999, "req": msg["req"]})
+    assert not f.done()                     # real request unaffected
+    assert len(sink) == n_before            # and NO span was emitted
+    tf.answer(fs, slot, msg)
+    assert f.result(timeout=5) is not None
+    fs.stop()
+
+
+# --------------------------------------------------------------------- #
+# trace CLI: merge, connectivity, critical path, chrome export
+# --------------------------------------------------------------------- #
+
+def _write_span_file(tmp_path, service, recs):
+    p = tmp_path / f"spans-{service}-1.jsonl"
+    with open(p, "a") as f:
+        for r in recs:
+            f.write(json.dumps(r) + "\n")
+    return p
+
+
+def _mkspan(trace, span, parent, name, kind, t0, t1, service="router",
+            pid=1, **attrs):
+    return {"event": "span", "schema": tracing.TRACE_SCHEMA,
+            "service": service, "pid": pid, "trace_id": trace,
+            "span_id": span, "parent_id": parent, "name": name,
+            "kind": kind, "t0": t0, "t1": t1, "attrs": attrs}
+
+
+def test_merge_connectivity_and_critical_path(tmp_path):
+    from heat2d_tpu.obs import trace_cli
+
+    t = "t" * 32
+    _write_span_file(tmp_path, "router", [
+        _mkspan(t, "r1", None, "fleet.request", "request", 0.0, 1.0,
+                content_hash="hash1"),
+        _mkspan(t, "w1", "r1", "fleet.dispatch", "wire", 0.1, 0.4),
+        _mkspan(t, "w2", "r1", "fleet.dispatch", "wire", 0.5, 1.0),
+    ])
+    _write_span_file(tmp_path, "worker0", [
+        _mkspan(t, "s1", "w2", "serve.request", "request", 0.55, 0.95,
+                service="worker0", pid=2),
+        _mkspan(t, "q1", "s1", "serve.queue", "queue", 0.55, 0.65,
+                service="worker0", pid=2),
+        _mkspan(t, "l1", "s1", "serve.launch", "launch", 0.65, 0.95,
+                service="worker0", pid=2, first_launch=True),
+    ])
+    rep = trace_cli.merge_report(str(tmp_path))
+    (row,) = rep["traces"]
+    assert row["connected"] and row["processes"] == 2
+    assert row["content_hash"] == "hash1"
+    b = row["breakdown"]
+    assert b["total"] == 1.0
+    assert b["queue"] == pytest.approx(0.1)
+    assert b["compile"] == pytest.approx(0.3)
+    # wire = both dispatch spans minus the nested worker request
+    assert b["wire"] == pytest.approx(0.3 + 0.5 - 0.4)
+    # replay gap: w1 ended 0.4, w2 began 0.5
+    assert b["replay"] == pytest.approx(0.1)
+    assert rep["request_hashes"] == {"hash1": [t]}
+
+    # chrome export: per-process lanes + a flow edge across processes
+    loaded = trace_cli.load_dir(str(tmp_path))
+    chrome = trace_cli.to_chrome(loaded["spans"])
+    evs = chrome["traceEvents"]
+    lanes = {e["args"]["name"] for e in evs
+             if e.get("name") == "process_name"}
+    assert len(lanes) == 2
+    assert any(e["ph"] == "s" for e in evs)     # flow start
+    assert any(e["ph"] == "f" for e in evs)     # flow finish
+
+
+def test_merge_flags_disconnected_and_unfinished(tmp_path):
+    from heat2d_tpu.obs import trace_cli
+
+    t = "u" * 32
+    _write_span_file(tmp_path, "router", [
+        _mkspan(t, "c1", "missing-parent", "serve.queue", "queue",
+                0.0, 0.1),
+        dict(_mkspan(t, "zz", None, "serve.request", "request",
+                     0.0, 0.0), event="span_start"),
+    ])
+    rep = trace_cli.merge_report(str(tmp_path))
+    (row,) = rep["traces"]
+    assert not row["connected"] and row["orphans"] == 1
+    # the start-only span was synthesized as unfinished
+    synth = [s for s in trace_cli.load_dir(str(tmp_path))["spans"]
+             if s["span_id"] == "zz"]
+    assert synth and synth[0]["attrs"]["unfinished"] is True
+
+
+# --------------------------------------------------------------------- #
+# END TO END: chaos kill mid-flight -> post-mortem + connected merge
+# --------------------------------------------------------------------- #
+
+def test_fleet_chaos_kill_postmortem_and_connected_timeline(tmp_path):
+    """The ISSUE acceptance scenario, in one subprocess test: a
+    2-worker fleet serves requests while worker 0 is armed to
+    chaos-kill at its 2nd pickup. Afterwards:
+
+    - every request completed (failover replayed the in-flight one);
+    - the killed worker left a flight-recorder file that is present,
+      DIGEST-VALID, and contains the in-flight request's spans;
+    - ``heat2d-tpu-trace`` merges every process's span file + the
+      post-mortem into timelines that are each CONNECTED, including
+      the replayed request's (router -> wire -> worker0[died] ->
+      replay -> wire -> worker1), which crosses >= 2 processes."""
+    import tests.test_fleet as tf
+    from heat2d_tpu.fleet.router import FleetServer
+    from heat2d_tpu.obs import trace_cli
+
+    tdir = str(tmp_path)
+    tracing.install(Tracer(tdir, service="router"))
+    reg = MetricsRegistry()
+    fs = FleetServer(
+        workers=2, registry=reg, max_replays=5,
+        env={"JAX_PLATFORMS": "cpu", "HEAT2D_TRACE_DIR": tdir,
+             "HEAT2D_FLIGHT_DIR": tdir},
+        per_worker_env={0: {"HEAT2D_CHAOS_WORKER_KILL_AFTER": "2"}})
+    reqs = [tf.req(cx=0.51 + 0.01 * i, steps=tf.STEPS + (i % 3))
+            for i in range(6)]
+    with fs:
+        results = [fs.solve(r, timeout=120) for r in reqs]
+        deaths = fs.sup.deaths
+        assert fs.stop()
+    tracing.uninstall()
+    assert len(results) == 6 and deaths >= 1
+    for r, res in zip(reqs, results):
+        assert np.asarray(res.u).tobytes() == tf.oracle_grid(r)
+
+    # -- the killed worker's black box ------------------------------- #
+    pms = flight.find_postmortems(tdir)
+    assert pms, "no flight-recorder file from the killed worker"
+    entries = flight.load_postmortem(pms[0])    # digest-verified
+    header = entries[0]
+    assert header["event"] == "flight_header"
+    assert header["reason"] == "chaos_worker_kill"
+    pm_spans = [e for e in entries
+                if e.get("event") in ("span", "span_start")]
+    assert pm_spans, "post-mortem holds no spans"
+    # the in-flight request's pickup marker is in the black box: the
+    # LAST thing worker 0 did was receive the request it died holding
+    recvs = [e for e in pm_spans if e.get("name") == "fleet.recv"]
+    assert recvs, "no wire-receive span in the post-mortem"
+
+    # -- the merged cross-process timeline --------------------------- #
+    rep = trace_cli.merge_report(tdir)
+    assert rep["postmortems"] and not rep["corrupt_postmortems"]
+    assert len(rep["traces"]) == 6
+    assert all(r["connected"] for r in rep["traces"]), rep["traces"]
+    replayed = [r for r in rep["traces"] if r["replays"] >= 1]
+    assert replayed, "no replayed trace recorded"
+    assert replayed[0]["processes"] >= 2        # crossed the fleet
+    # every request hash maps to exactly one (connected) trace
+    assert len(rep["request_hashes"]) == 6
+    assert all(len(tids) == 1
+               for tids in rep["request_hashes"].values())
+    # segments exist for the breakdown (queue/launch on some trace)
+    assert any(r["breakdown"]["queue"] > 0 for r in rep["traces"])
+    assert any(r["breakdown"]["compile"] + r["breakdown"]["launch"] > 0
+               for r in rep["traces"])
+
+    # CLI assertion mode agrees
+    assert trace_cli.main([tdir, "--assert-connected",
+                           "--require-postmortem"]) == 0
