@@ -1,0 +1,21 @@
+"""Static analysis + correctness tooling (docs/ANALYSIS.md).
+
+Three engines and one CLI:
+
+- ``analysis.lint`` — AST linter for the repo's hand-enforced
+  conventions (rules R001-R006), gated in CI by ``heat2d-tpu-lint``
+  (analysis/cli.py) at zero non-baselined findings.
+- ``analysis.locks`` — audited drop-in locks: lock-order inversion
+  (deadlock-cycle) detection plus ``@guarded_by`` guarded-state
+  checking, opt-in via ``HEAT2D_LOCK_AUDIT=1``, zero overhead off.
+- ``analysis.recompile`` — recompilation sentinel: counts actual XLA
+  compiles and gates the serve engine's O(log max_batch) contract.
+- ``analysis.jaxpr_pin`` — the consolidated jaxpr-pin library the test
+  suite's "free when off" proofs share.
+"""
+
+from heat2d_tpu.analysis.locks import (AuditedCondition, AuditedLock,
+                                       AuditedRLock, guarded_by)
+
+__all__ = ["AuditedCondition", "AuditedLock", "AuditedRLock",
+           "guarded_by"]

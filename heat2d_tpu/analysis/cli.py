@@ -1,0 +1,105 @@
+"""``heat2d-tpu-lint`` — the zero-findings CI gate.
+
+Runs the repo-specific rules (analysis/lint.py) over a tree and exits
+rc 1 on any NEW finding (one not grandfathered in the baseline, with a
+justification, at ``analysis/baseline.json``). ``--format json`` emits
+machine-readable findings for tooling; stale baseline entries (the
+finding was fixed but its entry lingers) are reported so the baseline
+only ever shrinks deliberately.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+from heat2d_tpu.analysis import lint
+
+
+def _default_root() -> str:
+    """The tree to lint: cwd when it holds the package, else the
+    installed package's parent (so the CLI works from anywhere)."""
+    cwd = os.getcwd()
+    if os.path.isdir(os.path.join(cwd, "heat2d_tpu")):
+        return cwd
+    import heat2d_tpu
+
+    return os.path.dirname(os.path.dirname(
+        os.path.abspath(heat2d_tpu.__file__)))
+
+
+def default_baseline_path() -> str:
+    return os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "baseline.json")
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="heat2d-tpu-lint",
+        description="heat2d-tpu invariant linter (rules R001-R006)")
+    p.add_argument("root", nargs="?", default=None,
+                   help="tree to lint (default: the repo / installed "
+                        "package root)")
+    p.add_argument("--rules", default=None,
+                   help="comma-separated subset, e.g. R001,R006 "
+                        "(default: all)")
+    p.add_argument("--baseline", default=None,
+                   help="baseline JSON of grandfathered findings "
+                        "(default: analysis/baseline.json; 'none' "
+                        "disables)")
+    p.add_argument("--format", choices=("text", "json"),
+                   default="text")
+    p.add_argument("--docs", default=None,
+                   help="docs directory for the drift rule "
+                        "(default: <root>/docs)")
+    args = p.parse_args(argv)
+
+    root = args.root or _default_root()
+    rules = ([r.strip() for r in args.rules.split(",") if r.strip()]
+             if args.rules else None)
+    if args.baseline == "none":
+        baseline_path = None
+    else:
+        baseline_path = args.baseline or default_baseline_path()
+    try:
+        baseline = lint.load_baseline(baseline_path)
+        findings = lint.lint_tree(root, rules=rules,
+                                  docs_dir=args.docs)
+    except (lint.BaselineError, ValueError) as e:
+        print(f"heat2d-tpu-lint: {e}", file=sys.stderr)
+        return 2
+    new, grandfathered, stale = lint.split_baselined(findings, baseline)
+
+    if args.format == "json":
+        print(json.dumps({
+            "root": os.path.abspath(root),
+            "rules": sorted(rules) if rules else list(lint.ALL_RULES),
+            "new": [f.to_dict() for f in new],
+            "baselined": [
+                f.to_dict() | {"justification": baseline[f.key]}
+                for f in grandfathered],
+            "stale_baseline_keys": stale,
+            "ok": not new,
+        }, indent=2))
+    else:
+        for f in new:
+            print(f.render())
+        if grandfathered:
+            print(f"# {len(grandfathered)} baselined finding(s) "
+                  "suppressed:")
+            for f in grandfathered:
+                print(f"#   {f.key}\n#     justification: "
+                      f"{baseline[f.key]}")
+        for k in stale:
+            print(f"# stale baseline entry (finding no longer "
+                  f"present): {k}")
+        print(f"{'FAIL' if new else 'OK'}: {len(new)} new finding(s), "
+              f"{len(grandfathered)} baselined, {len(stale)} stale "
+              "baseline entr(y/ies)")
+    return 1 if new else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

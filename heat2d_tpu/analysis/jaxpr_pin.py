@@ -1,0 +1,127 @@
+"""Consolidated jaxpr-pin library — ONE copy of the "this subsystem is
+free when off" proof pattern.
+
+Nearly every PR since the obs subsystem carries the same acceptance
+pin: trace a hot-path program with the new subsystem exercised/armed
+and again without it, and assert the two jaxprs are byte-identical —
+differentiability, tracing, tuning hooks, chaos, and the lock audit
+all cost *nothing* on the compiled path. The helpers lived as
+copy-pasted ``_solver_jaxpr``/``_batch_runner_jaxpr`` functions in five
+test modules; this module is the single import (tests/_pin.py re-exports
+for the suite), and ``assert_jaxpr_equal`` upgrades the bare ``==``
+assert to a readable structural diff when a pin ever breaks.
+"""
+
+from __future__ import annotations
+
+import difflib
+
+
+def jaxpr_text(fn, *args, **kwargs) -> str:
+    """``str(jax.make_jaxpr(fn)(*args))`` — the pinned representation.
+    String form on purpose: the pins assert BYTE-identity of the traced
+    program, and the printed jaxpr is the canonical stable text."""
+    import jax
+
+    return str(jax.make_jaxpr(fn)(*args, **kwargs))
+
+
+def diff_jaxprs(a: str, b: str, label_a: str = "before",
+                label_b: str = "after", context: int = 3) -> str:
+    """Unified structural diff of two jaxpr texts (line-based; the
+    printed jaxpr is one equation per line, so the diff reads as
+    "which equations moved")."""
+    return "\n".join(difflib.unified_diff(
+        a.splitlines(), b.splitlines(),
+        fromfile=label_a, tofile=label_b, n=context, lineterm=""))
+
+
+def assert_jaxpr_equal(a: str, b: str, label: str = "jaxpr",
+                       label_a: str = "before",
+                       label_b: str = "after") -> None:
+    """Byte-identity assert with a readable structural diff on
+    mismatch — replaces the suite's bare ``assert before == after``
+    (which printed two multi-thousand-line strings)."""
+    if a == b:
+        return
+    al, bl = a.splitlines(), b.splitlines()
+    d = diff_jaxprs(a, b, label_a, label_b)
+    changed = sum(1 for ln in d.splitlines()
+                  if ln[:1] in "+-" and ln[:3] not in ("+++", "---"))
+    raise AssertionError(
+        f"{label}: traced programs differ ({len(al)} vs {len(bl)} "
+        f"equations, {changed} changed lines):\n{d}")
+
+
+def assert_jaxpr_differs(a: str, b: str, label: str = "jaxpr") -> None:
+    """Non-vacuity twin: assert the two programs actually differ
+    (pinning two copies of the same bug to each other proves nothing)."""
+    if a != b:
+        return
+    raise AssertionError(
+        f"{label}: traced programs are byte-identical but were "
+        "expected to differ — the pinned change is vacuous")
+
+
+# ------------------------------------------------------------------ #
+# the standard hot-path pins (shared by five test modules)
+# ------------------------------------------------------------------ #
+
+def solver_jaxpr(nx: int = 12, ny: int = 12, steps: int = 8,
+                 mode: str = "serial", **cfg_kwargs) -> str:
+    """The forward solver runner's program — THE pin for "subsystem X
+    does not touch the serial hot path"."""
+    from heat2d_tpu.config import HeatConfig
+    from heat2d_tpu.models.solver import Heat2DSolver
+    from heat2d_tpu.ops.init import inidat
+
+    cfg = HeatConfig(nxprob=nx, nyprob=ny, steps=steps, mode=mode,
+                     **cfg_kwargs)
+    u0 = inidat(nx, ny)
+    return jaxpr_text(Heat2DSolver(cfg).make_runner(), u0)
+
+
+def _cxys(b: int):
+    import jax.numpy as jnp
+
+    return jnp.asarray([0.1 + 0.1 * i for i in range(b)], jnp.float32)
+
+
+def batch_runner_jaxpr(nx: int = 16, ny: int = 16, steps: int = 4,
+                       method: str = "jnp", b: int = 2) -> str:
+    """The serve compile cache's memoized batch runner's program."""
+    import jax.numpy as jnp
+
+    from heat2d_tpu.models import ensemble
+
+    fn = ensemble.batch_runner(nx, ny, steps, method)
+    u0 = jnp.zeros((b, nx, ny), jnp.float32)
+    cxs = _cxys(b)
+    return jaxpr_text(fn, u0, cxs, cxs)
+
+
+def band_runner_jaxpr(nx: int = 64, ny: int = 128, steps: int = 10,
+                      b: int = 2) -> str:
+    """The batched band kernel runner's program (the serve kernel path
+    for HBM-sized members)."""
+    import jax.numpy as jnp
+
+    from heat2d_tpu.models.ensemble import _run_batch_band
+
+    u0 = jnp.zeros((b, nx, ny), jnp.float32)
+    cxs = _cxys(b)
+    return jaxpr_text(lambda u, a, c: _run_batch_band(u, a, c,
+                                                      steps=steps),
+                      u0, cxs, cxs)
+
+
+def sharded_runner_jaxpr(cfg, mesh) -> str:
+    """A dist2d/sharded multi-step runner's program on ``mesh`` (the
+    fused-halo pins compare routes through this)."""
+    from heat2d_tpu.parallel.sharded import (make_sharded_runner,
+                                             sharded_inidat)
+
+    u0 = sharded_inidat(cfg, mesh)
+    runner, _ = make_sharded_runner(cfg, mesh)
+    fn = getattr(runner, "__wrapped__", runner)
+    return jaxpr_text(fn, u0)

@@ -1,0 +1,689 @@
+"""Repo-specific AST linter — the conventions the test suite relies on,
+as machine-checked rules.
+
+Six rules, each encoding an invariant this codebase already enforces by
+hand (docs/ANALYSIS.md has the rationale + an example finding for each):
+
+- **R001 atomic-write discipline** — ``open(path, "w"/"wb")`` on a
+  persistent artifact must flow through the tmp + fsync + ``os.replace``
+  idiom (io/binary.py's commit protocol). A direct write can be torn by
+  a crash and then *load* as a valid artifact. Exempt: staging paths
+  (the expression mentions ``tmp``) and functions that themselves
+  ``os.replace`` (they ARE the idiom).
+- **R002 no wall-clock/RNG in traced code** — ``time.*``,
+  ``datetime.now``, ``random.*`` inside a traced scope bake one
+  trace-time value into the compiled program (and differ across ranks:
+  the multihost lockstep hazard).
+- **R003 traced-value leaks** — ``float()``/``int()``/``bool()`` /
+  ``.item()`` on array values inside traced scopes force a
+  ConcretizationError at best, a silent host sync at worst.
+- **R004 chaos purity** — ``resil/chaos.py`` may not import or touch
+  jax: the chaos jaxpr pin (armed == disarmed program) is only
+  structural if the module *cannot* reach a traced value.
+- **R005 metric/doc drift** — every metric family instantiated through
+  the obs registry must appear in the docs tables, and every documented
+  family must exist in code (dashboards built from the docs must not
+  silently watch nothing).
+- **R006 bare locks in serve/fleet/resil** — threaded subsystems must
+  take their mutexes from ``analysis.locks`` so the lock audit
+  (``HEAT2D_LOCK_AUDIT=1``) sees every acquisition.
+
+Pure stdlib ``ast`` — no third-party parser; runs in CI as the
+``lint-gate`` job via the ``heat2d-tpu-lint`` CLI (analysis/cli.py),
+which holds the tree at zero non-baselined findings.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import json
+import os
+import re
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+ALL_RULES = ("R001", "R002", "R003", "R004", "R005", "R006")
+
+#: directory names never scanned
+SKIP_DIRS = {"tests", "__pycache__", ".git", "build", "dist",
+             ".claude", "benchmarks"}
+
+#: callees whose function-valued arguments become traced scopes
+TRACER_CALLS = {
+    "jit", "pallas_call", "shard_map", "shard_map_compat", "vmap",
+    "pmap", "grad", "value_and_grad", "fori_loop", "while_loop",
+    "scan", "cond", "switch", "remat", "checkpoint", "custom_vjp",
+    "custom_jvp", "defvjp", "make_jaxpr", "named_call",
+}
+
+#: callees whose function-valued arguments run on the HOST (never mark
+#: their arguments traced even when lexically inside a tracer call)
+HOST_CALLS = {
+    "callback", "debug_callback", "pure_callback", "io_callback",
+    "Thread", "submit", "partial",
+}
+
+#: wall-clock / RNG call chains banned inside traced scopes (R002)
+WALLCLOCK_ROOTS = {"time", "random"}
+WALLCLOCK_DATETIME_ATTRS = {"now", "utcnow", "today"}
+
+METRIC_METHODS = {"counter", "gauge", "observe", "series", "timer"}
+
+#: metric families the drift rule covers (names outside these prefixes
+#: are not part of the documented contract)
+METRIC_RE = re.compile(
+    r"^(serve|fleet|resil|tune|inverse|slo)_[a-z0-9_]+$")
+
+#: keyword names whose literal string values name a metric family
+#: (e.g. ``SingleFlight(counter="fleet_coalesced_total")``)
+METRIC_KEYWORDS = {"counter", "metric", "name"}
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    rule: str
+    path: str           # posix-relative to the scanned root
+    line: int
+    context: str        # enclosing qualname, or a rule-specific tag
+    match: str          # short source snippet (baseline identity)
+    message: str
+
+    @property
+    def key(self) -> str:
+        """Line-number-free identity: a finding keeps its baseline
+        entry across unrelated edits to the same file."""
+        return f"{self.rule}:{self.path}:{self.context}:{self.match}"
+
+    def render(self) -> str:
+        return (f"{self.rule} {self.path}:{self.line} [{self.context}] "
+                f"{self.message}  ->  {self.match}")
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self) | {"key": self.key}
+
+
+class BaselineError(ValueError):
+    """A malformed baseline file (entry without a justification, bad
+    schema) — a grandfathered finding without a WHY is just a
+    suppressed finding."""
+
+
+def load_baseline(path: Optional[str]) -> Dict[str, str]:
+    """{finding key: justification}. Every entry must carry a
+    non-empty ``justification`` string."""
+    if path is None or not os.path.exists(path):
+        return {}
+    with open(path) as f:
+        data = json.load(f)
+    entries = data.get("findings")
+    if not isinstance(entries, list):
+        raise BaselineError(f"{path}: expected a 'findings' list")
+    out: Dict[str, str] = {}
+    for e in entries:
+        key = e.get("key")
+        just = e.get("justification")
+        if not key or not isinstance(key, str):
+            raise BaselineError(f"{path}: entry missing 'key': {e}")
+        if not just or not isinstance(just, str) or not just.strip():
+            raise BaselineError(
+                f"{path}: baselined finding {key!r} has no "
+                "justification — grandfathering requires a reason")
+        out[key] = just
+    return out
+
+
+# ------------------------------------------------------------------ #
+# shared AST plumbing
+# ------------------------------------------------------------------ #
+
+def _attr_chain(node: ast.AST) -> List[str]:
+    """``a.b.c(...)`` -> ["a", "b", "c"]; empty when not a plain
+    name/attribute chain."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return list(reversed(parts))
+    return []
+
+
+def _terminal_name(func: ast.AST) -> Optional[str]:
+    chain = _attr_chain(func)
+    return chain[-1] if chain else None
+
+
+def _snippet(src_lines: List[str], node: ast.AST, limit: int = 96) -> str:
+    try:
+        text = ast.get_source_segment("\n".join(src_lines), node)
+    except Exception:
+        text = None
+    if not text:
+        line = src_lines[node.lineno - 1] if node.lineno - 1 < len(
+            src_lines) else ""
+        text = line.strip()
+    text = " ".join(text.split())
+    return text[:limit]
+
+
+class _Scopes(ast.NodeVisitor):
+    """Function table + parent/qualname maps for one module."""
+
+    def __init__(self, tree: ast.Module):
+        self.parents: Dict[ast.AST, ast.AST] = {}
+        self.functions: List[ast.AST] = []
+        self.qualnames: Dict[ast.AST, str] = {}
+        self.module_funcs: Dict[str, ast.AST] = {}
+        self._stack: List[str] = []
+        self._class_depth = 0
+        for parent in ast.walk(tree):
+            for child in ast.iter_child_nodes(parent):
+                self.parents[child] = parent
+        self._visit_block(tree)
+
+    def _visit_block(self, node: ast.AST) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                qn = ".".join(self._stack + [child.name])
+                self.qualnames[child] = qn
+                self.functions.append(child)
+                if not self._stack:
+                    self.module_funcs[child.name] = child
+                self._stack.append(child.name)
+                self._visit_block(child)
+                self._stack.pop()
+            elif isinstance(child, ast.Lambda):
+                qn = ".".join(self._stack + ["<lambda>"])
+                self.qualnames[child] = qn
+                self.functions.append(child)
+                self._visit_block(child)
+            elif isinstance(child, ast.ClassDef):
+                self._stack.append(child.name)
+                self._visit_block(child)
+                self._stack.pop()
+            else:
+                self._visit_block(child)
+
+    def enclosing_function(self, node: ast.AST) -> Optional[ast.AST]:
+        cur = self.parents.get(node)
+        while cur is not None:
+            if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                ast.Lambda)):
+                return cur
+            cur = self.parents.get(cur)
+        return None
+
+    def context_of(self, node: ast.AST) -> str:
+        fn = self.enclosing_function(node)
+        return self.qualnames.get(fn, "<module>") if fn is not None \
+            else "<module>"
+
+
+def _function_nodes_within(fn: ast.AST) -> Iterable[ast.AST]:
+    yield fn
+    for sub in ast.walk(fn):
+        if sub is not fn and isinstance(
+                sub, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+            yield sub
+
+
+def _traced_functions(tree: ast.Module, scopes: _Scopes) -> Set[ast.AST]:
+    """The traced-scope set: functions handed to jit/pallas_call/
+    shard_map/lax control flow (directly, by name, or through
+    ``functools.partial``), ``*_kernel`` functions (the Pallas kernel
+    convention), functions decorated with a tracer, everything
+    lexically nested in those — then closed over same-module calls
+    (a traced body calling a module-level helper traces the helper)."""
+    roots: Set[ast.AST] = set()
+
+    for fn in scopes.functions:
+        name = getattr(fn, "name", "")
+        if name.endswith("_kernel"):
+            roots.add(fn)
+        for deco in getattr(fn, "decorator_list", []):
+            for sub in ast.walk(deco):
+                t = _terminal_name(sub) if isinstance(
+                    sub, (ast.Name, ast.Attribute)) else None
+                if t in TRACER_CALLS:
+                    roots.add(fn)
+
+    def mark_arg(arg: ast.AST) -> None:
+        if isinstance(arg, ast.Lambda):
+            roots.add(arg)
+        elif isinstance(arg, ast.Name):
+            target = scopes.module_funcs.get(arg.id)
+            if target is not None:
+                roots.add(target)
+            else:
+                # a locally-defined function passed by name
+                for fn in scopes.functions:
+                    if getattr(fn, "name", None) == arg.id:
+                        roots.add(fn)
+        elif isinstance(arg, ast.Call):
+            t = _terminal_name(arg.func)
+            if t in HOST_CALLS and t != "partial":
+                return
+            for a in list(arg.args) + [k.value for k in arg.keywords]:
+                mark_arg(a)
+
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        t = _terminal_name(node.func)
+        if t not in TRACER_CALLS:
+            continue
+        for arg in list(node.args) + [k.value for k in node.keywords]:
+            mark_arg(arg)
+
+    traced: Set[ast.AST] = set()
+    for r in roots:
+        traced.update(_function_nodes_within(r))
+
+    # fixpoint: same-module calls out of traced bodies
+    changed = True
+    while changed:
+        changed = False
+        for fn in list(traced):
+            for node in ast.walk(fn):
+                if isinstance(node, ast.Call) and isinstance(
+                        node.func, ast.Name):
+                    callee = scopes.module_funcs.get(node.func.id)
+                    if callee is not None and callee not in traced:
+                        for sub in _function_nodes_within(callee):
+                            if sub not in traced:
+                                traced.add(sub)
+                                changed = True
+    return traced
+
+
+def _param_names(fn: ast.AST) -> Set[str]:
+    a = fn.args
+    names = [p.arg for p in list(a.posonlyargs) + list(a.args)
+             + list(a.kwonlyargs)]
+    if a.vararg:
+        names.append(a.vararg.arg)
+    if a.kwarg:
+        names.append(a.kwarg.arg)
+    return set(names)
+
+
+# ------------------------------------------------------------------ #
+# per-file rules
+# ------------------------------------------------------------------ #
+
+def _iter_py_files(root: str) -> Iterable[str]:
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames[:] = sorted(d for d in dirnames if d not in SKIP_DIRS)
+        for f in sorted(filenames):
+            if f.endswith(".py"):
+                yield os.path.join(dirpath, f)
+
+
+def _relpath(path: str, root: str) -> str:
+    return os.path.relpath(path, root).replace(os.sep, "/")
+
+
+def _write_mode(node: ast.Call) -> Optional[str]:
+    """The constant mode string of an ``open`` call when it opens for
+    (over)writing — "w"/"wb"/"w+"...; None otherwise."""
+    mode: Optional[ast.AST] = None
+    if len(node.args) >= 2:
+        mode = node.args[1]
+    else:
+        for k in node.keywords:
+            if k.arg == "mode":
+                mode = k.value
+    if isinstance(mode, ast.Constant) and isinstance(mode.value, str) \
+            and "w" in mode.value:
+        return mode.value
+    return None
+
+
+def _rule_r001(rel: str, tree: ast.Module, scopes: _Scopes,
+               src_lines: List[str]) -> List[Finding]:
+    out = []
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Name)
+                and node.func.id == "open" and node.args):
+            continue
+        mode = _write_mode(node)
+        if mode is None:
+            continue
+        path_src = _snippet(src_lines, node.args[0])
+        if "tmp" in path_src.lower():
+            continue                    # a staging file: the idiom's
+            #                             first half, committed later
+        fn = scopes.enclosing_function(node)
+        search_in = fn if fn is not None else tree
+        has_replace = any(
+            isinstance(n, ast.Call)
+            and _attr_chain(n.func)[-2:] == ["os", "replace"]
+            for n in ast.walk(search_in))
+        if has_replace:
+            continue                    # the tmp+replace idiom inline
+        out.append(Finding(
+            "R001", rel, node.lineno, scopes.context_of(node),
+            _snippet(src_lines, node),
+            f"direct open(..., {mode!r}) on a persistent artifact — "
+            "use the tmp + fsync + os.replace idiom "
+            "(io.binary.write_text_atomic / write_json_atomic)"))
+    return out
+
+
+def _rule_r002_r003(rel: str, tree: ast.Module, scopes: _Scopes,
+                    src_lines: List[str],
+                    rules: Set[str]) -> List[Finding]:
+    out: List[Finding] = []
+    traced = _traced_functions(tree, scopes)
+    if not traced:
+        return out
+    traced_params: Dict[ast.AST, Set[str]] = {}
+
+    def params_in_scope(fn: ast.AST) -> Set[str]:
+        if fn not in traced_params:
+            names: Set[str] = set()
+            cur: Optional[ast.AST] = fn
+            while cur is not None and cur in traced:
+                names |= _param_names(cur)
+                cur = scopes.enclosing_function(cur)
+            traced_params[fn] = names
+        return traced_params[fn]
+
+    for fn in traced:
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            owner = scopes.enclosing_function(node)
+            if owner not in traced:
+                continue                # nested host fn inside traced?
+            chain = _attr_chain(node.func)
+            if "R002" in rules and chain:
+                rooted = chain[0]
+                term = chain[-1]
+                bad = (
+                    (rooted in WALLCLOCK_ROOTS and len(chain) > 1)
+                    or ("datetime" in chain
+                        and term in WALLCLOCK_DATETIME_ATTRS)
+                    or (len(chain) >= 2 and chain[-2] == "random"
+                        and rooted in ("np", "numpy"))
+                )
+                if bad:
+                    out.append(Finding(
+                        "R002", rel, node.lineno,
+                        scopes.context_of(node),
+                        _snippet(src_lines, node),
+                        "wall-clock/RNG call inside a traced scope — "
+                        "the value is baked in at trace time (use a "
+                        "host-side hook, or jax.random with an "
+                        "explicit key)"))
+            if "R003" in rules:
+                if isinstance(node.func, ast.Attribute) \
+                        and node.func.attr == "item":
+                    out.append(Finding(
+                        "R003", rel, node.lineno,
+                        scopes.context_of(node),
+                        _snippet(src_lines, node),
+                        ".item() on a value inside a traced scope — "
+                        "concretizes the tracer (host sync / error)"))
+                elif (isinstance(node.func, ast.Name)
+                        and node.func.id in ("float", "int", "bool")
+                        and node.args):
+                    mentioned = {n.id for n in ast.walk(node.args[0])
+                                 if isinstance(n, ast.Name)}
+                    if mentioned & params_in_scope(owner):
+                        out.append(Finding(
+                            "R003", rel, node.lineno,
+                            scopes.context_of(node),
+                            _snippet(src_lines, node),
+                            f"{node.func.id}() applied to a traced "
+                            "value inside a traced scope — leaks the "
+                            "tracer to the host"))
+    return out
+
+
+def _rule_r004(rel: str, tree: ast.Module, scopes: _Scopes,
+               src_lines: List[str]) -> List[Finding]:
+    if not rel.endswith("resil/chaos.py"):
+        return []
+    out = []
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for alias in node.names:
+                if alias.name == "jax" or alias.name.startswith("jax."):
+                    out.append(Finding(
+                        "R004", rel, node.lineno,
+                        scopes.context_of(node),
+                        _snippet(src_lines, node),
+                        "chaos hooks must stay jax-free: the armed == "
+                        "disarmed jaxpr pin is only structural if this "
+                        "module cannot reach a traced value"))
+        elif isinstance(node, ast.ImportFrom):
+            mod = node.module or ""
+            if mod == "jax" or mod.startswith("jax."):
+                out.append(Finding(
+                    "R004", rel, node.lineno, scopes.context_of(node),
+                    _snippet(src_lines, node),
+                    "chaos hooks must stay jax-free (import from jax)"))
+        elif isinstance(node, ast.Attribute):
+            chain = _attr_chain(node)
+            if chain and chain[0] in ("jax", "jnp"):
+                out.append(Finding(
+                    "R004", rel, node.lineno, scopes.context_of(node),
+                    _snippet(src_lines, node),
+                    "chaos hooks must not touch jax values"))
+    return out
+
+
+def _rule_r006(rel: str, tree: ast.Module, scopes: _Scopes,
+               src_lines: List[str]) -> List[Finding]:
+    if not any(seg in rel.split("/") for seg in ("serve", "fleet",
+                                                 "resil")):
+        return []
+    out = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        chain = _attr_chain(node.func)
+        if chain[:1] == ["threading"] and chain[-1] in (
+                "Lock", "RLock", "Condition"):
+            out.append(Finding(
+                "R006", rel, node.lineno, scopes.context_of(node),
+                _snippet(src_lines, node),
+                f"bare threading.{chain[-1]} in a threaded subsystem — "
+                "use analysis.locks.AuditedLock/AuditedRLock/"
+                "AuditedCondition so HEAT2D_LOCK_AUDIT sees it"))
+    return out
+
+
+# ------------------------------------------------------------------ #
+# R005: metric/doc drift (cross-file)
+# ------------------------------------------------------------------ #
+
+def _code_metric_names(trees: Dict[str, ast.Module]) -> Tuple[
+        Dict[str, Tuple[str, int]], Set[str]]:
+    """(literal name -> (file, line), wildcard suffixes). A metric
+    instantiated with ``prefix + "_suffix"`` contributes a wildcard —
+    checked loosely (some doc name must end with the suffix)."""
+    names: Dict[str, Tuple[str, int]] = {}
+    wildcards: Set[str] = set()
+
+    def note(value, rel, lineno) -> None:
+        if isinstance(value, str) and METRIC_RE.match(value):
+            names.setdefault(value, (rel, lineno))
+
+    for rel, tree in trees.items():
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.FunctionDef,
+                                 ast.AsyncFunctionDef)):
+                # metric names as parameter defaults (the
+                # ``counter="serve_coalesced_total"`` pattern) — only
+                # for parameters NAMED like a metric slot (a "prefix"
+                # default is a family prefix, not a family)
+                pos = node.args.posonlyargs + node.args.args
+                for prm, d in zip(pos[len(pos)
+                                      - len(node.args.defaults):],
+                                  node.args.defaults):
+                    if prm.arg in METRIC_KEYWORDS and isinstance(
+                            d, ast.Constant):
+                        note(d.value, rel, d.lineno)
+                for prm, d in zip(node.args.kwonlyargs,
+                                  node.args.kw_defaults):
+                    if d is not None and prm.arg in METRIC_KEYWORDS \
+                            and isinstance(d, ast.Constant):
+                        note(d.value, rel, d.lineno)
+                continue
+            if not isinstance(node, ast.Call):
+                continue
+            for kw in node.keywords:
+                if kw.arg in METRIC_KEYWORDS and isinstance(
+                        kw.value, ast.Constant):
+                    note(kw.value.value, rel, kw.value.lineno)
+            if not (isinstance(node.func, ast.Attribute)
+                    and node.func.attr in METRIC_METHODS
+                    and node.args):
+                continue
+            arg = node.args[0]
+            if isinstance(arg, ast.Constant):
+                note(arg.value, rel, node.lineno)
+            elif (isinstance(arg, ast.BinOp)
+                    and isinstance(arg.op, ast.Add)
+                    and isinstance(arg.right, ast.Constant)
+                    and isinstance(arg.right.value, str)):
+                wildcards.add(arg.right.value)
+    return names, wildcards
+
+
+_DOC_METRIC_RE = re.compile(
+    r"`((?:serve|fleet|resil|tune|inverse|slo)_[a-z0-9_*]+)"
+    r"(?:\{[^`]*\})?`")
+
+
+def _doc_metric_names(docs_dir: str) -> Dict[str, Tuple[str, int]]:
+    out: Dict[str, Tuple[str, int]] = {}
+    if not os.path.isdir(docs_dir):
+        return out
+    for fname in sorted(os.listdir(docs_dir)):
+        if not fname.endswith(".md"):
+            continue
+        path = os.path.join(docs_dir, fname)
+        with open(path) as f:
+            for i, line in enumerate(f, 1):
+                for m in _DOC_METRIC_RE.finditer(line):
+                    name = m.group(1)
+                    if name.endswith("_"):
+                        # brace-expansion shorthand in the docs
+                        # (``fleet_cache_{size,hit_rate}``): a prefix
+                        # wildcard
+                        name += "*"
+                    out.setdefault(name, (f"docs/{fname}", i))
+    return out
+
+
+def _rule_r005(trees: Dict[str, ast.Module],
+               docs_dir: str) -> List[Finding]:
+    code, code_wild = _code_metric_names(trees)
+    docs = _doc_metric_names(docs_dir)
+    doc_exact = {n for n in docs if "*" not in n}
+    doc_prefixes = {n.rstrip("*") for n in docs if "*" in n}
+    out: List[Finding] = []
+
+    def doc_covers(name: str) -> bool:
+        return name in doc_exact or any(
+            name.startswith(p) for p in doc_prefixes)
+
+    for name, (rel, line) in sorted(code.items()):
+        if not doc_covers(name):
+            out.append(Finding(
+                "R005", rel, line, "metrics", name,
+                f"metric family {name!r} is instantiated here but "
+                "appears in no docs/*.md table"))
+
+    code_exact = set(code)
+
+    def code_covers(name: str) -> bool:
+        if "*" in name:
+            prefix = name.rstrip("*")
+            # a doc wildcard is satisfied by any literal under the
+            # prefix; dynamically-prefixed families (code wildcards)
+            # can't be resolved statically — benefit of the doubt
+            return (any(c.startswith(prefix) for c in code_exact)
+                    or bool(code_wild))
+        return (name in code_exact
+                or any(name.endswith(s) for s in code_wild))
+
+    for name, (rel, line) in sorted(docs.items()):
+        if not code_covers(name):
+            out.append(Finding(
+                "R005", rel, line, "metrics", name,
+                f"documented metric family {name!r} is never "
+                "instantiated in code"))
+    return out
+
+
+# ------------------------------------------------------------------ #
+# driver
+# ------------------------------------------------------------------ #
+
+def lint_tree(root: str, rules: Optional[Iterable[str]] = None,
+              docs_dir: Optional[str] = None) -> List[Finding]:
+    """Run the selected rules over every ``*.py`` under ``root``
+    (tests/ excluded) plus the docs drift check. Returns findings
+    sorted by (path, line)."""
+    active = set(rules) if rules is not None else set(ALL_RULES)
+    unknown = active - set(ALL_RULES)
+    if unknown:
+        raise ValueError(f"unknown rule(s): {sorted(unknown)}")
+    trees: Dict[str, ast.Module] = {}
+    sources: Dict[str, List[str]] = {}
+    findings: List[Finding] = []
+    for path in _iter_py_files(root):
+        rel = _relpath(path, root)
+        with open(path, encoding="utf-8") as f:
+            src = f.read()
+        try:
+            tree = ast.parse(src, filename=rel)
+        except SyntaxError as e:
+            findings.append(Finding(
+                "R000", rel, e.lineno or 0, "<module>", "syntax-error",
+                f"file does not parse: {e.msg}"))
+            continue
+        trees[rel] = tree
+        sources[rel] = src.splitlines()
+
+    for rel, tree in trees.items():
+        scopes = _Scopes(tree)
+        lines = sources[rel]
+        if "R001" in active:
+            findings.extend(_rule_r001(rel, tree, scopes, lines))
+        if active & {"R002", "R003"}:
+            findings.extend(_rule_r002_r003(rel, tree, scopes, lines,
+                                            active))
+        if "R004" in active:
+            findings.extend(_rule_r004(rel, tree, scopes, lines))
+        if "R006" in active:
+            findings.extend(_rule_r006(rel, tree, scopes, lines))
+
+    if "R005" in active:
+        findings.extend(_rule_r005(
+            trees, docs_dir if docs_dir is not None
+            else os.path.join(root, "docs")))
+
+    return sorted(findings, key=lambda f: (f.path, f.line, f.rule))
+
+
+def split_baselined(findings: List[Finding],
+                    baseline: Dict[str, str]) -> Tuple[
+        List[Finding], List[Finding], List[str]]:
+    """(new, grandfathered, stale-baseline-keys)."""
+    new, old = [], []
+    seen: Set[str] = set()
+    for f in findings:
+        if f.key in baseline:
+            old.append(f)
+            seen.add(f.key)
+        else:
+            new.append(f)
+    stale = [k for k in baseline if k not in seen]
+    return new, old, stale

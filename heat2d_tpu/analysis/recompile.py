@@ -1,0 +1,197 @@
+"""Recompilation sentinel — bounded compile counts as a checked invariant.
+
+The serving design leans hard on compile-count discipline: the
+power-of-two batch padding exists so one signature compiles
+O(log max_batch) programs, the memoized ``ensemble.batch_runner``
+exists so steady-state traffic never retraces, and the fleet's warm
+restart replays hot signatures precisely because a compile is the
+expensive thing being restored. None of that was *checked* — a
+weak_type flip, an unhashable static, or a dtype-promotion change in
+a cache key silently turns O(log B) into O(requests), and the only
+symptom is a slow soak.
+
+``CompileWatch`` counts ACTUAL XLA compiles by listening to jax's
+compile logs (``jax.log_compiles`` routes one "Finished XLA
+compilation of <name>" record per backend compile through the
+``jax._src.dispatch`` logger — backend-independent, CPU CI included).
+``assert_bounded`` turns a watch into a gate; ``serve_compile_report``
+drives a representative serve workload (every occupancy 1..max_batch
+through ``EnsembleEngine``) and reports compiles per signature so the
+O(log max_batch) contract is a test, not a comment.
+"""
+
+from __future__ import annotations
+
+import logging
+import math
+import re
+from typing import Dict, List, Optional
+
+#: the logger jax routes per-compile records through (stable across
+#: the jax versions this repo supports; the regex below is the
+#: contract, the logger name just the tap point)
+_DISPATCH_LOGGER = "jax._src.dispatch"
+
+#: sibling logger log_compiles also raises to WARNING ("Compiling <f>
+#: with global shapes..."); silenced during a watch so tests stay quiet
+_PXLA_LOGGER = "jax._src.interpreters.pxla"
+
+_COMPILE_RE = re.compile(r"Finished XLA compilation of (.+?) in ")
+
+
+class RecompileBudgetError(AssertionError):
+    """A watched region compiled more programs than its budget — the
+    cache-key blowup class the sentinel exists to catch."""
+
+
+class _Capture(logging.Handler):
+    def __init__(self) -> None:
+        super().__init__(level=logging.DEBUG)
+        self.names: List[str] = []
+
+    def emit(self, record: logging.LogRecord) -> None:
+        m = _COMPILE_RE.search(record.getMessage())
+        if m:
+            self.names.append(m.group(1))
+
+
+class CompileWatch:
+    """Context manager counting XLA compiles inside its block.
+
+    ``limit``: optional compile budget — exceeding it raises
+    ``RecompileBudgetError`` at exit (with the offending program
+    names). ``match``: only count programs whose logged name contains
+    this substring / regex (``re.search``) — jax compiles tiny helper
+    programs (``convert_element_type`` etc.) around any real workload,
+    and a sentinel gating "the runner compiled once" must not count
+    them against the budget.
+    """
+
+    def __init__(self, limit: Optional[int] = None,
+                 match: Optional[str] = None):
+        self.limit = limit
+        self.match = match
+        self._handler = _Capture()
+        self._ctx = None
+
+    # -- results -------------------------------------------------------
+
+    @property
+    def names(self) -> List[str]:
+        """Logged program names, filtered by ``match``."""
+        if self.match is None:
+            return list(self._handler.names)
+        pat = re.compile(self.match)
+        return [n for n in self._handler.names if pat.search(n)]
+
+    @property
+    def count(self) -> int:
+        return len(self.names)
+
+    def counts_by_name(self) -> Dict[str, int]:
+        out: Dict[str, int] = {}
+        for n in self.names:
+            out[n] = out.get(n, 0) + 1
+        return out
+
+    # -- context -------------------------------------------------------
+
+    def __enter__(self) -> "CompileWatch":
+        import jax
+
+        logger = logging.getLogger(_DISPATCH_LOGGER)
+        self._prev_level = logger.level
+        self._prev_propagate = logger.propagate
+        # the log_compiles records are emitted at WARNING; make sure a
+        # quieted logger still delivers them to OUR handler — and only
+        # ours (propagation off keeps the console clean in tests)
+        if logger.level > logging.WARNING:
+            logger.setLevel(logging.WARNING)
+        logger.propagate = False
+        logger.addHandler(self._handler)
+        pxla = logging.getLogger(_PXLA_LOGGER)
+        self._prev_pxla_propagate = pxla.propagate
+        pxla.propagate = False
+        self._ctx = jax.log_compiles(True)
+        self._ctx.__enter__()
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        logger = logging.getLogger(_DISPATCH_LOGGER)
+        try:
+            self._ctx.__exit__(exc_type, exc, tb)
+        finally:
+            logger.removeHandler(self._handler)
+            logger.setLevel(self._prev_level)
+            logger.propagate = self._prev_propagate
+            logging.getLogger(_PXLA_LOGGER).propagate = \
+                self._prev_pxla_propagate
+        if exc_type is None and self.limit is not None \
+                and self.count > self.limit:
+            raise RecompileBudgetError(
+                f"compile budget exceeded: {self.count} XLA compiles "
+                f"(limit {self.limit})"
+                + (f" matching {self.match!r}" if self.match else "")
+                + f": {self.counts_by_name()}")
+
+
+def assert_bounded(watch: CompileWatch, limit: int,
+                   label: str = "workload") -> None:
+    """Post-hoc budget check on a finished watch (for code that wants
+    the report even on failure paths)."""
+    if watch.count > limit:
+        raise RecompileBudgetError(
+            f"{label}: {watch.count} XLA compiles exceed the budget of "
+            f"{limit}: {watch.counts_by_name()}")
+
+
+def log2_capacity_budget(max_batch: int) -> int:
+    """The serve contract: power-of-two padding means at most
+    ``floor(log2(max_batch)) + 1`` distinct capacities — one compile
+    each — per (signature, program) pair."""
+    return int(math.floor(math.log2(max(1, max_batch)))) + 1
+
+
+#: logged-name filter for the serve engine's batch runners (the
+#: memoized jitted callables serve dispatches through; ensemble.
+#: batch_runner stamps the name)
+SERVE_RUNNER_MATCH = r"batch_runner"
+
+
+def serve_compile_report(*, nx: int = 16, ny: int = 16, steps: int = 4,
+                         method: str = "jnp", max_batch: int = 8,
+                         convergence: bool = False) -> dict:
+    """Drive a representative serve workload — one signature, EVERY
+    occupancy 1..max_batch through ``EnsembleEngine.solve_batch`` —
+    under a ``CompileWatch`` and report the compile accounting.
+
+    Returns ``{"compiles": int, "budget": int, "names": {...},
+    "launches": int, "capacities": [...]}`` — the caller (test or CI
+    gate) asserts ``compiles <= budget``. The engine pads occupancies
+    to powers of two, so the runner must compile once per DISTINCT
+    capacity, never once per occupancy: O(log max_batch), the exact
+    property the padding design bought."""
+    from heat2d_tpu.models import ensemble
+    from heat2d_tpu.serve.engine import EnsembleEngine
+    from heat2d_tpu.serve.schema import SolveRequest
+
+    # a fresh runner cache: reusing an executable another test already
+    # compiled would undercount and pass vacuously
+    ensemble.batch_runner.cache_clear()
+    engine = EnsembleEngine(max_batch=max_batch)
+    with CompileWatch(match=SERVE_RUNNER_MATCH) as watch:
+        for occupancy in range(1, max_batch + 1):
+            reqs = [SolveRequest(nx=nx, ny=ny, steps=steps,
+                                 cx=0.1 + 0.01 * i, cy=0.1,
+                                 method=method,
+                                 convergence=convergence)
+                    for i in range(occupancy)]
+            engine.solve_batch(reqs)
+    capacities = sorted({row["capacity"] for row in engine.launch_log})
+    return {
+        "compiles": watch.count,
+        "budget": log2_capacity_budget(max_batch),
+        "names": watch.counts_by_name(),
+        "launches": engine.launches,
+        "capacities": capacities,
+    }

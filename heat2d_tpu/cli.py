@@ -407,8 +407,8 @@ def _run_ensemble_cli(args, cfg) -> int:
                 args.metrics_out,
                 extra_records=[{"event": "run_record", **record}])
         if args.run_record:
-            with open(args.run_record, "w") as f:
-                json.dump(record, f, indent=2)
+            from heat2d_tpu.io.binary import write_json_atomic
+            write_json_atomic(record, args.run_record)
         if cfg.debug:
             print(json.dumps(record, indent=2))
     return 0
@@ -770,8 +770,8 @@ def main(argv=None) -> int:
                     args.metrics_out,
                     extra_records=[{"event": "run_record", **record}])
         if args.run_record and primary:
-            with open(args.run_record, "w") as f:
-                json.dump(record, f, indent=2)
+            from heat2d_tpu.io.binary import write_json_atomic
+            write_json_atomic(record, args.run_record)
         if cfg.debug and primary:
             print(json.dumps(record, indent=2))
         return 0

@@ -112,7 +112,8 @@ class HeatConfig:
 
     def __post_init__(self):
         if self.mode not in MODES:
-            raise ConfigError(f"mode must be one of {MODES}, got {self.mode!r}")
+            raise ConfigError(
+                f"mode must be one of {MODES}, got {self.mode!r}")
         if self.nxprob < 3 or self.nyprob < 3:
             raise ConfigError(
                 f"grid must be at least 3x3 to have interior cells, got "
@@ -121,7 +122,8 @@ class HeatConfig:
             raise ConfigError(f"steps must be >= 0, got {self.steps}")
         if self.accum_dtype not in ("float32", "float64"):
             raise ConfigError(
-                f"accum_dtype must be float32 or float64, got {self.accum_dtype!r}")
+                "accum_dtype must be float32 or float64, got "
+                f"{self.accum_dtype!r}")
         if self.gridx < 1 or self.gridy < 1:
             raise ConfigError("gridx/gridy must be >= 1")
         if self.mode in ("dist2d", "hybrid"):

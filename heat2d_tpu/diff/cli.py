@@ -28,7 +28,6 @@ and final loss), the same envelope as every other CLI.
 from __future__ import annotations
 
 import argparse
-import json
 import os
 import sys
 
@@ -289,8 +288,9 @@ def _write_outputs(args, registry, extra) -> None:
         # the kind="inverse" run record) every CLI uses.
         write_run_jsonl(registry, args.metrics_out, "inverse", extra)
     if args.run_record:
-        with open(args.run_record, "w") as f:
-            json.dump(build_record("inverse", extra=extra), f, indent=2)
+        from heat2d_tpu.io.binary import write_json_atomic
+        write_json_atomic(build_record("inverse", extra=extra),
+                          args.run_record)
 
 
 def main(argv=None) -> int:

@@ -37,6 +37,8 @@ import sys
 import threading
 import time
 
+from heat2d_tpu.analysis.locks import AuditedLock
+
 
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(
@@ -141,7 +143,7 @@ def run_soak(args, registry) -> int:
 
     failures = []
     events = []                 # (t, "completed" | rejected-code)
-    ev_lock = threading.Lock()
+    ev_lock = AuditedLock("fleet.cli.events")
     responses = {}              # content_hash -> result bytes
     fleet = FleetServer(
         workers=args.workers, registry=registry,

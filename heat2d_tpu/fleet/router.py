@@ -67,11 +67,11 @@ import hashlib
 import logging
 import math
 import random
-import threading
 import time
 from concurrent.futures import Future
 from typing import Dict, List, Optional
 
+from heat2d_tpu.analysis.locks import AuditedLock, guarded_by
 from heat2d_tpu.fleet import wire
 from heat2d_tpu.fleet.supervisor import Supervisor, WorkerGone
 from heat2d_tpu.obs import tracing
@@ -154,6 +154,8 @@ class _Inflight:
     wire_span: "object" = None
 
 
+@guarded_by("_lock", "_parked", "_next_rid", "_total_inflight",
+            "_stopped")
 class FleetServer:
     """N supervised workers behind one ``submit()``. See the module
     docstring for the layer map."""
@@ -213,7 +215,7 @@ class FleetServer:
             on_worker_lost=self._on_worker_lost,
             on_worker_ready=self._on_worker_ready,
             on_tick=self._expire_overdue)
-        self._lock = threading.Lock()
+        self._lock = AuditedLock("fleet.router")
         self._records: Dict[int, _Inflight] = {}
         self._parked: List[_Inflight] = []
         self._next_rid = 0
@@ -233,7 +235,9 @@ class FleetServer:
     # -- lifecycle ----------------------------------------------------- #
 
     def start(self, wait_ready: bool = True) -> "FleetServer":
-        self._stopped = False
+        with self._lock:    # _stopped is read under the lock by the
+            #                 dispatch park path; write it there too
+            self._stopped = False
         self.sup.start(wait_ready=wait_ready)
         return self
 

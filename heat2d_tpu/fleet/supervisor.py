@@ -44,6 +44,7 @@ import threading
 import time
 from typing import Callable, Dict, List, Optional
 
+from heat2d_tpu.analysis.locks import AuditedLock, guarded_by
 from heat2d_tpu.resil.retry import RetryPolicy
 
 log = logging.getLogger("heat2d_tpu.fleet")
@@ -70,12 +71,13 @@ class WorkerHandle:
         self.ready = False
         self.dead = False
         self.restarted = False      # a replacement, not a first spawn
-        self.write_lock = threading.Lock()
+        self.write_lock = AuditedLock(f"fleet.worker{slot}.pipe")
 
     def pid(self) -> int:
         return self.proc.pid
 
 
+@guarded_by("_lock", "_handles")
 class Supervisor:
     """Spawn/watch/restart N fleet workers. See the module docstring
     for the failure model; the router wires the three callbacks."""
@@ -116,7 +118,7 @@ class Supervisor:
         self.on_worker_ready = on_worker_ready
         self.on_tick = on_tick
 
-        self._lock = threading.Lock()
+        self._lock = AuditedLock("fleet.supervisor")
         self._handles: List[Optional[WorkerHandle]] = [None] * workers
         self._attempts = [0] * workers       # consecutive failed spawns
         self._restart_at = [None] * workers  # due time while slot dead

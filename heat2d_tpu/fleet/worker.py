@@ -35,6 +35,8 @@ import os
 import sys
 import threading
 
+from heat2d_tpu.analysis.locks import AuditedLock
+
 log = logging.getLogger("heat2d_tpu.fleet.worker")
 
 
@@ -97,7 +99,7 @@ def main(argv=None) -> int:
         default_timeout=args.timeout,
         registry=registry).start()
 
-    wlock = threading.Lock()
+    wlock = AuditedLock("fleet.worker.wire")
 
     def emit(obj: dict) -> None:
         line = json.dumps(obj)

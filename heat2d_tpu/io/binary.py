@@ -106,6 +106,30 @@ def read_binary(path, shape) -> np.ndarray:
     return a.reshape(shape)
 
 
+def write_text_atomic(text: str, path) -> None:
+    """Commit a text artifact crash-consistently: staged to
+    ``path + '.tmp'``, fsync'd, promoted with ``os.replace`` — the
+    checkpoint protocol's discipline for every persistent text file
+    (run records, metric exports, grid dumps). A reader can never see
+    a half-written artifact; a crash leaves the previous version (or
+    nothing plus a ``.tmp``), never a torn file. Enforced tree-wide by
+    lint rule R001 (docs/ANALYSIS.md)."""
+    path = str(path)
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        f.write(text)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+
+
+def write_json_atomic(obj, path, **dump_kwargs) -> None:
+    """``write_text_atomic`` for one JSON document (run records,
+    exported dbs, scaling records)."""
+    dump_kwargs.setdefault("indent", 2)
+    write_text_atomic(json.dumps(obj, **dump_kwargs) + "\n", path)
+
+
 def checkpoint_tmp_path(path) -> str:
     """The staging file a checkpoint is written to before its atomic
     commit. Deterministic (not per-pid): on the multihost shared-FS path

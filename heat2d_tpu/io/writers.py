@@ -73,13 +73,13 @@ def format_grid_rowmajor(u) -> str:
 
 
 def write_grid_baseline(u, path) -> None:
-    with open(path, "w") as f:
-        f.write(format_grid_baseline(u))
+    from heat2d_tpu.io.binary import write_text_atomic
+    write_text_atomic(format_grid_baseline(u), path)
 
 
 def write_grid_rowmajor(u, path) -> None:
-    with open(path, "w") as f:
-        f.write(format_grid_rowmajor(u))
+    from heat2d_tpu.io.binary import write_text_atomic
+    write_text_atomic(format_grid_rowmajor(u), path)
 
 
 def read_grid_text(path, layout: str = "rowmajor") -> np.ndarray:

@@ -757,8 +757,19 @@ def batch_runner(nx: int, ny: int, steps: int, method: str = "auto",
     re-specialization inside the one jitted callable."""
     method = _pick_method(method, nx, ny)
     if convergence:
-        return jax.jit(_conv_runner(method, steps, interval, sensitivity))
-    return jax.jit(functools.partial(_BATCH_RUNNERS[method], steps=steps))
+        fn = _conv_runner(method, steps, interval, sensitivity)
+    else:
+        fn = functools.partial(_BATCH_RUNNERS[method], steps=steps)
+    # A stable name (partials log as "<unnamed wrapped function>"):
+    # compile logs, traces, and the recompile sentinel
+    # (analysis/recompile.py) attribute every serve compile to the
+    # runner they belong to. Host-side metadata only — the traced
+    # program is unchanged.
+    try:
+        fn.__name__ = f"batch_runner_{method}"
+    except (AttributeError, TypeError):
+        pass
+    return jax.jit(fn)
 
 
 def run_ensemble(nx: int, ny: int, steps: int, cxs, cys, u0=None,

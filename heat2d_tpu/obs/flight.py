@@ -119,10 +119,18 @@ class FlightRecorder:
                 f.flush()
                 os.fsync(f.fileno())
             os.replace(tmp, self.path)
-            with open(self.path + ".digest.json", "w") as f:
+            # the sidecar commits atomically too: a kill between the
+            # two writes leaves body + .tmp sidecar, which
+            # load_postmortem reports as missing-sidecar (torn), never
+            # as a half-parsed digest
+            side = self.path + ".digest.json"
+            with open(side + ".tmp", "w") as f:
                 json.dump({"schema": FLIGHT_SCHEMA, "reason": reason,
                            "sha256": hashlib.sha256(blob).hexdigest(),
                            "lines": len(lines)}, f)
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(side + ".tmp", side)
             return self.path
         except Exception:   # noqa: BLE001 — see docstring
             return None

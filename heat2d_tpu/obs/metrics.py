@@ -224,18 +224,21 @@ class MetricsRegistry:
 
     def write_jsonl(self, path: str, extra_records=()) -> None:
         """JSONL event log: every recorded event, a final ``snapshot``
-        line, then any caller-supplied records (e.g. the run record)."""
+        line, then any caller-supplied records (e.g. the run record).
+        Committed atomically (tmp + ``os.replace``): a consumer tailing
+        the export never sees a half-written snapshot line."""
+        from heat2d_tpu.io.binary import write_text_atomic
+
         events = self.events()
-        with open(path, "w") as f:
-            for ev in events:
-                f.write(json.dumps(ev) + "\n")
-            f.write(json.dumps({"event": "snapshot",
-                                "ts": _utc_now_iso(),
-                                **self.snapshot()}) + "\n")
-            for rec in extra_records:
-                f.write(json.dumps(rec) + "\n")
+        lines = [json.dumps(ev) for ev in events]
+        lines.append(json.dumps({"event": "snapshot",
+                                 "ts": _utc_now_iso(),
+                                 **self.snapshot()}))
+        extra = tuple(extra_records)
+        lines.extend(json.dumps(rec) for rec in extra)
+        write_text_atomic("\n".join(lines) + "\n", path)
         log.debug("wrote %d events + snapshot + %d records to %s",
-                  len(events), len(tuple(extra_records)), path)
+                  len(events), len(extra), path)
 
     def prometheus_text(self) -> str:
         """Prometheus text exposition: counters, gauges, and summaries.

@@ -355,8 +355,9 @@ def main(argv=None) -> int:
     loaded = load_dir(args.trace_dir, verify=not args.no_verify)
     report = merge_report(args.trace_dir, loaded=loaded)
     if args.perfetto_out:
-        with open(args.perfetto_out, "w") as f:
-            json.dump(to_chrome(loaded["spans"]), f)
+        from heat2d_tpu.io.binary import write_json_atomic
+        write_json_atomic(to_chrome(loaded["spans"]), args.perfetto_out,
+                          indent=None)
         print(f"wrote {args.perfetto_out} "
               f"({len(loaded['spans'])} spans)", file=sys.stderr)
 

@@ -207,7 +207,8 @@ def digest(events: list, top: int = 25) -> dict:
 
 def to_markdown(d: dict, logdir: str = "") -> str:
     lines = [
-        f"# Trace digest — the mpiP analogue{f' ({logdir})' if logdir else ''}",
+        "# Trace digest — the mpiP analogue"
+        + (f" ({logdir})" if logdir else ""),
         "",
         "Aggregated from the captured `jax.profiler.trace` device events "
         "(Report.pdf p.34-37 reproduced for XLA: per-op self-time shares "
@@ -267,8 +268,8 @@ def main(argv=None) -> int:
         print(str(e), file=sys.stderr)
         return 1
     if args.json_out:
-        with open(args.json_out, "w") as f:
-            json.dump(d, f, indent=2)
+        from heat2d_tpu.io.binary import write_json_atomic
+        write_json_atomic(d, args.json_out)
     if args.format == "json":
         print(json.dumps(d, indent=2))
     else:

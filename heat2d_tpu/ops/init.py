@@ -27,15 +27,18 @@ def inidat(nx: int, ny: int, dtype=jnp.float32) -> jnp.ndarray:
 
 def inidat_block(block_shape: tuple[int, int], nx: int, ny: int,
                  x_offset, y_offset, dtype=jnp.float32) -> jnp.ndarray:
-    """Initial condition for a local block at global offset (x_offset, y_offset).
+    """Initial condition for a local block at global offset
+    (x_offset, y_offset).
 
     Equivalent to grad1612_mpi_heat.c:163-168 with ``xs``/``ys`` the global
     coordinates of the block's top-left cell. Offsets may be traced values
     (e.g. derived from ``lax.axis_index`` inside ``shard_map``).
     """
     bm, bn = block_shape
-    ix = lax.broadcasted_iota(dtype, (bm, bn), 0) + jnp.asarray(x_offset, dtype)
-    iy = lax.broadcasted_iota(dtype, (bm, bn), 1) + jnp.asarray(y_offset, dtype)
+    ix = (lax.broadcasted_iota(dtype, (bm, bn), 0)
+          + jnp.asarray(x_offset, dtype))
+    iy = (lax.broadcasted_iota(dtype, (bm, bn), 1)
+          + jnp.asarray(y_offset, dtype))
     nxf = jnp.asarray(nx, dtype)
     nyf = jnp.asarray(ny, dtype)
     return ix * (nxf - ix - 1) * iy * (nyf - iy - 1)

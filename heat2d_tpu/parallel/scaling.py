@@ -17,7 +17,6 @@ its ``tail``.
 
 from __future__ import annotations
 
-import json
 
 
 def square_mesh(n: int) -> tuple[int, int]:
@@ -105,6 +104,6 @@ def scaling_record(payloads: list, out_path: str | None = None) -> dict:
 
     rec = build_record("multichip", extra={"scaling": payloads})
     if out_path:
-        with open(out_path, "w") as f:
-            json.dump(rec, f, indent=2, sort_keys=True)
+        from heat2d_tpu.io.binary import write_json_atomic
+        write_json_atomic(rec, out_path, sort_keys=True)
     return rec

@@ -42,9 +42,10 @@ from __future__ import annotations
 
 import dataclasses
 import os
-import threading
 import time
 from typing import Optional
+
+from heat2d_tpu.analysis.locks import AuditedLock
 
 _ENV_PREFIX = "HEAT2D_CHAOS_"
 
@@ -145,7 +146,7 @@ class _Controller:
     def __init__(self, config: ChaosConfig, registry=None):
         self.config = config
         self.registry = registry
-        self._lock = threading.Lock()
+        self._lock = AuditedLock("resil.chaos.controller")
         self.ckpt_count = 0      # checkpoints that reached mid_write
         self.launch_count = 0
         self.launches_failed = 0
@@ -230,7 +231,7 @@ class _Controller:
         return True
 
 
-_lock = threading.Lock()
+_lock = AuditedLock("resil.chaos")
 _controller: Optional[_Controller] = None
 _enabled = False        # fast-path guard: False == all hooks are no-ops
 _env_checked = False

@@ -37,6 +37,7 @@ import threading
 import time
 from typing import Callable, Optional
 
+from heat2d_tpu.analysis.locks import AuditedLock
 from heat2d_tpu.resil.chaos import ChaosError
 
 log = logging.getLogger("heat2d_tpu.resil")
@@ -184,7 +185,7 @@ class DegradedMode:
         self.registry = registry
         self.metric_prefix = metric_prefix
         self._clock = clock
-        self._lock = threading.Lock()
+        self._lock = AuditedLock("resil.degraded")
         self._failures = 0          # consecutive
         self._opened_at: Optional[float] = None
         self._probing = False

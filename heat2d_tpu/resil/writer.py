@@ -35,13 +35,12 @@ from __future__ import annotations
 import contextlib
 import dataclasses
 import logging
-import os
-import threading
 from concurrent.futures import Future, ThreadPoolExecutor
 from typing import Optional
 
 import numpy as np
 
+from heat2d_tpu.analysis.locks import AuditedLock, guarded_by
 from heat2d_tpu.io.binary import (checkpoint_tmp_path,
                                   commit_checkpoint_files, write_binary)
 from heat2d_tpu.resil.manager import CheckpointManager
@@ -61,6 +60,7 @@ class _PendingCommit:
     out_shape: tuple
 
 
+@guarded_by("_lock", "_future", "_pending", "_closed", "saves")
 class AsyncCheckpointer:
     """Write restart points without blocking the run.
 
@@ -81,7 +81,7 @@ class AsyncCheckpointer:
         self._future: Optional[Future] = None
         self._pending: Optional[_PendingCommit] = None
         self._closed = False
-        self._lock = threading.Lock()
+        self._lock = AuditedLock("resil.writer")
         self.saves = 0
 
     # -- public -------------------------------------------------------- #
@@ -117,7 +117,8 @@ class AsyncCheckpointer:
         try:
             self.flush()
         finally:
-            self._closed = True
+            with self._lock:    # save_async reads _closed under it
+                self._closed = True
             self._pool.shutdown(wait=True)
 
     def __enter__(self) -> "AsyncCheckpointer":

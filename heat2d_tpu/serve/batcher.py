@@ -34,6 +34,7 @@ import threading
 import time
 from typing import Callable, Optional
 
+from heat2d_tpu.analysis.locks import AuditedCondition, guarded_by
 from heat2d_tpu.obs import tracing
 from heat2d_tpu.serve.schema import Rejected, SolveRequest, request_trace
 
@@ -57,6 +58,7 @@ class Pending:
         self.deadline = None if timeout is None else now + timeout
 
 
+@guarded_by("_cond", "_depth", "_running", "_draining")
 class MicroBatcher:
     """The queue + scheduler. ``dispatch(signature, pendings)`` runs on
     the scheduler thread and must deliver/fail every pending it is
@@ -76,7 +78,7 @@ class MicroBatcher:
         self.max_delay = max_delay
         self.max_queue = max_queue
         self.registry = registry
-        self._cond = threading.Condition()
+        self._cond = AuditedCondition("serve.batcher")
         #: signature -> FIFO of Pending (insertion order = arrival order)
         self._buckets: "collections.OrderedDict" = collections.OrderedDict()
         self._depth = 0

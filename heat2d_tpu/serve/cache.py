@@ -16,11 +16,13 @@ Metrics (obs/metrics.py registry, optional): ``serve_cache_hits_total``,
 from __future__ import annotations
 
 import collections
-import threading
 from concurrent.futures import Future
 from typing import Optional
 
+from heat2d_tpu.analysis.locks import AuditedLock, guarded_by
 
+
+@guarded_by("_lock", "hits", "misses", "evictions")
 class ResultCache:
     """Bounded LRU over content hashes. Thread-safe: admission runs on
     caller threads, fills on the scheduler thread. ``prefix`` names the
@@ -35,7 +37,7 @@ class ResultCache:
         self.capacity = capacity
         self.registry = registry
         self.prefix = prefix
-        self._lock = threading.Lock()
+        self._lock = AuditedLock(prefix)
         self._data: "collections.OrderedDict" = collections.OrderedDict()
         self.hits = 0
         self.misses = 0
@@ -94,7 +96,7 @@ class SingleFlight:
     requests share the leader's fate — result or rejection."""
 
     def __init__(self, registry=None, counter: str = "serve_coalesced_total"):
-        self._lock = threading.Lock()
+        self._lock = AuditedLock("single_flight")
         self._inflight: dict = {}
         self.registry = registry
         self._counter = counter

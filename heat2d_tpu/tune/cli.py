@@ -21,7 +21,6 @@ stored entries. The CI ``tune-selftest`` job runs exactly this.
 from __future__ import annotations
 
 import argparse
-import json
 import os
 import sys
 
@@ -304,8 +303,8 @@ def run_search(args, registry=None, out=sys.stdout) -> int:
           f"cached={totals['cached']} failed={totals['failed']}",
           file=out)
     if args.export:
-        with open(args.export, "w") as f:
-            json.dump(db.data, f, indent=2, sort_keys=True)
+        from heat2d_tpu.io.binary import write_json_atomic
+        write_json_atomic(db.data, args.export, sort_keys=True)
         print(f"# exported db to {args.export}", file=out)
     _write_metrics(args, registry, totals)
     return 0

@@ -1,4 +1,5 @@
-"""Device introspection — the detailsGPU analogue (grad1612_cuda_heat.cu:24-37).
+"""Device introspection — the detailsGPU analogue
+(grad1612_cuda_heat.cu:24-37).
 
 Where the reference printed SM version, memory sizes and warp/block limits,
 we report the TPU/host platform facts that matter for this workload: device

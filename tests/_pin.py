@@ -1,0 +1,22 @@
+"""The suite's jaxpr-pin helpers — ONE import of the consolidated
+``heat2d_tpu.analysis.jaxpr_pin`` library.
+
+Every "subsystem X is free when off" acceptance pin (obs, tune, diff,
+tracing, chaos, fused-halo, lock-audit) goes through these; a broken
+pin now fails with a readable structural diff of the two traced
+programs instead of a bare ``assert a == b`` over multi-thousand-line
+strings."""
+
+from heat2d_tpu.analysis.jaxpr_pin import (assert_jaxpr_differs,
+                                           assert_jaxpr_equal,
+                                           band_runner_jaxpr,
+                                           batch_runner_jaxpr,
+                                           diff_jaxprs, jaxpr_text,
+                                           sharded_runner_jaxpr,
+                                           solver_jaxpr)
+
+__all__ = [
+    "assert_jaxpr_differs", "assert_jaxpr_equal", "band_runner_jaxpr",
+    "batch_runner_jaxpr", "diff_jaxprs", "jaxpr_text",
+    "sharded_runner_jaxpr", "solver_jaxpr",
+]

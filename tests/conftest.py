@@ -10,8 +10,27 @@ import jax  # noqa: E402
 
 jax.config.update("jax_enable_x64", True)
 
+import os  # noqa: E402
+
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
+
+from heat2d_tpu.analysis import locks as _locks  # noqa: E402
+
+if _locks._env_enabled():
+    # Opt-in lock audit (the CI lock-audit job; the env parse is
+    # locks._env_enabled so this gate and the lock factories can never
+    # disagree about what arms the audit): every test runs with an
+    # installed auditor — serve/fleet/resil locks become instrumented,
+    # @guarded_by checks arm — and FAILS on any lock-order cycle or
+    # guarded-state violation it observed.
+    @pytest.fixture(autouse=True)
+    def _lock_audit():
+        _locks.install()
+        yield
+        rep = _locks.report()
+        _locks.uninstall()
+        assert rep.clean, rep.render()
 
 
 @pytest.fixture

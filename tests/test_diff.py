@@ -23,6 +23,8 @@ from heat2d_tpu.diff.adjoint import (DiffSpec, make_diff_solve,
                                      segment_schedule)
 from heat2d_tpu.models.engine import run_fixed, run_fixed_stacked
 from heat2d_tpu.ops.init import inidat
+from tests._pin import (assert_jaxpr_equal, band_runner_jaxpr,
+                        solver_jaxpr)
 from heat2d_tpu.ops.stencil import stencil_step, stencil_step_var
 
 
@@ -250,38 +252,31 @@ def test_forward_solver_jaxpr_identical_with_diff_exercised():
     """The acceptance pin: building AND differentiating a diff operator
     leaves the forward solver's traced program byte-identical — the
     serve hot path pays zero for the subsystem's existence."""
-    from heat2d_tpu.config import HeatConfig
-    from heat2d_tpu.models.solver import Heat2DSolver
-
-    cfg = HeatConfig(nxprob=12, nyprob=12, steps=8, mode="serial")
-    u0 = inidat(12, 12)
-    before = str(jax.make_jaxpr(Heat2DSolver(cfg).make_runner())(u0))
+    before = solver_jaxpr(12, 12, 8)
 
     f = make_diff_solve(12, 12, 8)
     w = _w(12, 12)
     jax.grad(lambda u: jnp.sum(w * f(u, 0.1, 0.1)))(_u0(12, 12))
 
-    after = str(jax.make_jaxpr(Heat2DSolver(cfg).make_runner())(u0))
-    assert before == after
+    after = solver_jaxpr(12, 12, 8)
+    assert_jaxpr_equal(before, after,
+                       label="forward solver (diff exercised)")
 
 
 def test_batched_band_runner_jaxpr_identical_with_diff_exercised(
         monkeypatch):
     """Same pin for the serve compile cache's kernel path."""
-    from heat2d_tpu.models.ensemble import _run_batch_band
     from heat2d_tpu.ops import pallas_stencil as ps
 
     monkeypatch.setattr(ps, "VMEM_BUDGET_BYTES", 256 * 1024)
-    u0 = jnp.zeros((2, 64, 128), jnp.float32)
-    cxs = jnp.asarray([0.1, 0.2], jnp.float32)
-    fn = lambda u, a, b: _run_batch_band(u, a, b, steps=10)  # noqa: E731
-    before = str(jax.make_jaxpr(fn)(u0, cxs, cxs))
+    before = band_runner_jaxpr(64, 128, 10, b=2)
 
     f = make_diff_solve(16, 16, 6)
     jax.grad(lambda u: jnp.sum(f(u, 0.1, 0.1)))(_u0(16, 16))
 
-    after = str(jax.make_jaxpr(fn)(u0, cxs, cxs))
-    assert before == after
+    after = band_runner_jaxpr(64, 128, 10, b=2)
+    assert_jaxpr_equal(before, after,
+                       label="batched band runner (diff exercised)")
 
 
 # --------------------------------------------------------------------- #

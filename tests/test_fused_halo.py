@@ -16,13 +16,13 @@ import numpy as np
 import pytest
 
 from heat2d_tpu.config import ConfigError, HeatConfig
+from tests._pin import (assert_jaxpr_differs, assert_jaxpr_equal,
+                        sharded_runner_jaxpr)
 from heat2d_tpu.models.solver import Heat2DSolver
 from heat2d_tpu.parallel.halo import fused_halo_viable
 from heat2d_tpu.parallel.mesh import make_mesh
 from heat2d_tpu.parallel.sharded import (effective_halo_depth,
-                                         make_sharded_runner,
-                                         resolve_halo_route,
-                                         sharded_inidat)
+                                         resolve_halo_route)
 
 MESHES = [(1, 2), (2, 2), (2, 4)]
 
@@ -140,9 +140,7 @@ def test_fused_hybrid_degrades_bitwise():
 # ------------------------------------------------------------------ #
 
 def _runner_jaxpr(cfg, mesh):
-    u0 = sharded_inidat(cfg, mesh)
-    runner, _ = make_sharded_runner(cfg, mesh)
-    return str(jax.make_jaxpr(runner.__wrapped__)(u0))
+    return sharded_runner_jaxpr(cfg, mesh)
 
 
 def test_jaxpr_pin_collective_route_unchanged():
@@ -154,7 +152,8 @@ def test_jaxpr_pin_collective_route_unchanged():
                 gridx=2, gridy=2)
     explicit = _runner_jaxpr(HeatConfig(halo="collective", **base), mesh)
     default = _runner_jaxpr(HeatConfig(**base), mesh)
-    assert explicit == default
+    assert_jaxpr_equal(explicit, default,
+                       label="collective route (explicit vs default)")
 
 
 def test_jaxpr_pin_degraded_fused_is_collective():
@@ -169,7 +168,8 @@ def test_jaxpr_pin_degraded_fused_is_collective():
                 gridx=8, gridy=1, halo_depth=100)
     fused = _runner_jaxpr(HeatConfig(halo="fused", **base), mesh)
     col = _runner_jaxpr(HeatConfig(halo="collective", **base), mesh)
-    assert fused == col
+    assert_jaxpr_equal(fused, col,
+                       label="fully-degraded fused vs collective")
 
 
 def test_jaxpr_pin_viable_fused_differs():
@@ -180,7 +180,7 @@ def test_jaxpr_pin_viable_fused_differs():
                 gridx=2, gridy=2, halo_depth=3)
     fused = _runner_jaxpr(HeatConfig(halo="fused", **base), mesh)
     col = _runner_jaxpr(HeatConfig(halo="collective", **base), mesh)
-    assert fused != col
+    assert_jaxpr_differs(fused, col, label="viable fused route")
 
 
 # ------------------------------------------------------------------ #

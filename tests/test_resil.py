@@ -599,16 +599,17 @@ def test_jaxpr_identical_with_chaos_armed():
     chaos campaign (or none) must not change the traced program of the
     engine loops — pinned here the same way test_telemetry pins the
     tap-off path."""
-    import jax
     from heat2d_tpu.models.solver import Heat2DSolver
+
+    from tests._pin import assert_jaxpr_equal, jaxpr_text
 
     cfg = _cfg(convergence=True, interval=4)
     u0 = inidat(16, 16)
-    before = str(jax.make_jaxpr(Heat2DSolver(cfg).make_runner())(u0))
+    before = jaxpr_text(Heat2DSolver(cfg).make_runner(), u0)
     chaos.install(ChaosConfig(fail_launches=3, ckpt_latency_s=0.5,
                               kill_ckpt_at=99))
-    armed = str(jax.make_jaxpr(Heat2DSolver(cfg).make_runner())(u0))
-    assert before == armed
+    armed = jaxpr_text(Heat2DSolver(cfg).make_runner(), u0)
+    assert_jaxpr_equal(before, armed, label="chaos armed vs disarmed")
     assert "debug_callback" not in before
 
 

@@ -214,7 +214,9 @@ def test_ready_buckets_dispatch_oldest_head_first():
     now = time.monotonic() + 1.0
     order = []
     for _ in range(3):
-        sig, batch = mb._pop_ready_locked(now)
+        with mb._cond:      # the _locked suffix is a real contract:
+            #                 the lock audit flags a bare call
+            sig, batch = mb._pop_ready_locked(now)
         order.append(batch[0].req.content_hash())
     # Insertion-order service would yield hot, hot2, other.
     assert order == [r.content_hash() for r in (hot, other, hot2)]

@@ -405,32 +405,20 @@ def test_serve_untraced_emits_nothing_and_no_sig_spam():
 # the jaxpr pins: tracing is FREE when off — and when on
 # --------------------------------------------------------------------- #
 
-def _solver_jaxpr():
-    from heat2d_tpu.config import HeatConfig
-    from heat2d_tpu.models.solver import Heat2DSolver
-    from heat2d_tpu.ops.init import inidat
+from tests._pin import (assert_jaxpr_equal, band_runner_jaxpr,
+                        batch_runner_jaxpr, solver_jaxpr)
 
-    cfg = HeatConfig(nxprob=12, nyprob=12, steps=8, mode="serial")
-    u0 = inidat(12, 12)
-    return str(jax.make_jaxpr(Heat2DSolver(cfg).make_runner())(u0))
+
+def _solver_jaxpr():
+    return solver_jaxpr(12, 12, 8)
 
 
 def _batch_runner_jaxpr():
-    from heat2d_tpu.models import ensemble
-
-    fn = ensemble.batch_runner(16, 16, 4, "jnp")
-    u0 = jnp.zeros((2, 16, 16), jnp.float32)
-    cxs = jnp.asarray([0.1, 0.2], jnp.float32)
-    return str(jax.make_jaxpr(fn)(u0, cxs, cxs))
+    return batch_runner_jaxpr(16, 16, 4, "jnp", b=2)
 
 
 def _band_runner_jaxpr():
-    from heat2d_tpu.models.ensemble import _run_batch_band
-
-    u0 = jnp.zeros((2, 64, 128), jnp.float32)
-    cxs = jnp.asarray([0.1, 0.2], jnp.float32)
-    fn = lambda u, a, b: _run_batch_band(u, a, b, steps=10)  # noqa: E731
-    return str(jax.make_jaxpr(fn)(u0, cxs, cxs))
+    return band_runner_jaxpr(64, 128, 10, b=2)
 
 
 def test_jaxpr_pin_solver_band_and_batch_runner(monkeypatch, sink):
@@ -456,9 +444,12 @@ def test_jaxpr_pin_solver_band_and_batch_runner(monkeypatch, sink):
     tracing.uninstall()
     os.environ.pop("HEAT2D_TRACE_DIR", None)
     assert not tracing.enabled()
-    assert _solver_jaxpr() == with_tracing["solver"]
-    assert _batch_runner_jaxpr() == with_tracing["batch"]
-    assert _band_runner_jaxpr() == with_tracing["band"]
+    assert_jaxpr_equal(with_tracing["solver"], _solver_jaxpr(),
+                       label="solver (traced vs untraced)")
+    assert_jaxpr_equal(with_tracing["batch"], _batch_runner_jaxpr(),
+                       label="batch runner (traced vs untraced)")
+    assert_jaxpr_equal(with_tracing["band"], _band_runner_jaxpr(),
+                       label="band runner (traced vs untraced)")
 
 
 def test_phase_emits_host_span_only_when_traced(sink):

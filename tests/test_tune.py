@@ -23,6 +23,8 @@ import numpy as np
 import pytest
 
 import heat2d_tpu.ops.pallas_stencil as ps
+from tests._pin import (assert_jaxpr_differs, assert_jaxpr_equal,
+                        band_runner_jaxpr, jaxpr_text)
 from heat2d_tpu.tune import runtime as tr
 from heat2d_tpu.tune.cli import frontier_table, search_problem
 from heat2d_tpu.tune.db import TuningDB
@@ -235,28 +237,24 @@ def test_band_chunk_jaxpr_identical_without_db(monkeypatch):
     off)."""
     monkeypatch.setattr(ps, "VMEM_BUDGET_BYTES", 256 * 1024)  # band route
     u = jnp.zeros((64, 128), jnp.float32)
-    with_hook = str(jax.make_jaxpr(
-        lambda v: ps.band_chunk(v, 20, 0.1, 0.1))(u))
+    with_hook = jaxpr_text(lambda v: ps.band_chunk(v, 20, 0.1, 0.1), u)
     monkeypatch.setattr(ps, "_tuned_band_config",
                         lambda *a, **k: None)
-    without = str(jax.make_jaxpr(
-        lambda v: ps.band_chunk(v, 20, 0.1, 0.1))(u))
-    assert with_hook == without
+    without = jaxpr_text(lambda v: ps.band_chunk(v, 20, 0.1, 0.1), u)
+    assert_jaxpr_equal(with_hook, without,
+                       label="band_chunk (db hook vs none)")
 
 
 def test_batched_band_runner_jaxpr_identical_without_db(monkeypatch):
     """The serve compile cache's kernel path (ensemble batched band
     runner) is likewise pinned when no db is active."""
-    from heat2d_tpu.models.ensemble import _run_batch_band
     monkeypatch.setattr(ps, "VMEM_BUDGET_BYTES", 256 * 1024)
-    u0 = jnp.zeros((2, 64, 128), jnp.float32)
-    cxs = jnp.asarray([0.1, 0.2], jnp.float32)
-    fn = lambda u, a, b: _run_batch_band(u, a, b, steps=10)  # noqa: E731
-    with_hook = str(jax.make_jaxpr(fn)(u0, cxs, cxs))
+    with_hook = band_runner_jaxpr(64, 128, 10, b=2)
     monkeypatch.setattr(ps, "_tuned_band_config",
                         lambda *a, **k: None)
-    without = str(jax.make_jaxpr(fn)(u0, cxs, cxs))
-    assert with_hook == without
+    without = band_runner_jaxpr(64, 128, 10, b=2)
+    assert_jaxpr_equal(with_hook, without,
+                       label="batched band runner (db hook vs none)")
 
 
 def test_db_entry_steers_band_chunk(tmp_path, monkeypatch):
@@ -267,7 +265,7 @@ def test_db_entry_steers_band_chunk(tmp_path, monkeypatch):
     u = jnp.asarray(np.linspace(0, 1, 64 * 128, dtype=np.float32)
                     .reshape(64, 128))
     fn = jax.jit(lambda v: ps.band_chunk(v, 20, 0.1, 0.1))
-    base_jaxpr = str(jax.make_jaxpr(fn)(u))
+    base_jaxpr = jaxpr_text(fn, u)
     base_out = np.asarray(fn(u))
 
     make_db(tmp_path / "db.json",
@@ -275,9 +273,10 @@ def test_db_entry_steers_band_chunk(tmp_path, monkeypatch):
     tr.set_tuning_db(str(tmp_path / "db.json"))
     tuned = ps._resolve_bands(64, 128, jnp.float32, None)
     assert tuned == (24, 72)             # tuned bm, ceil-padded rows
-    tuned_jaxpr = str(jax.make_jaxpr(
-        lambda v: ps.band_chunk(v, 20, 0.1, 0.1))(u))
-    assert tuned_jaxpr != base_jaxpr     # the plan actually moved
+    tuned_jaxpr = jaxpr_text(lambda v: ps.band_chunk(v, 20, 0.1, 0.1),
+                             u)
+    assert_jaxpr_differs(tuned_jaxpr, base_jaxpr,
+                         label="tuned band plan")  # plan actually moved
     out = np.asarray(jax.jit(
         lambda v: ps.band_chunk(v, 20, 0.1, 0.1))(u))
     np.testing.assert_array_equal(out, base_out)
