@@ -265,23 +265,29 @@ class InverseEngine:
     optimizer streams (labeled by short content hash).
     """
 
-    def __init__(self, registry=None, deadline=None, stop_event=None):
+    def __init__(self, registry=None, deadline=None, stop_event=None,
+                 clock=None):
         self.registry = registry
         self.deadline = deadline
         self.stop_event = stop_event
+        #: the clock the deadline reads (None = time.monotonic):
+        #: injected by tests so the abort is driven deterministically
+        #: instead of racing real compile time on slow hosts
+        self.clock = clock
         self.solves = 0
         self.solve_log: list = []
 
     def _iteration_guard(self):
         import time
-        t0 = time.monotonic()
+        clock = time.monotonic if self.clock is None else self.clock
+        t0 = clock()
 
         def check(_it, _loss, _gn):
             if self.stop_event is not None and self.stop_event.is_set():
                 raise Rejected("shutdown",
                                "server stopping mid-optimization")
             if self.deadline is not None \
-                    and time.monotonic() - t0 > self.deadline:
+                    and clock() - t0 > self.deadline:
                 raise Rejected(
                     "watchdog_timeout",
                     f"inverse optimization exceeded the "

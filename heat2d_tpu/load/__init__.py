@@ -1,0 +1,32 @@
+"""Load-generation + capacity-modeling subsystem (ROADMAP item 5;
+docs/LOADGEN.md).
+
+The serving platform's benchmark harness, rebuilt as a first-class
+subsystem — the role the reference's 37-page hand-built report played
+for the MPI solver:
+
+- ``schedule`` — the ``Arrival``/``Schedule`` traffic shape both
+                 producers emit and the runner consumes.
+- ``replay``   — recorded span timelines (PR 9's ``spans-*.jsonl``)
+                 parsed back into the arrival process production saw.
+- ``synth``    — seeded deterministic workload generators: zipf
+                 signature skew, MMPP bursts, diurnal envelopes,
+                 tenant mixes, inverse heavy tails; named profiles.
+- ``runner``   — open-loop execution against a live ``SolveServer``
+                 or ``FleetServer`` with fidelity + latency +
+                 throughput measurement (``load_*`` families).
+- ``capacity`` — the fitted capacity model: max sustainable req/s
+                 per serving unit -> units needed for N req/s.
+- ``gate``     — the committed-baseline serving-regression gate
+                 (``bench_serve``) CI runs on every PR.
+- ``cli``      — ``heat2d-tpu-load``.
+"""
+
+from heat2d_tpu.load.capacity import fit_capacity, units_for
+from heat2d_tpu.load.replay import schedule_from_trace_dir
+from heat2d_tpu.load.schedule import Arrival, Schedule
+from heat2d_tpu.load.synth import PROFILES, MixProfile, synthesize
+
+__all__ = ["Arrival", "Schedule", "MixProfile", "PROFILES",
+           "synthesize", "schedule_from_trace_dir", "fit_capacity",
+           "units_for"]

@@ -1,0 +1,95 @@
+"""Capacity model — from a measured latency/throughput surface to
+"chips needed for N req/s at this SLO".
+
+The reference's report answers sizing questions by table lookup over
+19 hand-built benchmark tables; the serving analogue is a fitted
+model over the sweep the load runner measures. The model is
+deliberately simple and stated in the record so its assumptions are
+auditable:
+
+1. A sweep point QUALIFIES when the target kept up (achieved within
+   ``keepup_margin`` of offered), shed at most ``max_shed_rate``, and
+   met its SLO (p99 target + error budget, when one was given).
+2. **Max sustainable throughput** = the largest qualifying offered
+   rate's achieved req/s. If the TOP sweep point qualifies the system
+   never saturated and the fit is flagged ``saturated: false`` — the
+   capacity is a lower bound, and sizing from it is conservative.
+3. **Per-unit rate** = max sustainable / serving units (fleet
+   workers on CPU, chips on TPU — the target says which it counted),
+   assuming the near-linear unit scaling the strong-scaling gate
+   (docs/SCALING.md) holds serve-side; ``units_for(N)`` is then a
+   ceiling division.
+
+``fit_capacity`` is pure arithmetic over surface rows — no clocks, no
+jax — so it is unit-testable against synthetic sweeps with known
+capacity.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import List, Optional
+
+CAPACITY_MODEL = "heat2d-tpu/capacity-linear-per-unit/v1"
+
+
+def _qualifies(row: dict, keepup_margin: float,
+               max_shed_rate: float) -> bool:
+    if row.get("offered_rps", 0.0) <= 0:
+        return False
+    keepup = row.get("achieved_rps", 0.0) \
+        >= (1.0 - keepup_margin) * row["offered_rps"]
+    shed_ok = row.get("shed_rate", 0.0) <= max_shed_rate
+    slo_ok = bool(row.get("slo_ok", True))
+    return keepup and shed_ok and slo_ok
+
+
+def fit_capacity(rows: List[dict], units: int, *,
+                 keepup_margin: float = 0.2,
+                 max_shed_rate: float = 0.01) -> dict:
+    """Fit the capacity model over surface ``rows`` (each carrying
+    ``offered_rps`` / ``achieved_rps`` / ``shed_rate`` / ``slo_ok``).
+    Returns the fit dict published into ``kind="load"`` run records
+    and gate baselines."""
+    if units < 1:
+        raise ValueError(f"units must be >= 1, got {units}")
+    ranked = sorted(rows, key=lambda r: r.get("offered_rps", 0.0))
+    qualifying = [r for r in ranked
+                  if _qualifies(r, keepup_margin, max_shed_rate)]
+    if qualifying:
+        best = qualifying[-1]
+        max_rps = best["achieved_rps"]
+        saturated = best is not ranked[-1]
+    else:
+        max_rps, saturated = 0.0, True
+    per_unit = max_rps / units
+    return {
+        "model": CAPACITY_MODEL,
+        "units": int(units),
+        "points": len(ranked),
+        "qualifying_points": len(qualifying),
+        "max_sustainable_rps": round(max_rps, 4),
+        "per_unit_rps": round(per_unit, 4),
+        #: False == the sweep never found the knee: capacity is a
+        #: LOWER bound (every offered rate qualified)
+        "saturated": bool(saturated),
+        "criteria": {"keepup_margin": keepup_margin,
+                     "max_shed_rate": max_shed_rate},
+    }
+
+
+def units_for(fit: dict, target_rps: float) -> Optional[int]:
+    """Serving units needed to sustain ``target_rps`` under the
+    fitted per-unit rate (the "chips for N req/s" answer). ``None``
+    when the fit found no sustainable point — the model cannot size
+    what it never saw succeed."""
+    per_unit = fit.get("per_unit_rps", 0.0)
+    if per_unit <= 0:
+        return None
+    return max(1, math.ceil(target_rps / per_unit))
+
+
+def sustainable_at(fit: dict, units: int) -> float:
+    """The model's predicted sustainable req/s at ``units`` serving
+    units (linear extrapolation from the fitted per-unit rate)."""
+    return round(fit.get("per_unit_rps", 0.0) * units, 4)

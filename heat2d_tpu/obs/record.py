@@ -37,9 +37,14 @@ RECORD_SCHEMA = "heat2d-tpu/run-record/v1"
 #: final loss, convergence flag — + the inverse_* metric families and
 #: per-iteration loss/grad-norm series), "multichip" (the strong-
 #: scaling gate: per-chip Mcells/s at 1 vs n chips + efficiency per
-#: halo route — parallel/scaling.py).
+#: halo route — parallel/scaling.py), "load" (heat2d-tpu-load: the
+#: latency/throughput surface — per-point offered/achieved req/s,
+#: latency quantiles, shed rate, replay-fidelity skew, per-signature
+#: SLO rows — plus the fitted capacity model (max sustainable req/s,
+#: per-unit rate, units-for-N sizing) and the gate verdict against
+#: the committed baseline — heat2d_tpu/load/, docs/LOADGEN.md).
 RECORD_KINDS = ("run", "ensemble", "bench", "sweep", "serve", "tune",
-                "fleet", "inverse", "multichip")
+                "fleet", "inverse", "multichip", "load")
 
 
 def run_context() -> dict:

@@ -194,6 +194,8 @@ def summarize(trace_spans: list) -> dict:
     return {
         "trace_id": trace_spans[0]["trace_id"],
         "content_hash": attrs.get("content_hash"),
+        "signature": attrs.get("signature"),
+        "tenant": attrs.get("tenant"),
         "root": root.get("name"),
         "service": root.get("service"),
         "t0": min(s.get("t0", 0.0) for s in trace_spans),
@@ -234,6 +236,54 @@ def merge_report(trace_dir: str, verify: bool = True,
         "postmortems": loaded["postmortems"],
         "corrupt_postmortems": loaded["corrupt"],
     }
+
+
+# -- per-segment statistics (--stats) ----------------------------------- #
+
+def _seg_quantile(sorted_vals: list, q: float) -> float:
+    if not sorted_vals:
+        return 0.0
+    from heat2d_tpu.obs.metrics import quantile
+    return quantile(sorted_vals, q)    # the registry's one convention
+
+
+def segment_stats(report: dict) -> dict:
+    """Per-segment distribution over every trace in a merged report:
+    {segment: {count, mean, p50, p99, max, total}} across the
+    per-trace critical-path breakdowns. The aggregate view of where
+    requests spend time — what the load subsystem's replay rides on
+    (load/replay.py consumes the same ``load_dir``/``assemble``
+    parser) and what ``--stats`` renders."""
+    out = {}
+    rows = report.get("traces", [])
+    for seg in SEGMENTS + ("total",):
+        vals = sorted(r["breakdown"].get(seg, 0.0) for r in rows)
+        n = len(vals)
+        out[seg] = {
+            "count": n,
+            "mean": round(sum(vals) / n, 6) if n else 0.0,
+            "p50": round(_seg_quantile(vals, 0.50), 6),
+            "p99": round(_seg_quantile(vals, 0.99), 6),
+            "max": round(vals[-1], 6) if n else 0.0,
+            "total": round(sum(vals), 6),
+        }
+    return out
+
+
+def stats_markdown(report: dict) -> str:
+    stats = segment_stats(report)
+    n = len(report.get("traces", []))
+    lines = [
+        f"# Segment statistics — {report['dir']} ({n} trace(s))", "",
+        "| segment | mean | p50 | p99 | max | total (s) |",
+        "|---|---|---|---|---|---|",
+    ]
+    for seg in SEGMENTS + ("total",):
+        s = stats[seg]
+        lines.append(
+            f"| {seg} | {s['mean']:.4g} | {s['p50']:.4g} "
+            f"| {s['p99']:.4g} | {s['max']:.4g} | {s['total']:.4g} |")
+    return "\n".join(lines) + "\n"
 
 
 # -- Chrome trace-event export ----------------------------------------- #
@@ -334,6 +384,10 @@ def main(argv=None) -> int:
                     "cross-process timeline (docs/OBSERVABILITY.md)")
     p.add_argument("trace_dir", help="the span directory to merge")
     p.add_argument("--format", default="md", choices=["md", "json"])
+    p.add_argument("--stats", action="store_true",
+                   help="print per-segment (queue/compile/launch/"
+                        "wire/replay) p50/p99 tables over the merged "
+                        "timeline instead of per-trace rows")
     p.add_argument("--top", type=int, default=25,
                    help="trace rows in the markdown table")
     p.add_argument("--perfetto-out", default=None, metavar="PATH",
@@ -361,7 +415,15 @@ def main(argv=None) -> int:
         print(f"wrote {args.perfetto_out} "
               f"({len(loaded['spans'])} spans)", file=sys.stderr)
 
-    if args.format == "json":
+    if args.stats:
+        if args.format == "json":
+            print(json.dumps({"dir": report["dir"],
+                              "traces": len(report["traces"]),
+                              "segments": segment_stats(report)},
+                             indent=2))
+        else:
+            print(stats_markdown(report), end="")
+    elif args.format == "json":
         print(json.dumps(report, indent=2))
     else:
         print(to_markdown(report, top=args.top), end="")

@@ -136,14 +136,29 @@ class Watchdog:
     If ``work`` outlives the deadline, ``on_timeout()`` fires ONCE from
     a timer thread (the block itself keeps running — Python cannot
     safely preempt it — but its waiters get structured answers instead
-    of a hang). ``fired`` says whether the deadline hit."""
+    of a hang). ``fired`` says whether the deadline hit.
+
+    ``clock`` (a ``time.monotonic``-shaped callable) makes the
+    deadline CONTROLLABLE: with one injected, a watcher thread polls
+    the clock (5 ms real-time granularity) instead of arming a
+    wall-clock timer, so a test can hold time still — a compile
+    running long on a slow CI host can no longer trip a deadline the
+    test meant for the *modeled* clock — and advance it exactly when
+    the scenario calls for the timeout (the deterministic fix for the
+    host-speed-sensitive inverse-deadline flake). ``None`` (the
+    default) keeps the zero-thread ``threading.Timer`` path."""
+
+    _POLL_S = 0.005
 
     def __init__(self, deadline_s: Optional[float],
-                 on_timeout: Callable[[], None]):
+                 on_timeout: Callable[[], None],
+                 clock: Optional[Callable[[], float]] = None):
         self.deadline_s = deadline_s
         self.on_timeout = on_timeout
+        self.clock = clock
         self.fired = False
         self._timer: Optional[threading.Timer] = None
+        self._stop: Optional[threading.Event] = None
 
     def _fire(self) -> None:
         self.fired = True
@@ -152,16 +167,32 @@ class Watchdog:
         except Exception:   # broken callback must not kill timer thread
             log.exception("watchdog on_timeout callback failed")
 
+    def _watch(self, t0: float) -> None:
+        while not self._stop.wait(self._POLL_S):
+            if self.clock() - t0 >= self.deadline_s:
+                self._fire()
+                return
+
     def __enter__(self) -> "Watchdog":
-        if self.deadline_s is not None:
+        if self.deadline_s is None:
+            return self
+        if self.clock is None:
             self._timer = threading.Timer(self.deadline_s, self._fire)
             self._timer.daemon = True
             self._timer.start()
+        else:
+            self._stop = threading.Event()
+            t = threading.Thread(target=self._watch,
+                                 args=(self.clock(),),
+                                 name="heat2d-watchdog", daemon=True)
+            t.start()
         return self
 
     def __exit__(self, *exc) -> None:
         if self._timer is not None:
             self._timer.cancel()
+        if self._stop is not None:
+            self._stop.set()
 
 
 class DegradedMode:

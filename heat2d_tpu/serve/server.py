@@ -62,7 +62,8 @@ class SolveServer:
                  default_timeout: Optional[float] = 30.0,
                  registry=None, retry_policy: Optional[RetryPolicy] = None,
                  launch_deadline: Optional[float] = None,
-                 breaker: Optional[DegradedMode] = None):
+                 breaker: Optional[DegradedMode] = None,
+                 deadline_clock=None):
         if registry is None:
             from heat2d_tpu.obs import get_registry
             registry = get_registry()
@@ -73,6 +74,10 @@ class SolveServer:
         #: launch wall-clock deadline; None = no watchdog (hangs bound
         #: only by the caller's own future timeout)
         self.launch_deadline = launch_deadline
+        #: the clock the deadline is measured on (None = wall clock).
+        #: Tests inject a controllable clock so deadline scenarios are
+        #: deterministic on any host speed (resil/retry.Watchdog).
+        self.deadline_clock = deadline_clock
         self.breaker = (DegradedMode(registry=registry) if breaker is None
                         else breaker)
         self.cache = ResultCache(cache_size, registry=registry)
@@ -238,7 +243,8 @@ class SolveServer:
             from heat2d_tpu.diff.serving import InverseEngine
             self._inv_engine = InverseEngine(registry=self.registry,
                                              deadline=self.launch_deadline,
-                                             stop_event=self._inv_stop)
+                                             stop_event=self._inv_stop,
+                                             clock=self.deadline_clock)
         return self._inv_engine
 
     def _inverse_pool(self) -> ThreadPoolExecutor:
@@ -296,7 +302,8 @@ class SolveServer:
 
         engine = (self._inverse_engine() if kind == "inverse"
                   else self.engine)
-        watchdog = Watchdog(self.launch_deadline, on_timeout)
+        watchdog = Watchdog(self.launch_deadline, on_timeout,
+                            clock=self.deadline_clock)
         t_launch0 = time.monotonic()
         try:
             with watchdog:

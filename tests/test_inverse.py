@@ -319,19 +319,62 @@ def test_long_inverse_loop_aborts_on_nondrain_stop():
     assert exc_info.value.code == "shutdown"
 
 
+class _StepClock:
+    """A controllable monotonic clock: returns a fixed reading until
+    the test advances it. Thread-safe (the watchdog polls it from its
+    watcher thread, the engine guard reads it from the inverse
+    lane)."""
+
+    def __init__(self):
+        import threading
+        self._lock = threading.Lock()
+        self._t = 0.0
+
+    def __call__(self) -> float:
+        with self._lock:
+            return self._t
+
+    def advance(self, dt: float) -> None:
+        with self._lock:
+            self._t += dt
+
+
 def test_inverse_deadline_aborts_loop_and_frees_lane():
     """launch_deadline bounds an inverse loop: the watchdog fails the
     waiters and the engine aborts at the next iteration, after which
-    the server still serves."""
+    the server still serves.
+
+    The deadline is driven by a CONTROLLABLE clock (flake fix —
+    previously a 0.5s wall-clock deadline, which a slow CI host's
+    compile times could trip on the follow-up plain solve): real time
+    never advances the deadline here, so only the explicit advance()
+    past it can fire the watchdog — on any host speed."""
+    import time
+
     _, _, mask, values = _observed_problem()
     req = InverseRequest.from_fields(12, 12, 16, mask, values,
                                      iterations=100_000, lr=0.02)
-    with SolveServer(max_delay=0.01, launch_deadline=0.5) as srv:
+    clock = _StepClock()
+    reg = MetricsRegistry()
+    with SolveServer(registry=reg, max_delay=0.01,
+                     launch_deadline=0.5,
+                     deadline_clock=clock) as srv:
         fut = srv.submit(req)
+        # wait until the optimization loop is live (iterating), then
+        # push the modeled clock past the deadline
+        deadline = time.monotonic() + 120
+        while time.monotonic() < deadline:
+            if reg.snapshot()["counters"].get(
+                    "inverse_iterations_total", 0):
+                break
+            time.sleep(0.02)
+        clock.advance(1.0)
         with pytest.raises(Rejected) as exc_info:
             fut.result(120)
         assert exc_info.value.code == "watchdog_timeout"
-        # the lane is free again: plain traffic still flows
+        # the lane is free again: plain traffic still flows — and its
+        # launch cannot trip the (frozen) deadline however slow the
+        # host is
         r = srv.solve(SolveRequest(nx=16, ny=16, steps=3, method="jnp"),
                       timeout=60)
         assert r.steps_done == 3
