@@ -1,0 +1,20 @@
+"""Fleet control plane — close the loop from live telemetry back into
+tuning, shedding and capacity decisions (docs/CONTROL.md).
+
+- ``plane.ControlPlane`` — the tick loop: sustained SLO burn
+  (obs/slo.BurnWindow) -> pre-emptive shed / retune / capacity advice,
+  escalating before the DegradedMode breaker trips.
+- ``retuner.Retuner`` — re-measure hot signatures off-peak and stage
+  candidate TuningDBs (validated=False, next epoch).
+- ``rollout.Rollout`` — canary one worker, assert bitwise parity,
+  observe SLO burn + relative latency, promote worker-by-worker or
+  auto-revert with a bitwise post-revert proof; kill-storm-safe by
+  construction (one-generation env overlays).
+"""
+
+from heat2d_tpu.control.plane import ControlPlane
+from heat2d_tpu.control.retuner import Retuner, problem_from_signature
+from heat2d_tpu.control.rollout import Rollout, RolloutConfig
+
+__all__ = ["ControlPlane", "Retuner", "Rollout", "RolloutConfig",
+           "problem_from_signature"]

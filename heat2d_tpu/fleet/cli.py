@@ -99,6 +99,41 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--slo-error-budget", type=float, default=0.001,
                    metavar="F",
                    help="allowed failure fraction per signature")
+    p.add_argument("--control", action="store_true",
+                   help="arm the SLO-driven control plane beside the "
+                        "soak (docs/CONTROL.md): a BurnWindow watches "
+                        "per-signature burn and sheds/retunes before "
+                        "the breaker trips; workers serve under the "
+                        "control db directory's validated tuning db")
+    p.add_argument("--control-db", default=None, metavar="DIR",
+                   help="directory for the control plane's "
+                        "validated.json / candidate.json tuning dbs "
+                        "(default: a temp dir)")
+    p.add_argument("--control-rollout", action="store_true",
+                   help="with --control: at the soak midpoint, stage "
+                        "a candidate db for the hottest signature "
+                        "(simulated measurement backend) and run one "
+                        "safe rollout — canary, bitwise parity, "
+                        "observation, promote or auto-revert — while "
+                        "the load keeps running")
+    p.add_argument("--control-bad-candidate", action="store_true",
+                   help="inject a deliberately-bad candidate: the "
+                        "canary's one-generation env overlay carries "
+                        "HEAT2D_CHAOS_SLOW_WORKER_S, so the rollout "
+                        "MUST measure the regression and auto-revert "
+                        "with bitwise post-revert parity (the CLI "
+                        "fails otherwise)")
+    p.add_argument("--control-storm-phase", default=None,
+                   choices=["canary", "parity", "observe", "promote"],
+                   help="arm a chaos kill storm (every worker hard-"
+                        "killed) to land when the rollout reaches "
+                        "this window; the CLI then asserts no worker "
+                        "generation ever served a non-validated "
+                        "config")
+    p.add_argument("--control-observe", type=float, default=2.0,
+                   metavar="S",
+                   help="rollout observation window (paired probes + "
+                        "windowed SLO burn)")
     p.add_argument("--platform", default=None, choices=["cpu", "tpu"],
                    help="force a JAX platform for the workers "
                         "(default cpu: the soak is a logic gate, not a "
@@ -145,6 +180,28 @@ def run_soak(args, registry) -> int:
     events = []                 # (t, "completed" | rejected-code)
     ev_lock = AuditedLock("fleet.cli.events")
     responses = {}              # content_hash -> result bytes
+    env = ({"JAX_PLATFORMS": args.platform} if args.platform
+           else {"JAX_PLATFORMS": "cpu"})
+
+    # -- control plane setup (docs/CONTROL.md) -------------------------- #
+    control = args.control or args.control_rollout
+    validated_path = candidate_path = None
+    if control:
+        import tempfile
+        cdir = args.control_db or tempfile.mkdtemp("heat2d-control")
+        os.makedirs(cdir, exist_ok=True)
+        validated_path = os.path.join(cdir, "validated.json")
+        candidate_path = os.path.join(cdir, "candidate.json")
+        # every worker serves under the VALIDATED db path (a missing
+        # file degrades to "no db"); rollouts hand the candidate path
+        # to a canary via a one-generation env overlay only
+        env["HEAT2D_TUNE_DB"] = validated_path
+    if args.control_storm_phase:
+        from heat2d_tpu.resil import chaos
+        chaos.install(chaos.ChaosConfig(
+            rollout_kill_phase=args.control_storm_phase,
+            rollout_kills=0), registry=registry)
+
     fleet = FleetServer(
         workers=args.workers, registry=registry,
         default_timeout=args.timeout,
@@ -156,8 +213,7 @@ def run_soak(args, registry) -> int:
         # windows must measure the SOLVE path the chaos is aimed at,
         # not cache service (which has its own tests).
         cache_size=0, worker_cache_size=0,
-        env=({"JAX_PLATFORMS": args.platform} if args.platform
-             else {"JAX_PLATFORMS": "cpu"}),
+        env=env,
         per_worker_env=_parse_worker_env(args.worker_env))
     killed = []
     submitted = 0
@@ -186,7 +242,12 @@ def run_soak(args, registry) -> int:
 
     print(f"# fleet soak: {args.workers} workers, {args.soak:.0f}s, "
           f"concurrency {args.concurrency}"
-          + (f", killing {args.kill} at midpoint" if args.chaos else ""))
+          + (f", killing {args.kill} at midpoint" if args.chaos else "")
+          + (", control plane armed" if control else ""))
+    plane = None
+    rollout_thread = None
+    rollout_out: dict = {}
+    control_extra = None
     with fleet:
         # Warmup OUTSIDE the measured window: every signature compiles
         # its padded batch programs on every worker-reachable path, so
@@ -202,8 +263,21 @@ def run_soak(args, registry) -> int:
                 f.result(timeout=args.timeout + 60)
             except Exception:   # noqa: BLE001 — warmup is best-effort
                 pass
+        if control:
+            from heat2d_tpu.control import ControlPlane, Retuner
+            from heat2d_tpu.obs import slo as _slo
+            plane = ControlPlane(
+                fleet,
+                policy=_slo.SLOPolicy(
+                    latency_p99_s=args.slo_p99 or 30.0,
+                    error_budget=args.slo_error_budget),
+                retuner=Retuner(fleet, candidate_path=candidate_path,
+                                validated_path=validated_path),
+                registry=registry).start()
         t_start = time.monotonic()
         kill_at = t_start + args.soak / 2 if args.chaos else None
+        rollout_at = (t_start + args.soak / 2
+                      if args.control_rollout else None)
         window = args.window or max(1.0, args.soak / 3)
         reqs = iter(_requests(args, 10 ** 9))
         t_rec = None        # when the fleet was whole-and-warm again
@@ -233,14 +307,26 @@ def run_soak(args, registry) -> int:
                     print(f"# t+{now - t_start:.1f}s: throughput "
                           f"recovered ({r:.1f} rps vs {pre:.1f} "
                           f"pre-kill)")
+            if (rollout_at is not None and rollout_thread is None
+                    and now >= rollout_at):
+                rollout_at = None
+                rollout_thread = _start_rollout(
+                    args, plane, validated_path, candidate_path,
+                    rollout_out, failures)
             if now - t_start >= args.soak:
                 # "throughput recovered after restart" is measured, not
                 # scheduled: under --chaos the load keeps running until
                 # the sliding window clears the recovery bar (hard-
                 # capped at 3x the nominal soak, reported as a failure)
-                if (not args.chaos
-                        or (t_thr is not None and t_rec is not None)
-                        or now - t_start >= 3 * args.soak):
+                chaos_done = (not args.chaos
+                              or (t_thr is not None and t_rec is not None)
+                              or now - t_start >= 3 * args.soak)
+                # a mid-soak rollout keeps its observation probes under
+                # live load: the loop runs until it settles (capped)
+                rollout_done = (rollout_thread is None
+                                or not rollout_thread.is_alive()
+                                or now - t_start >= 6 * args.soak)
+                if chaos_done and rollout_done:
                     break
             if (kill_at is not None and not killed
                     and now >= kill_at):
@@ -258,12 +344,28 @@ def run_soak(args, registry) -> int:
             submitted += 1
             fleet.submit(req).add_done_callback(
                 lambda f, r=req: on_done(f, r))
+        if rollout_thread is not None:
+            rollout_thread.join(timeout=3 * args.soak + 120)
+            if rollout_thread.is_alive():
+                failures.append("control rollout did not finish")
         # drain: wait for every outstanding slot back
         for _ in range(args.concurrency):
             sem.acquire(timeout=args.timeout + 30)
+        if plane is not None:
+            plane.stop()
+            control_extra = plane.summary()
+            control_extra["validated_path"] = validated_path
+            control_extra["candidate_path"] = candidate_path
+            # what every CURRENT worker reports serving, pre-shutdown
+            control_extra["workers_tune"] = {
+                str(s): (fleet.sup.worker_info(s) or {}).get("tune")
+                for s in fleet.sup.alive_slots()}
         deaths, restarts = fleet.sup.deaths, fleet.sup.restarts
         alive = len(fleet.sup.alive_slots())
         clean = fleet.stop()
+    if args.control_storm_phase:
+        from heat2d_tpu.resil import chaos
+        chaos.uninstall()
 
     answered = len(events)
     completed = sum(1 for _t, o in events if o == "completed")
@@ -332,12 +434,99 @@ def run_soak(args, registry) -> int:
     if not clean:
         failures.append("supervisor shutdown was not clean")
 
+    # -- control-plane acceptance (docs/CONTROL.md) --------------------- #
+    if control_extra is not None:
+        from heat2d_tpu.tune.db import TuningDB
+        if not control_extra.get("no_unvalidated_serving"):
+            failures.append(
+                "control: a non-rollout worker generation served a "
+                "non-validated config: "
+                f"{control_extra.get('unvalidated_serving')}")
+        oc = rollout_out.get("outcome")
+        control_extra["rollout_outcome"] = oc
+        if args.control_rollout and oc is None:
+            failures.append("control: the rollout never produced an "
+                            "outcome")
+        elif args.control_bad_candidate:
+            if not (oc or "").startswith("reverted"):
+                failures.append(f"control: the deliberately-bad "
+                                f"candidate was NOT auto-reverted "
+                                f"(outcome {oc})")
+            elif rollout_out.get("post_revert_parity") is not True:
+                failures.append("control: post-revert answers were "
+                                "not bitwise-identical to the "
+                                "pre-rollout baseline")
+        elif args.control_storm_phase and (oc or "").startswith(
+                "reverted"):
+            if rollout_out.get("post_revert_parity") is not True:
+                failures.append("control: storm revert without a "
+                                "bitwise post-revert parity proof")
+        elif args.control_rollout and not args.control_storm_phase:
+            if oc != "promoted":
+                failures.append(f"control: a healthy candidate did "
+                                f"not promote (outcome {oc})")
+            else:
+                vdb = TuningDB(validated_path)
+                if not (vdb.validated and vdb.epoch
+                        == rollout_out.get("epoch")):
+                    failures.append(
+                        f"control: promote did not advance the "
+                        f"validated db (epoch {vdb.epoch}, validated "
+                        f"{vdb.validated})")
+        summary["control"] = {
+            "rollout_outcome": oc,
+            "no_unvalidated_serving":
+                control_extra.get("no_unvalidated_serving"),
+            "decisions": len(control_extra.get("decisions", [])),
+        }
+
     print(f"# soak summary: {json.dumps(summary)}")
     for f in failures:
         print(f"FAIL: {f}", file=sys.stderr)
-    _write_metrics(args, registry, dict(summary, failures=failures))
+    _write_metrics(args, registry, dict(summary, failures=failures),
+                   control=control_extra)
     print("fleet soak " + ("FAILED" if failures else "passed"))
     return 1 if failures else 0
+
+
+def _start_rollout(args, plane, validated_path, candidate_path,
+                   out, failures):
+    """Stage a candidate for the hottest signature (simulated
+    measurement backend — the rollout machinery, not kernel speed, is
+    under test on CPU) and run one safe rollout on a thread beside the
+    live load. Appends to ``failures`` / updates ``out`` in place."""
+    from heat2d_tpu.control import RolloutConfig
+
+    staged = None
+    for sig, _n in plane.retuner.hot_signatures():
+        staged = plane.retuner.stage_candidate(sig)
+        if staged is not None:
+            break
+    if staged is None:
+        failures.append("control rollout: no tunable hot signature "
+                        "to stage")
+        return None
+    extra = ({"HEAT2D_CHAOS_SLOW_WORKER_S": "0.5"}
+             if args.control_bad_candidate else {})
+    cfg = RolloutConfig(
+        candidate_path=candidate_path, validated_path=validated_path,
+        probe_spec={"nx": args.nx, "ny": args.ny, "steps": args.steps,
+                    "cx": 0.123, "cy": 0.1, "method": "jnp"},
+        observe_s=args.control_observe,
+        probe_timeout=args.timeout,
+        extra_canary_env=extra)
+    print(f"# control: staged candidate epoch {staged['epoch']} for "
+          f"{staged['signature']}; starting rollout"
+          + (" (bad-candidate injection armed)" if extra else ""))
+
+    def _run():
+        out.update(plane.run_rollout(cfg))
+        print(f"# control: rollout outcome {out.get('outcome')}")
+
+    t = threading.Thread(target=_run, name="heat2d-control-rollout",
+                         daemon=True)
+    t.start()
+    return t
 
 
 def _rate(events, t_start: float, lo: float, hi: float) -> float:
@@ -376,7 +565,7 @@ def _oracle_check(args, responses) -> int:
     return mismatches + len(todo)
 
 
-def _write_metrics(args, registry, extra) -> None:
+def _write_metrics(args, registry, extra, control=None) -> None:
     from heat2d_tpu.obs.record import write_run_jsonl
     if args.slo_p99 is not None and registry is not None:
         from heat2d_tpu.obs import slo
@@ -392,7 +581,10 @@ def _write_metrics(args, registry, extra) -> None:
             "router_spans": t.spans_emitted if t is not None else 0,
             "postmortems": len(flight.find_postmortems(args.trace_dir)),
         }
-    write_run_jsonl(registry, args.metrics_out, "fleet", extra)
+    # the control plane's decisions/rollouts/invariant ride as their
+    # own kind="control" record beside the fleet record
+    write_run_jsonl(registry, args.metrics_out, "fleet", extra,
+                    more=[("control", control)] if control else ())
 
 
 def main(argv=None) -> int:
@@ -422,6 +614,14 @@ def main(argv=None) -> int:
         from heat2d_tpu.obs import tracing
         tracing.install(tracing.Tracer(args.trace_dir, service="router"))
 
+    if ((args.control_storm_phase or args.control_bad_candidate)
+            and not args.control_rollout):
+        # without a rollout there is no storm window and no canary to
+        # poison — a soak that "passed" would prove nothing
+        print("--control-storm-phase/--control-bad-candidate require "
+              "--control-rollout (they act on a live rollout)",
+              file=sys.stderr)
+        return 2
     from heat2d_tpu.obs import MetricsRegistry
     registry = MetricsRegistry()
     if args.soak is not None:

@@ -57,7 +57,9 @@ Metric families (docs/FLEET.md has the table): ``fleet_requests_total
 gauges, ``fleet_quota_rejected_total{tenant}``,
 ``fleet_failover_replays_total``, ``fleet_workers_alive``,
 ``fleet_worker_deaths_total{cause}``, ``fleet_worker_restarts_total``,
-``fleet_degraded`` / ``fleet_breaker_trips_total``.
+``fleet_degraded`` / ``fleet_breaker_trips_total``,
+``fleet_shed_watermark`` (the control plane's pre-emptive-shed
+actuator — docs/CONTROL.md).
 """
 
 from __future__ import annotations
@@ -134,7 +136,11 @@ class _Inflight:
     """One dispatched request: everything needed to answer it — or to
     replay it somewhere else. ``warmup`` records belong to a restarted
     worker's rejoin phase: no client future waits on them, they are
-    never replayed, and their answers are discarded."""
+    never replayed, and their answers are discarded. ``probe`` records
+    are the control plane's targeted dispatches (``FleetServer.
+    probe``): pinned to ONE slot, bypassing cache/single-flight/
+    quotas, never replayed — their answer (or structured failure)
+    resolves ``fut`` directly."""
     key: Optional[str]          # content hash (cache / flight key)
     sig: str                    # signature string (routing key)
     tenant: str
@@ -145,6 +151,8 @@ class _Inflight:
     rid: Optional[int] = None
     replays: int = 0
     warmup: bool = False
+    probe: bool = False
+    fut: "object" = None        # probe-only: the caller's future
     #: tracing (obs/tracing.py): the request's root span, and the
     #: OPEN wire span of the current dispatch (a replay closes the old
     #: one and opens a fresh one — one wire span per hop). Warmup
@@ -155,7 +163,7 @@ class _Inflight:
 
 
 @guarded_by("_lock", "_parked", "_next_rid", "_total_inflight",
-            "_stopped")
+            "_stopped", "_shed_watermark")
 class FleetServer:
     """N supervised workers behind one ``submit()``. See the module
     docstring for the layer map."""
@@ -229,6 +237,10 @@ class FleetServer:
         self._cold: set = set()
         #: slot -> outstanding warmup rids
         self._warming: Dict[int, set] = {}
+        #: control-plane override of HIGH_WATERMARK (pre-emptive
+        #: shedding under sustained SLO burn — docs/CONTROL.md); None
+        #: means the static default
+        self._shed_watermark: Optional[float] = None
         self._stopped = False
         self.replays = 0
 
@@ -258,6 +270,11 @@ class FleetServer:
             self._parked.clear()
         for rec in leftovers:
             _end_wire(rec, outcome="shutdown")
+            if rec.probe:
+                rec.fut.set_exception(Rejected(
+                    "shutdown", "fleet stopping",
+                    content_hash=rec.key))
+                continue
             self.flight.fail(rec.key, Rejected(
                 "shutdown", "fleet stopping", content_hash=rec.key))
             self._count("rejected_shutdown")
@@ -348,6 +365,34 @@ class FleetServer:
         return self.submit(req, tenant=tenant, timeout=timeout).result(
             None if wait is None else wait + 60)
 
+    def probe(self, slot: int, req: SolveRequest,
+              timeout: Optional[float] = None) -> Future:
+        """Targeted dispatch to ONE worker — the control plane's
+        parity/latency probe (docs/CONTROL.md). Bypasses the shared
+        cache, single-flight, quotas and the breaker on purpose: a
+        probe exists to measure THAT worker's answer and latency, and
+        a cache hit or a coalesce onto another worker's launch would
+        measure nothing. The future resolves to the worker's own
+        ``SolveResult`` or fails with a structured ``Rejected``; a
+        probe is never replayed to a survivor (an answer from a
+        different worker proves nothing about the probed one) and
+        never enters the hot-signature warmup set."""
+        t0 = time.monotonic()
+        timeout = self.default_timeout if timeout is None else timeout
+        fut: Future = Future()
+        try:
+            req.validate()
+        except Rejected as e:
+            fut.set_exception(e)
+            return fut
+        rec = _Inflight(
+            key=req.content_hash(), sig=str(req.signature()),
+            tenant="_control", req_dict=req.spec(), t0=t0,
+            deadline=None if timeout is None else t0 + timeout,
+            slot=slot, probe=True, fut=fut)
+        self._dispatch(rec)
+        return fut
+
     # -- admission ----------------------------------------------------- #
 
     def _policy(self, tenant: str) -> TenantPolicy:
@@ -356,8 +401,11 @@ class FleetServer:
     def _admit(self, tenant: str, key: str) -> Optional[Rejected]:
         """Reserve capacity for a fresh leader, or explain why not."""
         pol = self._policy(tenant)
-        watermark = int(math.ceil(HIGH_WATERMARK * self.max_inflight))
         with self._lock:
+            shed = self._shed_watermark
+            watermark = int(math.ceil(
+                (HIGH_WATERMARK if shed is None else shed)
+                * self.max_inflight))
             mine = self._tenant_inflight.get(tenant, 0)
             if mine >= pol.max_inflight:
                 if self.registry is not None:
@@ -378,8 +426,11 @@ class FleetServer:
                     f"{self.max_inflight}"
                     + ("" if pol.priority == 0
                        else f"; standard-priority watermark "
-                            f"{watermark}") + ")",
-                    tenant=tenant, content_hash=key)
+                            f"{watermark}"
+                            + (" (pre-emptive shed)"
+                               if shed is not None else "")) + ")",
+                    tenant=tenant, content_hash=key,
+                    preemptive_shed=shed is not None)
             if not self.breaker.allow():
                 self._count("rejected_degraded")
                 return Rejected(
@@ -391,6 +442,23 @@ class FleetServer:
             self._total_inflight += 1
             self._gauge_inflight_locked()
         return None
+
+    def set_preemptive_shed(self, watermark: Optional[float]) -> None:
+        """Control-plane actuator (docs/CONTROL.md): temporarily lower
+        the standard-priority admission watermark below
+        ``HIGH_WATERMARK`` — sustained SLO burn sheds low-priority
+        tenants BEFORE the breaker trips. ``None`` restores the
+        default. Priority-0 tenants, cache hits and coalesced
+        followers are untouched: they never consult the watermark."""
+        if watermark is not None and not (0 <= watermark <= 1):
+            raise ValueError(
+                f"watermark must be in [0, 1], got {watermark}")
+        with self._lock:
+            self._shed_watermark = watermark
+        if self.registry is not None:
+            self.registry.gauge(
+                "fleet_shed_watermark",
+                HIGH_WATERMARK if watermark is None else watermark)
 
     def _release(self, tenant: str, t0: float) -> None:
         with self._lock:
@@ -418,13 +486,21 @@ class FleetServer:
         tried = set()
         while True:
             alive = set(self.sup.alive_slots())
-            pool = ([rec.slot] if rec.warmup
+            pool = ([rec.slot] if rec.warmup or rec.probe
                     else [s for s in self._routable()
                           if s not in tried])
             pool = [s for s in pool if s in alive]
             if not pool:
                 if rec.warmup:
                     return      # its worker died; nothing to warm
+                if rec.probe:
+                    # a probe never parks or retargets: its whole point
+                    # is THAT worker, and that worker is gone
+                    rec.fut.set_exception(Rejected(
+                        "worker_lost",
+                        f"probe target slot {rec.slot} is not alive",
+                        content_hash=rec.key))
+                    return
                 with self._lock:
                     stopped = self._stopped
                     if not stopped:
@@ -449,14 +525,16 @@ class FleetServer:
                 rid = self._next_rid
                 rec.rid, rec.slot = rid, slot
                 self._records[rid] = rec
-                if not rec.warmup:
+                if rec.warmup:
+                    self._warming.setdefault(slot, set()).add(rid)
+                elif not rec.probe:
                     # hot-signature set: recency-ordered, bounded
+                    # (probes are control traffic, not client demand —
+                    # they must not shape the warmup set)
                     self._hot.pop(rec.sig, None)
                     self._hot[rec.sig] = rec.req_dict
                     while len(self._hot) > MAX_HOT_SIGNATURES:
                         self._hot.pop(next(iter(self._hot)))
-                else:
-                    self._warming.setdefault(slot, set()).add(rid)
             msg = {"id": rid, "req": rec.req_dict}
             if rec.warmup:
                 msg["event"] = "warmup"
@@ -479,6 +557,13 @@ class FleetServer:
                         self._warming.get(slot, set()).discard(rid)
                 if rec.warmup:
                     return
+                if rec.probe:
+                    if owned:
+                        rec.fut.set_exception(Rejected(
+                            "worker_lost",
+                            f"probe target slot {rec.slot} died at "
+                            f"send", content_hash=rec.key))
+                    return
                 if not owned:
                     # a concurrent _on_worker_lost sweep already popped
                     # this rid and owns the replay — retrying here
@@ -496,6 +581,20 @@ class FleetServer:
             #             can never attach spans to a replay's trace
         if rec.warmup:
             self._warmup_done(rec)
+            return
+        if rec.probe:
+            # a probe's answer goes straight to its caller: no cache
+            # write, no single-flight, no per-signature SLO counters —
+            # control traffic must not dress up as client outcomes
+            if msg.get("ok"):
+                try:
+                    rec.fut.set_result(wire.decode_result(msg))
+                except (KeyError, ValueError) as e:
+                    rec.fut.set_exception(Rejected(
+                        "error", f"undecodable probe response: {e!r}",
+                        content_hash=rec.key))
+            else:
+                rec.fut.set_exception(wire.decode_rejection(msg))
             return
         _end_wire(rec, outcome="ok" if msg.get("ok") else "rejected")
         if msg.get("ok"):
@@ -542,6 +641,14 @@ class FleetServer:
             self._cold.discard(slot)
             lost = [r for r in lost if not r.warmup]
         self.breaker.record_failure()
+        probes = [r for r in lost if r.probe]
+        lost = [r for r in lost if not r.probe]
+        for rec in probes:
+            # never replayed: an answer from a survivor would prove
+            # nothing about the worker the probe was aimed at
+            rec.fut.set_exception(Rejected(
+                "worker_lost", "probed worker died mid-probe",
+                content_hash=rec.key))
         if not lost:
             return
         log.warning("worker %d died with %d request(s) in flight; "
@@ -648,6 +755,12 @@ class FleetServer:
             if rec.warmup:
                 # an overdue warmup must not wedge the slot cold
                 self._warmup_done(rec)
+                continue
+            if rec.probe:
+                rec.fut.set_exception(Rejected(
+                    "timeout", "probe exceeded its deadline",
+                    content_hash=rec.key,
+                    waited_s=round(now - rec.t0, 6)))
                 continue
             _end_wire(rec, outcome="timeout")
             self.flight.fail(rec.key, Rejected(

@@ -71,13 +71,17 @@ class WorkerHandle:
         self.ready = False
         self.dead = False
         self.restarted = False      # a replacement, not a first spawn
+        self.via = "start"          # start | restart | rollout
+        self.overlay = None         # one-generation env overlay, if any
+        self.info = None            # the worker's ready line (tune
+        #                             stamp etc.), once it reports
         self.write_lock = AuditedLock(f"fleet.worker{slot}.pipe")
 
     def pid(self) -> int:
         return self.proc.pid
 
 
-@guarded_by("_lock", "_handles")
+@guarded_by("_lock", "_handles", "_generations")
 class Supervisor:
     """Spawn/watch/restart N fleet workers. See the module docstring
     for the failure model; the router wires the three callbacks."""
@@ -127,6 +131,14 @@ class Supervisor:
         self._monitor: Optional[threading.Thread] = None
         self.restarts = 0
         self.deaths = 0
+        #: one row per worker GENERATION that reported ready: slot,
+        #: pid, how it was spawned (start | restart | rollout), any
+        #: one-generation env overlay it ran under, and the tune-db
+        #: stamp it reported — the audit trail the control plane's
+        #: no-unvalidated-serving invariant is asserted on
+        #: (docs/CONTROL.md). Appended under ``_lock``; read through
+        #: ``generations_snapshot()``.
+        self._generations: List[dict] = []
 
     # -- lifecycle ----------------------------------------------------- #
 
@@ -212,6 +224,96 @@ class Supervisor:
                         slot, h.pid())
             h.proc.kill()
 
+    # -- the control plane's surface (docs/CONTROL.md) ------------------ #
+
+    def worker_info(self, slot: int) -> Optional[dict]:
+        """The CURRENT worker's ready line (pid, protocol, tune-db
+        stamp), or None while the slot has no ready worker."""
+        with self._lock:
+            h = self._handles[slot]
+        if h is None or h.dead or not h.ready:
+            return None
+        return dict(h.info or {})
+
+    def generations_snapshot(self) -> List[dict]:
+        """Every worker generation that reported ready, in order — the
+        control plane's audit trail for the no-unvalidated-serving
+        invariant."""
+        with self._lock:
+            return [dict(g) for g in self._generations]
+
+    def update_slot_env(self, slot: int, env: dict) -> None:
+        """DURABLY merge ``env`` into one slot's per-worker env: every
+        future spawn of the slot (crash restarts included) carries it.
+        Contrast ``restart_worker``'s overlay, which lives for exactly
+        one generation."""
+        with self._lock:
+            self.per_worker_env.setdefault(int(slot), {}).update(env)
+
+    def restart_worker(self, slot: int,
+                       env_overlay: Optional[dict] = None,
+                       timeout: float = 30.0) -> None:
+        """Deliberate in-place restart of one slot — the control
+        plane's rollout actuator. The old worker drains (shutdown
+        line, then escalating kill); the replacement spawns with
+        ``env_overlay`` applied on top of the durable env **for this
+        generation only**: any LATER restart of the slot — including
+        a crash restart mid-kill-storm — rebuilds the env from the
+        durable config alone, so an overlay (candidate) config can
+        never be resurrected by the failure path. Blocks until the
+        old process exited and the replacement was spawned (not until
+        it is ready — poll ``worker_info``/``alive_slots``)."""
+        with self._lock:
+            h = self._handles[slot]
+            self._restart_at[slot] = None
+            if h is not None:
+                # hand the slot from the monitor to us: no death path,
+                # no competing backoff restart
+                h.dead = True
+        unclean = False
+        if h is not None:
+            try:
+                self._write(h, {"event": "shutdown"})
+            except WorkerGone:
+                pass
+            try:
+                h.proc.wait(timeout=timeout)
+            except subprocess.TimeoutExpired:
+                log.warning("worker %d did not drain for a deliberate "
+                            "restart; killing", slot)
+                h.proc.kill()
+                try:
+                    h.proc.wait(timeout=10)
+                except subprocess.TimeoutExpired:
+                    pass
+            unclean = h.proc.returncode != 0
+            if unclean:
+                self._close_pipes(h)
+            else:
+                # clean drain: every answer was emitted before exit,
+                # but the reader thread may still be pulling buffered
+                # lines — close only our write end and let the reader
+                # run to EOF (closing stdout under it drops answers)
+                try:
+                    if h.proc.stdin is not None:
+                        h.proc.stdin.close()
+                except OSError:
+                    pass
+        if unclean and self.on_worker_lost is not None:
+            # exit != 0 covers both the forced kill above AND a worker
+            # that was already dead/crashed when the restart began
+            # (h.dead=True fenced the monitor's death path out): the
+            # router must get the same worker-lost sweep the crash
+            # path runs, or its in-flight records for this slot sit
+            # until their deadline instead of replaying
+            self.on_worker_lost(slot)
+        self.restarts += 1
+        if self.registry is not None:
+            self.registry.counter("fleet_worker_restarts_total")
+        log.info("deliberate restart of worker %d%s", slot,
+                 " (env overlay)" if env_overlay else "")
+        self._spawn(slot, overlay=env_overlay, via="rollout")
+
     # -- spawn / death / restart --------------------------------------- #
 
     def _worker_cmd(self, slot: int) -> List[str]:
@@ -234,17 +336,26 @@ class Supervisor:
         env.update(self.per_worker_env.get(slot, {}))
         return env
 
-    def _spawn(self, slot: int) -> None:
+    def _spawn(self, slot: int, overlay: Optional[dict] = None,
+               via: Optional[str] = None) -> None:
+        env = self._worker_env(slot)
+        if overlay:
+            # ONE-generation overlay (restart_worker): applied to this
+            # spawn only — never persisted, so a later crash restart
+            # rebuilds from the durable env alone
+            env.update(overlay)
         proc = subprocess.Popen(
             self._worker_cmd(slot), stdin=subprocess.PIPE,
             stdout=subprocess.PIPE, stderr=None,  # stderr passes through
-            env=self._worker_env(slot), text=True, bufsize=1)
+            env=env, text=True, bufsize=1)
         h = WorkerHandle(slot, proc)
+        h.overlay = dict(overlay) if overlay else None
         with self._lock:
             self._handles[slot] = h
             self._restart_at[slot] = None
             self._spawn_counts[slot] += 1
             h.restarted = self._spawn_counts[slot] > 1
+            h.via = via or ("restart" if h.restarted else "start")
         threading.Thread(target=self._read_loop, args=(h,),
                          name=f"heat2d-fleet-reader-{slot}",
                          daemon=True).start()
@@ -263,9 +374,15 @@ class Supervisor:
                     continue        # torn line from a killed worker
                 ev = msg.get("event")
                 if ev == "ready":
+                    h.info = msg
                     h.ready = True
                     with self._lock:
                         self._attempts[h.slot] = 0
+                        self._generations.append({
+                            "slot": h.slot, "pid": h.pid(),
+                            "via": h.via, "restarted": h.restarted,
+                            "overlay": h.overlay,
+                            "tune": msg.get("tune")})
                     self._gauge_alive()
                     log.info("worker %d ready (pid %d%s)", h.slot,
                              h.pid(),

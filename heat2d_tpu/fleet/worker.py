@@ -121,8 +121,24 @@ def main(argv=None) -> int:
     threading.Thread(target=hb_loop, name="heat2d-fleet-hb",
                      daemon=True).start()
     warm_threads: list = []
-    emit({"event": "ready", "pid": os.getpid(),
-          "worker": args.worker_id, "protocol": wire.PROTOCOL})
+    ready = {"event": "ready", "pid": os.getpid(),
+             "worker": args.worker_id, "protocol": wire.PROTOCOL}
+    # The tuning-db stamp this worker is serving under (HEAT2D_TUNE_DB
+    # arrives through the supervisor's env): path + epoch + validated.
+    # The control plane's rollout gate reads it off the ready line to
+    # prove which config GENERATION every worker runs — a crash
+    # restart mid-rollout must always report the validated incumbent,
+    # never a candidate (docs/CONTROL.md).
+    try:
+        from heat2d_tpu.tune import runtime as tune_runtime
+        info = tune_runtime.describe_active()
+        if info is not None:
+            ready["tune"] = info
+    except Exception as e:  # noqa: BLE001 — a broken db must not keep
+        #                     the worker from serving (it degrades to
+        #                     the heuristic anyway)
+        log.warning("tune-db stamp unavailable: %r", e)
+    emit(ready)
 
     for line in sys.stdin:
         line = line.strip()

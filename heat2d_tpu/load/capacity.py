@@ -93,3 +93,25 @@ def sustainable_at(fit: dict, units: int) -> float:
     """The model's predicted sustainable req/s at ``units`` serving
     units (linear extrapolation from the fitted per-unit rate)."""
     return round(fit.get("per_unit_rps", 0.0) * units, 4)
+
+
+def advise(fit: dict, observed_rps: float, current_units: int) -> dict:
+    """Capacity advice for the control plane (docs/CONTROL.md): under
+    sustained SLO burn, how many serving units the fitted model says
+    the OBSERVED rate needs vs what is deployed. Pure arithmetic — the
+    plane records the advice; acting on it (adding chips/workers) is
+    an operator/orchestrator decision, deliberately outside the loop
+    this repo automates. ``needed_units`` is None when the model never
+    saw a sustainable point; an unsaturated fit makes the advice
+    conservative (the fit is a lower bound)."""
+    need = units_for(fit, observed_rps)
+    return {
+        "model": fit.get("model"),
+        "observed_rps": round(float(observed_rps), 4),
+        "current_units": int(current_units),
+        "needed_units": need,
+        "add_units": (None if need is None
+                      else max(0, need - int(current_units))),
+        "fit_saturated": bool(fit.get("saturated", True)),
+        "sustainable_at_current": sustainable_at(fit, current_units),
+    }

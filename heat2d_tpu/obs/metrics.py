@@ -113,6 +113,30 @@ class Reservoir:
         return self.count <= self.cap
 
 
+class CounterDeltas:
+    """Differentiate cumulative counters between calls — the ONE
+    windowing primitive behind ``obs.slo.BurnWindow``, the control
+    plane's observed-rate estimate, and the retuner's demand signal
+    (each previously hand-rolled the same snapshot-and-subtract).
+    ``tick(registry, name)`` returns {label-pairs tuple: delta since
+    the previous tick} per series; the first tick sees the full
+    cumulative value. Counters are monotonic, so a negative delta
+    means the registry was swapped — that series resets to its new
+    total rather than reporting nonsense."""
+
+    def __init__(self):
+        self._last: dict = {}
+
+    def tick(self, registry, name: str) -> dict:
+        out = {}
+        for k, v in registry.find_counters(name).items():
+            key = (name, k)
+            d = v - self._last.get(key, 0.0)
+            self._last[key] = v
+            out[k] = d if d >= 0 else v
+        return out
+
+
 class MetricsRegistry:
     """Counters, gauges, timing histograms and labeled series.
 

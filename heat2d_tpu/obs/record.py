@@ -42,9 +42,13 @@ RECORD_SCHEMA = "heat2d-tpu/run-record/v1"
 #: latency quantiles, shed rate, replay-fidelity skew, per-signature
 #: SLO rows — plus the fitted capacity model (max sustainable req/s,
 #: per-unit rate, units-for-N sizing) and the gate verdict against
-#: the committed baseline — heat2d_tpu/load/, docs/LOADGEN.md).
+#: the committed baseline — heat2d_tpu/load/, docs/LOADGEN.md),
+#: "control" (the fleet control plane: decision log, rollout outcomes
+#: with parity/revert verdicts, worker config generations and the
+#: no-unvalidated-serving invariant, staged retune candidates —
+#: heat2d_tpu/control/, docs/CONTROL.md).
 RECORD_KINDS = ("run", "ensemble", "bench", "sweep", "serve", "tune",
-                "fleet", "inverse", "multichip", "load")
+                "fleet", "inverse", "multichip", "load", "control")
 
 
 def run_context() -> dict:
@@ -98,13 +102,19 @@ def build_record(kind: str, config=None, steps_done=None, elapsed_s=None,
     return attach_context(rec, kind)
 
 
-def write_run_jsonl(registry, path: str, kind: str, extra: dict) -> None:
+def write_run_jsonl(registry, path: str, kind: str, extra: dict,
+                    more=()) -> None:
     """The one-line telemetry export shared by the CLIs: the
     registry's events + snapshot plus a ``kind`` run record carrying
-    ``extra`` as its payload. No-op without a registry or path."""
+    ``extra`` as its payload. ``more`` appends additional (kind,
+    extra) record pairs to the same JSONL — e.g. the fleet CLI's
+    ``kind="control"`` record riding beside its ``kind="fleet"`` one.
+    No-op without a registry or path."""
     if registry is None or not path:
         return
-    record = build_record(kind, extra=dict(extra))
-    registry.write_jsonl(path,
-                         extra_records=[{"event": "run_record",
-                                         **record}])
+    records = [{"event": "run_record",
+                **build_record(kind, extra=dict(extra))}]
+    for k2, e2 in more:
+        records.append({"event": "run_record",
+                        **build_record(k2, extra=dict(e2))})
+    registry.write_jsonl(path, extra_records=records)

@@ -87,7 +87,18 @@ def evaluate(registry, *, prefix: str = "serve",
             "p50_s": summary["p50"] if summary else None,
             "p99_s": summary["p99"] if summary else None,
         }
-        if pol is not None:
+        if pol is not None and total == 0:
+            # Zero traffic: there is nothing to judge. A burn rate of
+            # 0/0 is not "healthy", it is ABSENT — no slo_burn_rate
+            # gauge, and no ok verdict AT ALL: consumers uniformly do
+            # ``row.get("ok", True)`` (serve CLI violation print, the
+            # load gate's slo_ok), so the verdict key must be MISSING,
+            # not None — a None would read as a violation and fail a
+            # gate over a route nobody called. The row still reports
+            # the objective so the signature's silence is visible.
+            row.update(latency_target_p99_s=pol.latency_p99_s,
+                       error_budget=pol.error_budget)
+        elif pol is not None:
             burn = row["error_rate"] / pol.error_budget
             latency_ok = (summary is None
                           or summary["p99"] <= pol.latency_p99_s)
@@ -119,3 +130,85 @@ def stamp_record(extra: dict, rows: list) -> dict:
     (returns it) — the ``slo`` schema row in docs/OBSERVABILITY.md."""
     extra["slo"] = rows
     return extra
+
+
+class BurnWindow:
+    """Windowed, SUSTAINED burn-rate detection — the control plane's
+    trigger (heat2d_tpu/control/, docs/CONTROL.md).
+
+    ``evaluate`` above is cumulative: ten minutes of clean serving
+    dilute a current outage below any threshold. The control plane
+    needs the opposite — the burn rate *right now*, held long enough
+    to act on. ``tick(registry)`` differentiates the per-signature
+    outcome counters since the previous tick (one tick == one window),
+    computes each signature's windowed ``error_rate / error_budget``,
+    and tracks a consecutive-window streak per signature: a signature
+    is **sustained** once its burn exceeded ``threshold`` for
+    ``sustain`` ticks in a row. One clean window resets the streak; a
+    ZERO-TRAFFIC window is no evidence either way — it neither grows
+    nor resets the streak (and, like ``evaluate``, contributes no
+    burn gauge).
+
+    Windowed burns are exported as ``slo_windowed_burn_rate``
+    gauges beside the cumulative ``slo_burn_rate`` family."""
+
+    def __init__(self, policy: SLOPolicy, *, prefix: str = "fleet",
+                 threshold: float = 1.0, sustain: int = 2):
+        if sustain < 1:
+            raise ValueError(f"sustain must be >= 1, got {sustain}")
+        if threshold <= 0:
+            raise ValueError(
+                f"threshold must be > 0, got {threshold}")
+        from heat2d_tpu.obs.metrics import CounterDeltas
+        self.policy = policy
+        self.prefix = prefix
+        self.threshold = threshold
+        self.sustain = sustain
+        self._deltas = CounterDeltas()
+        self._streak: Dict[str, int] = {}
+
+    def tick(self, registry) -> Dict[str, dict]:
+        """One window: {signature: {requests, failures, burn_rate,
+        windows, sustained}}. ``burn_rate`` is None on a zero-traffic
+        window; a registry-less caller gets an empty window, not a
+        crash (FleetServer(registry=None) is a supported shape)."""
+        if registry is None:
+            return {}
+        totals: Dict[str, list] = {}
+        for k, d in self._deltas.tick(
+                registry,
+                self.prefix + "_signature_requests_total").items():
+            kd = dict(k)
+            sig = kd.get("signature")
+            if sig is None:
+                continue
+            t = totals.setdefault(sig, [0.0, 0.0])
+            t[0] += d
+            if kd.get("outcome") not in FAILURE_OUTCOMES_EXCLUDED:
+                t[1] += d
+        out: Dict[str, dict] = {}
+        for sig, (dt, df) in sorted(totals.items()):
+            if dt <= 0:
+                streak = self._streak.get(sig, 0)
+                out[sig] = {"requests": 0.0, "failures": 0.0,
+                            "burn_rate": None, "windows": streak,
+                            "sustained": streak >= self.sustain}
+                continue
+            burn = (df / dt) / self.policy.error_budget
+            streak = (self._streak.get(sig, 0) + 1
+                      if burn > self.threshold else 0)
+            self._streak[sig] = streak
+            registry.gauge("slo_windowed_burn_rate", burn,
+                           signature=sig)
+            out[sig] = {"requests": dt, "failures": df,
+                        "burn_rate": burn, "windows": streak,
+                        "sustained": streak >= self.sustain}
+        return out
+
+    def sustained(self, result: Optional[Dict[str, dict]] = None) -> list:
+        """Signatures currently over their sustain threshold. Pass a
+        ``tick`` result to avoid consuming a fresh window."""
+        if result is not None:
+            return sorted(s for s, r in result.items() if r["sustained"])
+        return sorted(s for s, n in self._streak.items()
+                      if n >= self.sustain)

@@ -28,6 +28,14 @@ failure path executes. This module injects those failures on purpose:
 - **slow worker** — ``HEAT2D_CHAOS_SLOW_WORKER_S`` sleeps inside each
   request pickup (drives latency-blip and routing-under-straggler
   tests).
+- **kill storm mid-rollout** — ``HEAT2D_CHAOS_ROLLOUT_KILL_PHASE``
+  names a control-plane rollout window (``canary`` | ``parity`` |
+  ``observe`` | ``promote``); when the rollout reaches it, the hook
+  fires the caller-supplied kill callback ONCE against
+  ``HEAT2D_CHAOS_ROLLOUT_KILLS`` workers (0 = every alive worker —
+  the full storm). This is how the control gate proves a tuning
+  rollout interrupted at its worst moment never leaves a worker
+  serving a non-validated config (docs/CONTROL.md).
 
 Config comes from the environment (so CI can chaos a whole CLI
 subprocess without code changes) or programmatically via ``install()``
@@ -51,6 +59,10 @@ _ENV_PREFIX = "HEAT2D_CHAOS_"
 
 #: phases of a checkpoint commit where a kill can be injected
 CKPT_PHASES = ("mid_write", "pre_meta")
+
+#: control-plane rollout windows where a kill storm can be injected
+#: (heat2d_tpu/control/rollout.py announces each via rollout_point)
+ROLLOUT_PHASES = ("canary", "parity", "observe", "promote")
 
 
 class ChaosError(RuntimeError):
@@ -84,12 +96,19 @@ class ChaosConfig:
     worker_kill_after: Optional[int] = None  # 1-based request ordinal
     heartbeat_drop_after: Optional[int] = None  # beats after N dropped
     slow_worker_s: float = 0.0
+    rollout_kill_phase: Optional[str] = None  # rollout window to storm
+    rollout_kills: int = 0                    # workers to kill (0=all)
 
     def __post_init__(self):
         if self.kill_ckpt_phase not in CKPT_PHASES:
             raise ValueError(
                 f"kill_ckpt_phase must be one of {CKPT_PHASES}, got "
                 f"{self.kill_ckpt_phase!r}")
+        if (self.rollout_kill_phase is not None
+                and self.rollout_kill_phase not in ROLLOUT_PHASES):
+            raise ValueError(
+                f"rollout_kill_phase must be one of {ROLLOUT_PHASES}, "
+                f"got {self.rollout_kill_phase!r}")
         # 0 ordinals can never fire (counters are 1-based): canonicalize
         # to disarmed so any_active()/from_env treat them as unset.
         for f in ("kill_ckpt_at", "worker_kill_after",
@@ -127,7 +146,9 @@ class ChaosConfig:
             ckpt_latency_s=get("CKPT_LATENCY_S", float, 0.0),
             worker_kill_after=get("WORKER_KILL_AFTER", int, None),
             heartbeat_drop_after=get("HEARTBEAT_DROP_AFTER", int, None),
-            slow_worker_s=get("SLOW_WORKER_S", float, 0.0))
+            slow_worker_s=get("SLOW_WORKER_S", float, 0.0),
+            rollout_kill_phase=get("ROLLOUT_KILL_PHASE", str, None),
+            rollout_kills=get("ROLLOUT_KILLS", int, 0))
         return cfg if cfg.any_active() else None
 
     def any_active(self) -> bool:
@@ -135,7 +156,8 @@ class ChaosConfig:
                     or self.launch_latency_s or self.ckpt_latency_s
                     or self.worker_kill_after is not None
                     or self.heartbeat_drop_after is not None
-                    or self.slow_worker_s)
+                    or self.slow_worker_s
+                    or self.rollout_kill_phase is not None)
 
 
 class _Controller:
@@ -152,6 +174,7 @@ class _Controller:
         self.launches_failed = 0
         self.worker_requests = 0     # fleet-worker request pickups
         self.heartbeats = 0          # heartbeats attempted
+        self.rollout_fired = False   # the storm fires exactly once
 
     def _count(self, point: str) -> None:
         if self.registry is not None:
@@ -216,6 +239,24 @@ class _Controller:
             self._count("worker_kill")
             _flight_flush("chaos_worker_kill")
             os._exit(137)
+
+    def rollout_point(self, phase: str, kill_cb=None) -> None:
+        """Called by the control plane's rollout as it enters each
+        window (``ROLLOUT_PHASES``). When the armed phase matches,
+        ``kill_cb(n)`` fires ONCE — the caller supplies the actual
+        worker-killing action (``n`` workers; 0 = all alive), keeping
+        this module free of any fleet/jax dependency. Runs in the
+        ROUTER process: the storm it triggers kills worker
+        subprocesses, never the control plane itself."""
+        cfg = self.config
+        if cfg.rollout_kill_phase != phase or kill_cb is None:
+            return
+        with self._lock:
+            if self.rollout_fired:
+                return
+            self.rollout_fired = True
+        self._count("rollout_kill")
+        kill_cb(cfg.rollout_kills)
 
     def heartbeat_point(self) -> bool:
         """True = send the heartbeat, False = drop it (the worker keeps
@@ -313,3 +354,14 @@ def heartbeat_point() -> bool:
     if c is None:
         return True
     return c.heartbeat_point()
+
+
+def rollout_point(phase: str, kill_cb=None) -> None:
+    """Called by the control plane's rollout at each window boundary;
+    an armed campaign fires ``kill_cb`` (the storm) once at its
+    phase."""
+    if not _enabled and _env_checked:
+        return
+    c = controller()
+    if c is not None:
+        c.rollout_point(phase, kill_cb)

@@ -249,6 +249,17 @@ def frontier_table(db: TuningDB, device_kind: str) -> str:
         if e is None:
             continue
         best = e.get("best") or {}
+        # rollout provenance (docs/CONTROL.md): entries staged by the
+        # control plane carry validated/epoch stamps — surfaced on the
+        # best row so the frontier shows what production actually
+        # proved vs what a search merely measured
+        vtag = ""
+        if "validated" in e or "epoch" in e:
+            # missing 'validated' defaults True (the incumbent
+            # back-compat rule every other consumer applies)
+            kind_tag = ("validated" if e.get("validated", True)
+                        else "candidate")
+            vtag = f" [{kind_tag} e{int(e.get('epoch', 0))}]"
         pts = sorted(e.get("points", []),
                      key=lambda p: -(p.get("mcells_per_s") or 0))
         for p in pts:
@@ -263,7 +274,8 @@ def frontier_table(db: TuningDB, device_kind: str) -> str:
                 f"{p.get('bm', 0):>4} {p.get('tsteps', 0):>3} "
                 f"{f'{st:.3e}' if st is not None else '—':>11} "
                 f"{f'{mc:.1f}' if mc is not None else '—':>10}  "
-                f"{p.get('status')}{'  <-- best' if is_best else ''}")
+                f"{p.get('status')}"
+                f"{'  <-- best' + vtag if is_best else ''}")
     return "\n".join(lines)
 
 
@@ -463,10 +475,14 @@ def run_merge(args, out=sys.stdout) -> int:
               f"(+{s['points_added']} points), "
               f"{s['entries_kept']} kept", file=out)
     merged.save()
-    n = sum(len(d.get("entries", {}))
-            for d in merged.data["devices"].values())
+    n = nv = 0
+    for d in merged.data["devices"].values():
+        for e in d.get("entries", {}).values():
+            n += 1
+            nv += bool(e.get("validated"))
     print(f"# wrote {args.out}: {n} entries across "
-          f"{len(merged.data['devices'])} device kinds", file=out)
+          f"{len(merged.data['devices'])} device kinds"
+          f" ({nv} validated)", file=out)
     return rc
 
 

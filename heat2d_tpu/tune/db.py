@@ -28,6 +28,14 @@ Rules the lookup/write paths enforce:
   (FLAGGED as ``source="nearest"`` with the matched key; callers
   re-validate it against the resource model) -> ``None`` (callers keep
   the static heuristic — no behavior cliff when the db is absent).
+- **Rollout provenance** (the control plane, docs/CONTROL.md): a db
+  staged as a rollout CANDIDATE carries a document-level
+  ``epoch``/``validated`` stamp (``stamp_rollout``) plus per-entry
+  twins (``mark_entries``). Workers report the stamp of the db they
+  loaded on their ready line, which is how the chaos gate proves no
+  crash-restarted worker ever rejoined on a non-validated config. A
+  db without the stamp is the incumbent — ``validated`` defaults to
+  True for every db that predates rollouts.
 """
 
 from __future__ import annotations
@@ -165,6 +173,43 @@ class TuningDB:
         ``vmem_total_bytes``) consumers may apply at load time."""
         self.device(device_kind).update(fields)
 
+    # -- rollout provenance (docs/CONTROL.md) --------------------------- #
+
+    @property
+    def epoch(self) -> int:
+        """The document-level rollout epoch (0 for a db that predates
+        rollouts)."""
+        return int(self.data.get("epoch", 0) or 0)
+
+    @property
+    def validated(self) -> bool:
+        """Whether this db is a VALIDATED rollout artifact. Defaults
+        True: every db that predates the control plane is the incumbent
+        — only a staged candidate is explicitly unvalidated."""
+        return bool(self.data.get("validated", True))
+
+    def stamp_rollout(self, *, epoch: int, validated: bool) -> None:
+        """Stamp the document-level rollout identity — the stamp a
+        fleet worker reports on its ready line (``runtime.
+        describe_active``), and the fact the chaos gate asserts on:
+        a candidate is ``validated=False`` until its canary survived
+        parity + observation; promotion restamps True."""
+        self.data["epoch"] = int(epoch)
+        self.data["validated"] = bool(validated)
+
+    def mark_entries(self, *, validated: bool, epoch: int) -> int:
+        """Stamp every entry's validation provenance (the per-entry
+        twin of ``stamp_rollout`` — it travels through ``merge``, where
+        a validated entry beats an unvalidated one at equal salt).
+        Returns the number of entries stamped."""
+        n = 0
+        for dev in self.data["devices"].values():
+            for e in dev.get("entries", {}).values():
+                e["validated"] = bool(validated)
+                e["epoch"] = int(epoch)
+                n += 1
+        return n
+
     # -- search bookkeeping -------------------------------------------- #
 
     def _entry_for_write(self, device_kind: str, problem_key: str) -> dict:
@@ -214,7 +259,12 @@ class TuningDB:
         - **Same salt**: points union (per ``(route, bm, tsteps)`` the
           better datum wins — an ``ok`` beats any failure, a faster
           ``ok`` beats a slower one) and the best/provenance restamp
-          from the merged frontier.
+          from the merged frontier. A side that is explicitly a
+          rollout CANDIDATE (``validated=False``) never wins the
+          best/provenance slots against a validated side — and an
+          unstamped entry counts as the validated incumbent —
+          chaos/parity-proven beats fast-but-unproven
+          (docs/CONTROL.md).
         - **Different salts**: one storage slot per problem key, so the
           CURRENT code version wins; between two stale salts the newer
           provenance timestamp wins (both describe dead code — keep the
@@ -318,7 +368,11 @@ def _better_point(p: dict, q: dict) -> bool:
 
 def _merge_entry(ours: dict, theirs: dict) -> int:
     """Union ``theirs``'s points into ``ours`` (same salt) and restamp
-    the best from the merged frontier. Returns points added."""
+    the best from the merged frontier — except that a VALIDATED entry's
+    best/provenance beat an unvalidated one's outright (a rollout
+    proved that config bitwise-compatible and SLO-clean in production;
+    a faster unvalidated point is a claim, not a proof). Returns
+    points added."""
     added = 0
     pts = ours.setdefault("points", [])
     have = {_point_key(p): i for i, p in enumerate(pts)}
@@ -330,6 +384,31 @@ def _merge_entry(ours: dict, theirs: dict) -> int:
             added += 1
         elif _better_point(p, pts[have[k]]):
             pts[have[k]] = copy.deepcopy(p)
+    # An UNSTAMPED entry defaults to validated — it is the pre-rollout
+    # incumbent (same back-compat rule as TuningDB.validated). Only an
+    # explicitly staged candidate (validated=False) loses the
+    # preference, so a merge can never let a candidate's faster claim
+    # displace an incumbent that predates rollout stamps.
+    ours_val = bool(ours.get("validated", True))
+    theirs_val = bool(theirs.get("validated", True))
+    if ours_val != theirs_val and (ours if ours_val
+                                   else theirs).get("best"):
+        if theirs_val:
+            for k in ("best", "mcells_per_s", "provenance"):
+                if k in theirs:
+                    ours[k] = copy.deepcopy(theirs[k])
+            # the winner's VALIDATION identity must travel too: an
+            # unstamped winner leaves the merged entry unstamped
+            # (implicitly validated) — keeping the loser's
+            # validated=False stamp would let a later candidate merge
+            # displace the proven best it just adopted
+            for k in ("validated", "epoch"):
+                if k in theirs:
+                    ours[k] = theirs[k]
+                else:
+                    ours.pop(k, None)
+        # ours validated: keep our best/provenance/stamps as they are
+        return added
     ok = [p for p in pts if p.get("status") == "ok"]
     if ok:
         b = max(ok, key=lambda p: p.get("mcells_per_s") or 0)
@@ -337,8 +416,12 @@ def _merge_entry(ours: dict, theirs: dict) -> int:
         ours["best"] = {"route": b["route"], "bm": b["bm"],
                         "tsteps": b["tsteps"]}
         ours["mcells_per_s"] = b.get("mcells_per_s")
-        # the winning measurement's provenance travels with it
+        # the winning measurement's provenance (and rollout stamps)
+        # travel with it
         if (_point_key(theirs.get("best") or {}) == best_key
                 and theirs.get("provenance")):
             ours["provenance"] = copy.deepcopy(theirs["provenance"])
+            for k in ("validated", "epoch"):
+                if k in theirs:
+                    ours[k] = theirs[k]
     return added

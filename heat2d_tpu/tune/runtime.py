@@ -80,6 +80,22 @@ def active_db() -> Optional[TuningDB]:
         return cached_db
 
 
+def describe_active() -> Optional[dict]:
+    """The active db's rollout identity, or None without a db — the
+    stamp a fleet worker reports on its ready line (fleet/worker.py)
+    so the control plane can prove which config generation every
+    worker is serving (docs/CONTROL.md). ``validated`` defaults True
+    for dbs that predate rollouts (they are the incumbent); only a
+    staged candidate is explicitly unvalidated."""
+    db = active_db()
+    if db is None:
+        return None
+    entries = sum(len(d.get("entries", {}))
+                  for d in db.data["devices"].values())
+    return {"path": db.path, "epoch": db.epoch,
+            "validated": db.validated, "entries": entries}
+
+
 def _apply_device_stamps(db: TuningDB) -> None:
     """Device-level stamps: a probed ``vmem_total_bytes`` becomes the
     planning budget (source \"db\") unless an explicit flag/env

@@ -1,0 +1,602 @@
+"""Control plane (heat2d_tpu/control/): SLO-driven decisions, safe
+tuning rollouts with auto-revert, and the chaos-proven
+no-unvalidated-serving invariant (ISSUE 12).
+
+Three tiers, mirroring test_fleet.py: unit tests over the new
+obs/slo.py windowed-burn API and tune-db rollout provenance; router
+logic (probe semantics, pre-emptive shedding) against the FAKE
+supervisor; and end-to-end rollouts over real worker subprocesses —
+healthy promote, deliberately-bad candidate auto-revert with bitwise
+post-revert parity, and a kill storm landing mid-rollout."""
+
+import time
+
+import numpy as np
+import pytest
+
+from heat2d_tpu.control import (ControlPlane, Retuner, Rollout,
+                                RolloutConfig, problem_from_signature)
+from heat2d_tpu.fleet.router import FleetServer, TenantPolicy
+from heat2d_tpu.obs import slo
+from heat2d_tpu.obs.metrics import MetricsRegistry
+from heat2d_tpu.resil import chaos
+from heat2d_tpu.serve.schema import Rejected, SolveRequest
+from heat2d_tpu.tune.db import TuningDB
+
+import tests.test_fleet as tf
+
+
+def _policy(budget=0.01):
+    return slo.SLOPolicy(latency_p99_s=1.0, error_budget=budget)
+
+
+def _traffic(reg, n_ok=0, n_fail=0, sig="sigA"):
+    if n_ok:
+        reg.counter("fleet_signature_requests_total", value=n_ok,
+                    signature=sig, outcome="completed")
+    if n_fail:
+        reg.counter("fleet_signature_requests_total", value=n_fail,
+                    signature=sig, outcome="rejected_timeout")
+
+
+# --------------------------------------------------------------------- #
+# obs/slo.py satellites: zero-traffic + the windowed burn API
+# --------------------------------------------------------------------- #
+
+def test_slo_evaluate_zero_traffic_emits_no_burn_gauge_or_verdict():
+    """A signature with latency samples but zero requests must not
+    read as a met objective OR a violation: no slo_burn_rate gauge,
+    and the verdict key is MISSING (every consumer does
+    row.get("ok", True) — a None would read as a violation)."""
+    reg = MetricsRegistry()
+    reg.observe("fleet_signature_latency_s", 0.1, signature="dead")
+    (row,) = slo.evaluate(reg, prefix="fleet", default=_policy())
+    assert row["requests"] == 0
+    assert "ok" not in row and "burn_rate" not in row
+    assert row["latency_target_p99_s"] == 1.0
+    assert row.get("ok", True) is True      # the consumers' idiom
+    assert reg.snapshot()["gauges"] == {}
+
+
+def test_burn_window_sustained_detection_and_reset():
+    reg = MetricsRegistry()
+    bw = slo.BurnWindow(_policy(), threshold=1.0, sustain=2)
+    _traffic(reg, n_ok=100)
+    res = bw.tick(reg)
+    assert res["sigA"]["burn_rate"] == 0.0
+    assert not bw.sustained(res)
+    # two consecutive burning windows -> sustained
+    _traffic(reg, n_fail=10)
+    res = bw.tick(reg)
+    assert res["sigA"]["burn_rate"] == pytest.approx(100.0)
+    assert res["sigA"]["windows"] == 1 and not res["sigA"]["sustained"]
+    _traffic(reg, n_fail=10)
+    res = bw.tick(reg)
+    assert res["sigA"]["sustained"] and bw.sustained(res) == ["sigA"]
+    g = reg.snapshot()["gauges"]
+    assert g["slo_windowed_burn_rate{signature=sigA}"] == \
+        pytest.approx(100.0)
+    # one clean window resets the streak
+    _traffic(reg, n_ok=100)
+    res = bw.tick(reg)
+    assert res["sigA"]["windows"] == 0 and not res["sigA"]["sustained"]
+
+
+def test_burn_window_zero_traffic_holds_streak_without_gauge():
+    reg = MetricsRegistry()
+    bw = slo.BurnWindow(_policy(), threshold=1.0, sustain=1)
+    _traffic(reg, n_fail=5)
+    assert bw.tick(reg)["sigA"]["sustained"]
+    # idle window: the streak neither grows nor resets, burn is absent
+    res = bw.tick(reg)
+    assert res["sigA"]["burn_rate"] is None
+    assert res["sigA"]["sustained"]
+    # a registry-less caller gets an empty window, never a crash
+    assert bw.tick(None) == {}
+    with pytest.raises(ValueError):
+        slo.BurnWindow(_policy(), sustain=0)
+    with pytest.raises(ValueError):
+        slo.BurnWindow(_policy(), threshold=0)
+
+
+def test_counter_deltas_windows_and_registry_swap():
+    from heat2d_tpu.obs.metrics import CounterDeltas
+    reg = MetricsRegistry()
+    cd = CounterDeltas()
+    reg.counter("fleet_requests_total", value=5, outcome="completed")
+    (d,) = cd.tick(reg, "fleet_requests_total").values()
+    assert d == 5.0                       # first tick: the full total
+    assert list(cd.tick(reg, "fleet_requests_total").values()) == [0.0]
+    reg.counter("fleet_requests_total", value=3, outcome="completed")
+    assert list(cd.tick(reg, "fleet_requests_total").values()) == [3.0]
+    # a swapped (fresh) registry resets the series to its new total
+    reg2 = MetricsRegistry()
+    reg2.counter("fleet_requests_total", value=2, outcome="completed")
+    assert list(cd.tick(reg2, "fleet_requests_total").values()) == [2.0]
+
+
+# --------------------------------------------------------------------- #
+# router probe semantics (fake supervisor)
+# --------------------------------------------------------------------- #
+
+def test_probe_targets_slot_and_bypasses_cache():
+    fs = tf.make_router()
+    r = tf.req(cx=0.33)
+    f = fs.submit(r)
+    slot, msg = fs.sup.sent[-1]
+    tf.answer(fs, slot, msg)
+    f.result(timeout=5)
+    assert fs.submit(r).result(timeout=5).cache_hit
+    n = len(fs.sup.sent)
+    other = 1 - slot
+    pf = fs.probe(other, r)
+    assert len(fs.sup.sent) == n + 1       # a real dispatch, no cache
+    pslot, pmsg = fs.sup.sent[-1]
+    assert pslot == other                  # pinned to the target slot
+    assert "event" not in pmsg             # served as a normal request
+    tf.answer(fs, pslot, pmsg)
+    res = pf.result(timeout=5)
+    assert not res.cache_hit and not res.coalesced
+    # probes never enter the hot-signature warmup set
+    assert str(r.signature()) in fs._hot   # from the ORIGINAL submit
+    probe_only = SolveRequest(nx=tf.NX, ny=tf.NY, steps=tf.STEPS + 7,
+                              cx=0.9, cy=0.1, method="jnp")
+    fs.probe(other, probe_only)
+    assert str(probe_only.signature()) not in fs._hot
+
+
+def test_probe_fails_fast_without_replay():
+    fs = tf.make_router()
+    f = fs.probe(0, tf.req(cx=0.41))
+    n = len(fs.sup.sent)
+    fs.sup.alive = [1]
+    fs._on_worker_lost(0)
+    with pytest.raises(Rejected) as e:
+        f.result(timeout=5)
+    assert e.value.code == "worker_lost"
+    assert len(fs.sup.sent) == n           # never replayed elsewhere
+    # a probe aimed at a dead slot fails immediately
+    with pytest.raises(Rejected) as e:
+        fs.probe(0, tf.req(cx=0.42)).result(timeout=5)
+    assert e.value.code == "worker_lost"
+
+
+def test_probe_deadline_expires():
+    fs = tf.make_router(default_timeout=0.01)
+    f = fs.probe(0, tf.req(cx=0.43))
+    time.sleep(0.05)
+    fs._expire_overdue()
+    with pytest.raises(Rejected) as e:
+        f.result(timeout=5)
+    assert e.value.code == "timeout"
+
+
+# --------------------------------------------------------------------- #
+# pre-emptive shedding (extends the PR 5 quota/watermark suite)
+# --------------------------------------------------------------------- #
+
+def test_preemptive_shed_low_priority_only_cache_still_answers():
+    """Under a control-plane shed, standard-priority tenants shed at
+    the lowered watermark while priority-0 traffic and cache hits keep
+    answering; lifting the shed restores the default watermark."""
+    fs = tf.make_router(
+        max_inflight=10,
+        quotas={"batch": TenantPolicy(max_inflight=10, priority=1)})
+    warm = tf.req(cx=0.77)
+    f = fs.submit(warm, tenant="batch")
+    slot, msg = fs.sup.sent[-1]
+    tf.answer(fs, slot, msg)
+    f.result(timeout=5)
+    fs.set_preemptive_shed(0.3)            # watermark 10 -> 3
+    futs = [fs.submit(tf.req(cx=0.5 + 0.001 * i), tenant="batch")
+            for i in range(4)]
+    with pytest.raises(Rejected) as e:
+        futs[-1].result(timeout=5)         # 4th standard passes 3/10
+    assert e.value.code == "overloaded"
+    assert e.value.fields["preemptive_shed"] is True
+    # priority-0 (default tenant) is untouched by the shed
+    crit = fs.submit(tf.req(cx=0.81))
+    assert not crit.done()                 # admitted
+    # an answer the fleet already owns is never shed
+    assert fs.submit(warm, tenant="batch").result(timeout=5).cache_hit
+    snap = fs.registry.snapshot()
+    assert snap["gauges"]["fleet_shed_watermark"] == 0.3
+    fs.set_preemptive_shed(None)
+    assert fs.registry.snapshot()["gauges"][
+        "fleet_shed_watermark"] == 0.8
+    with pytest.raises(ValueError):
+        fs.set_preemptive_shed(1.5)
+
+
+# --------------------------------------------------------------------- #
+# control plane decisions (fake fleet)
+# --------------------------------------------------------------------- #
+
+class FakePlaneFleet:
+    """The FleetServer surface the plane uses, minus everything."""
+
+    def __init__(self):
+        self.registry = MetricsRegistry()
+        self.shed_calls = []
+        self._total_inflight = 0
+        self.sup = tf.FakeSup(alive=(0, 1))
+
+    def set_preemptive_shed(self, wm):
+        self.shed_calls.append(wm)
+
+
+def test_plane_sheds_on_sustained_burn_and_lifts_on_recovery():
+    fleet = FakePlaneFleet()
+    fit = {"model": "m", "per_unit_rps": 50.0, "saturated": True}
+    plane = ControlPlane(fleet, policy=_policy(), sustain=2,
+                         shed_watermark=0.4, capacity_fit=fit)
+    _traffic(fleet.registry, n_ok=100)
+    plane.tick()
+    assert fleet.shed_calls == []
+    for _ in range(2):
+        _traffic(fleet.registry, n_fail=10)
+        plane.tick()
+    assert fleet.shed_calls == [0.4]
+    acts = [d["action"] for d in plane.decisions]
+    assert "shed" in acts and "retune_wanted" in acts
+    assert "capacity_advice" in acts
+    advice = [d for d in plane.decisions
+              if d["action"] == "capacity_advice"][0]
+    assert advice["current_units"] == 2
+    # advice dedupes while the burn state holds: more burning ticks
+    # with the same advised unit count append no new rows
+    for _ in range(3):
+        _traffic(fleet.registry, n_fail=10)
+        plane.tick()
+    assert len([d for d in plane.decisions
+                if d["action"] == "capacity_advice"]) == 1
+    snap = fleet.registry.snapshot()
+    assert snap["gauges"]["control_shed_active"] == 1.0
+    assert snap["gauges"]["control_burning_signatures"] == 1.0
+    assert snap["counters"]["control_actions_total{action=shed}"] == 1
+    # burn clears -> unshed exactly once
+    _traffic(fleet.registry, n_ok=500)
+    plane.tick()
+    plane.tick()
+    assert fleet.shed_calls == [0.4, None]
+    assert fleet.registry.snapshot()["gauges"][
+        "control_shed_active"] == 0.0
+
+
+def test_plane_stages_retune_off_peak(tmp_path):
+    fleet = FakePlaneFleet()
+    ret = Retuner(fleet,
+                  candidate_path=str(tmp_path / "candidate.json"),
+                  validated_path=str(tmp_path / "validated.json"))
+    plane = ControlPlane(fleet, policy=_policy(), sustain=1,
+                         retuner=ret)
+    sig = str(SolveRequest(nx=64, ny=64, steps=4).signature())
+    _traffic(fleet.registry, n_fail=5, sig=sig)
+    fleet._total_inflight = 99             # peak: nothing stages
+    plane.tick()
+    assert plane.staged == [] and plane.retune_wanted
+    fleet._total_inflight = 0              # off-peak: stage
+    _traffic(fleet.registry, n_fail=5, sig=sig)
+    plane.tick()
+    assert len(plane.staged) == 1
+    # one attempt per burn episode: further burning idle ticks must
+    # not re-run the search or re-log retune decisions every interval
+    for _ in range(3):
+        _traffic(fleet.registry, n_fail=5, sig=sig)
+        plane.tick()
+    assert len(plane.staged) == 1
+    assert len([d for d in plane.decisions
+                if d["action"] == "retune_wanted"]) == 1
+    staged = plane.staged[0]
+    assert staged["epoch"] == 1
+    cdb = TuningDB(str(tmp_path / "candidate.json"))
+    assert cdb.epoch == 1 and cdb.validated is False
+    e = cdb.entry("sim-v5e", "64x64:float32")
+    assert e is not None and e["validated"] is False
+    assert e.get("best")
+    # summary carries the decision log for the kind="control" record
+    s = plane.summary()
+    assert s["staged"] and s["no_unvalidated_serving"] is True
+
+
+def test_retuner_signature_mapping_and_hot_ranking():
+    fleet = FakePlaneFleet()
+    ret = Retuner(fleet, candidate_path="c.json",
+                  validated_path="v.json")
+    sig_a = str(SolveRequest(nx=32, ny=32, steps=4).signature())
+    sig_b = str(SolveRequest(nx=48, ny=32, steps=4).signature())
+    _traffic(fleet.registry, n_ok=10, sig=sig_a)
+    _traffic(fleet.registry, n_ok=30, sig=sig_b)
+    hot = ret.hot_signatures()
+    assert [s for s, _ in hot] == [sig_b, sig_a]    # hottest first
+    assert ret.hot_signatures() == []               # deltas consumed
+    p = problem_from_signature(sig_a)
+    assert (p.nx, p.ny, p.dtype) == (32, 32, "float32")
+    assert problem_from_signature("('inverse', 1)") is None
+    assert problem_from_signature("garbage((") is None
+
+
+def test_capacity_advise_units():
+    from heat2d_tpu.load import capacity
+    fit = {"model": "m", "per_unit_rps": 25.0, "saturated": True}
+    adv = capacity.advise(fit, observed_rps=90.0, current_units=2)
+    assert adv["needed_units"] == 4 and adv["add_units"] == 2
+    none = capacity.advise({"per_unit_rps": 0.0}, 10.0, 2)
+    assert none["needed_units"] is None and none["add_units"] is None
+
+
+def test_chaos_rollout_env_parse_and_single_fire():
+    cfg = chaos.ChaosConfig.from_env(
+        {"HEAT2D_CHAOS_ROLLOUT_KILL_PHASE": "observe"})
+    assert cfg is not None and cfg.rollout_kills == 0
+    with pytest.raises(ValueError):
+        chaos.ChaosConfig(rollout_kill_phase="nonsense")
+    with pytest.raises(ValueError):
+        chaos.ChaosConfig.from_env(
+            {"HEAT2D_CHAOS_ROLLOUT_KILLS": "lots"})
+    assert chaos.ChaosConfig.from_env(
+        {"HEAT2D_CHAOS_ROLLOUT_KILL_PHASE": ""}) is None
+    # the storm fires exactly once, at its phase only
+    fired = []
+    ctl = chaos._Controller(chaos.ChaosConfig(
+        rollout_kill_phase="parity", rollout_kills=2))
+    ctl.rollout_point("canary", fired.append)
+    assert fired == []
+    ctl.rollout_point("parity", fired.append)
+    ctl.rollout_point("parity", fired.append)
+    assert fired == [2]
+
+
+# --------------------------------------------------------------------- #
+# supervisor: one-generation overlays vs durable env (real processes)
+# --------------------------------------------------------------------- #
+
+def test_supervisor_overlay_is_one_generation_only(tmp_path):
+    """The ISSUE's supervisor satellite: a deliberate rollout restart
+    hands the canary its candidate db via env overlay; a NON-rollout
+    (crash) restart of the same slot rebuilds from the durable env —
+    the overlay can never be resurrected by the failure path."""
+    cand = str(tmp_path / "candidate.json")
+    TuningDB(cand).save()
+    with tf.fleet(workers=1) as fs:
+        assert fs.sup.worker_info(0).get("tune") is None
+        fs.sup.restart_worker(0, env_overlay={"HEAT2D_TUNE_DB": cand})
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            info = fs.sup.worker_info(0)
+            if info and (info.get("tune") or {}).get("path") == cand:
+                break
+            time.sleep(0.05)
+        assert (fs.sup.worker_info(0)["tune"] or {})["path"] == cand
+        # the crash path: monitor restart, durable env only
+        fs.sup.kill_worker(0)
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            info = fs.sup.worker_info(0)
+            if info is not None and info.get("tune") is None \
+                    and fs.sup.deaths >= 1:
+                break
+            time.sleep(0.05)
+        assert fs.sup.worker_info(0).get("tune") is None
+        gens = fs.sup.generations_snapshot()
+        assert fs.stop()
+    vias = [g["via"] for g in gens]
+    assert vias == ["start", "rollout", "restart"]
+    assert gens[1]["overlay"] == {"HEAT2D_TUNE_DB": cand}
+    assert gens[2]["overlay"] is None and gens[2]["tune"] is None
+
+
+def test_supervisor_update_slot_env_is_durable(tmp_path):
+    """update_slot_env changes survive crash restarts (the durable
+    counterpart of the one-generation overlay)."""
+    vali = str(tmp_path / "validated.json")
+    with tf.fleet(workers=1) as fs:
+        fs.sup.update_slot_env(0, {"HEAT2D_TUNE_DB": vali})
+        fs.sup.kill_worker(0)
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            info = fs.sup.worker_info(0)
+            if info and (info.get("tune") or {}).get("path") == vali:
+                break
+            time.sleep(0.05)
+        assert (fs.sup.worker_info(0)["tune"] or {})["path"] == vali
+        assert fs.stop()
+
+
+def test_cli_storm_and_bad_candidate_require_rollout():
+    """Review regression: the chaos flags act on a live rollout — a
+    soak that 'passed' without one would prove nothing, so the CLI
+    refuses the combination outright."""
+    from heat2d_tpu.fleet.cli import main
+    assert main(["--soak", "1", "--control-storm-phase",
+                 "observe"]) == 2
+    assert main(["--soak", "1", "--control-bad-candidate"]) == 2
+
+
+# --------------------------------------------------------------------- #
+# end to end: rollouts over real worker subprocesses
+# --------------------------------------------------------------------- #
+
+PROBE = {"nx": tf.NX, "ny": tf.NY, "steps": tf.STEPS,
+         "cx": 0.123, "cy": 0.1, "method": "jnp"}
+
+
+def _control_fleet(tmp_path, workers=2):
+    vp = str(tmp_path / "validated.json")
+    cp = str(tmp_path / "candidate.json")
+    fs = tf.fleet(workers=workers, max_replays=5,
+                  env={"JAX_PLATFORMS": "cpu", "HEAT2D_TUNE_DB": vp},
+                  cache_size=0, worker_cache_size=0)
+    return fs, vp, cp
+
+
+def _stage(fs, vp, cp):
+    ret = Retuner(fs, candidate_path=cp, validated_path=vp)
+    for i in range(3):
+        fs.solve(tf.req(cx=0.05 + 0.01 * i), timeout=120)
+    staged = ret.stage_candidate(ret.hot_signatures()[0][0])
+    assert staged is not None and staged["epoch"] == 1
+    return staged
+
+
+def test_rollout_healthy_candidate_promotes(tmp_path):
+    """Canary -> bitwise parity -> observe -> promote: the validated
+    db advances an epoch and every worker ends up serving it."""
+    fs, vp, cp = _control_fleet(tmp_path)
+    reg = fs.registry
+    with fs:
+        _stage(fs, vp, cp)
+        out = Rollout(fs, RolloutConfig(
+            candidate_path=cp, validated_path=vp, probe_spec=PROBE,
+            observe_s=0.8, observe_probes=2, probe_timeout=60),
+            policy=_policy(budget=0.5), registry=reg).run()
+        assert out["outcome"] == "promoted", out
+        assert [p["phase"] for p in out["phases"]] == [
+            "baseline", "canary", "parity", "observe", "promote",
+            "roll"]
+        vdb = TuningDB(vp)
+        assert vdb.epoch == 1 and vdb.validated is True
+        for s in fs.sup.alive_slots():
+            t = (fs.sup.worker_info(s) or {}).get("tune") or {}
+            assert t.get("path") == vp and t.get("validated") is True
+            assert t.get("epoch") == 1
+        assert fs.stop()
+    snap = reg.snapshot()
+    assert snap["counters"][
+        "control_rollouts_total{outcome=promoted}"] == 1
+    assert snap["counters"][
+        "control_probe_parity_total{result=match}"] >= 1
+    assert snap["gauges"]["control_epoch"] == 1
+
+
+def test_rollout_bad_candidate_auto_reverts_bitwise(tmp_path):
+    """ISSUE acceptance: a seeded regression candidate (chaos-slow on
+    the canary's overlay) is MEASURED as a latency regression and
+    auto-reverted; post-revert answers are bitwise-identical to the
+    pre-rollout baseline; nothing non-validated survives."""
+    fs, vp, cp = _control_fleet(tmp_path)
+    with fs:
+        _stage(fs, vp, cp)
+        out = Rollout(fs, RolloutConfig(
+            candidate_path=cp, validated_path=vp, probe_spec=PROBE,
+            observe_s=1.2, observe_probes=3, probe_timeout=60,
+            extra_canary_env={"HEAT2D_CHAOS_SLOW_WORKER_S": "0.6"}),
+            policy=_policy(budget=0.5), registry=fs.registry).run()
+        assert out["outcome"] == "reverted:latency_regression", out
+        assert out["post_revert_parity"] is True
+        # the candidate never reached the validated db
+        vdb = TuningDB(vp)
+        assert vdb.epoch == 0 and vdb.validated is True
+        gens = fs.sup.generations_snapshot()
+        assert fs.stop()
+    bad = [g for g in gens
+           if not (g["via"] == "rollout" and g.get("overlay"))
+           and g.get("tune") is not None
+           and not g["tune"].get("validated", True)]
+    assert bad == []
+
+
+def test_rollout_promote_guards_against_midflight_restage(
+        tmp_path, monkeypatch):
+    """Review regression: if the candidate file changes between the
+    canary's observation and promote (a concurrent re-stage), the
+    never-canaried content must NOT be validated — the rollout
+    reverts instead."""
+    from heat2d_tpu.control import rollout as rmod
+
+    fs, vp, cp = _control_fleet(tmp_path)
+    real_point = chaos.rollout_point
+
+    def restage_at_promote(phase, kill_cb=None):
+        if phase == "promote":
+            db = TuningDB(cp)
+            db.stamp_rollout(epoch=7, validated=False)
+            db.save()
+        return real_point(phase, kill_cb)
+
+    monkeypatch.setattr(rmod.chaos, "rollout_point",
+                        restage_at_promote)
+    with fs:
+        _stage(fs, vp, cp)
+        out = rmod.Rollout(fs, RolloutConfig(
+            candidate_path=cp, validated_path=vp, probe_spec=PROBE,
+            observe_s=0.5, observe_probes=1, probe_timeout=60),
+            policy=_policy(budget=0.5), registry=fs.registry).run()
+        assert out["outcome"] == \
+            "reverted:candidate_changed_mid_rollout", out
+        assert out["post_revert_parity"] is True
+        assert TuningDB(vp).epoch == 0      # nothing was promoted
+        assert fs.stop()
+
+
+def test_restart_worker_forced_kill_notifies_router(tmp_path):
+    """Review regression: a worker that misses the drain window for a
+    deliberate restart is killed — and the router must get the same
+    worker-lost sweep the crash path runs, or its in-flight records
+    sit until their deadline instead of replaying."""
+    lost = []
+    with tf.fleet(workers=1) as fs:
+        orig = fs.sup.on_worker_lost
+        fs.sup.on_worker_lost = lambda s: (lost.append(s), orig(s))
+        # timeout=0 forces the kill path even on an idle worker (the
+        # drain cannot complete in zero time)
+        fs.sup.restart_worker(0, timeout=0)
+        assert lost == [0]
+        deadline = time.monotonic() + 60
+        while not fs.sup.alive_slots() and time.monotonic() < deadline:
+            time.sleep(0.05)
+        # the replacement serves
+        assert fs.solve(tf.req(cx=0.91), timeout=120).steps_done \
+            == tf.STEPS
+        assert fs.stop()
+
+
+def test_rollout_kill_storm_never_serves_unvalidated(tmp_path):
+    """ISSUE acceptance (chaos-proven): a kill storm landing mid-
+    rollout (observation window) takes every worker down; the rollout
+    auto-reverts, post-revert answers match the pre-rollout baseline
+    bitwise, and NO non-rollout worker generation ever reported a
+    non-validated config — crash restarts always rejoin the validated
+    epoch."""
+    fs, vp, cp = _control_fleet(tmp_path, workers=2)
+    chaos.install(chaos.ChaosConfig(rollout_kill_phase="observe",
+                                    rollout_kills=0))
+    try:
+        with fs:
+            _stage(fs, vp, cp)
+            pre = np.asarray(fs.solve(
+                SolveRequest.from_dict(dict(PROBE)),
+                timeout=120).u).tobytes()
+            out = Rollout(fs, RolloutConfig(
+                candidate_path=cp, validated_path=vp,
+                probe_spec=PROBE, observe_s=3.0, observe_probes=4,
+                probe_timeout=60),
+                policy=_policy(budget=0.5),
+                registry=fs.registry).run()
+            assert out["outcome"].startswith("reverted:"), out
+            assert out["post_revert_parity"] is True
+            assert fs.sup.deaths >= 2          # the storm landed
+            # the incumbent config still answers, bitwise
+            deadline = time.monotonic() + 60
+            while (len(fs.sup.alive_slots()) < 2
+                   and time.monotonic() < deadline):
+                time.sleep(0.05)
+            post = np.asarray(fs.solve(
+                SolveRequest.from_dict(dict(PROBE)),
+                timeout=120).u).tobytes()
+            assert post == pre
+            gens = fs.sup.generations_snapshot()
+            assert fs.stop()
+    finally:
+        chaos.uninstall()
+    # THE invariant: only rollout-spawned generations may be
+    # unvalidated; every crash restart rejoined the validated epoch
+    restarts = [g for g in gens if g["via"] == "restart"]
+    assert restarts, "the storm produced no crash restarts"
+    for g in gens:
+        if g["via"] == "rollout" and g.get("overlay"):
+            continue
+        assert g.get("tune") is None or \
+            g["tune"].get("validated", True), g
+    # the validated db never advanced
+    assert TuningDB(vp).epoch == 0

@@ -103,6 +103,9 @@ class FakeSup:
     def alive_slots(self):
         return list(self.alive)
 
+    def generations_snapshot(self):
+        return []
+
     def send(self, slot, obj):
         if slot not in self.alive:
             raise WorkerGone(f"worker {slot} is not running")

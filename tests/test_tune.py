@@ -719,6 +719,136 @@ def test_db_merge_new_device_kind_and_stamps(tmp_path):
         a.merge({"not": "a db"})
 
 
+def test_db_rollout_stamps_roundtrip(tmp_path):
+    """Document-level epoch/validated stamps (the control plane's
+    rollout provenance) survive save/load; a db without them is the
+    validated incumbent at epoch 0."""
+    db = _worker_db(tmp_path / "a.json",
+                    points=[_point("C", 8, 8, 100.0)],
+                    best={"route": "C", "bm": 8, "tsteps": 8,
+                          "mcells_per_s": 100.0})
+    assert db.epoch == 0 and db.validated is True
+    db.stamp_rollout(epoch=3, validated=False)
+    assert db.mark_entries(validated=False, epoch=3) == 1
+    db.save()
+    back = TuningDB(str(tmp_path / "a.json"))
+    assert back.epoch == 3 and back.validated is False
+    e = back.entry("cpu", "64x64:float32")
+    assert e["validated"] is False and e["epoch"] == 3
+
+
+def test_db_merge_prefers_validated_at_equal_salt(tmp_path):
+    """A VALIDATED entry's best beats a staged CANDIDATE's at the same
+    salt even when the candidate measured a faster rate — a rollout
+    proved the validated config; the faster point is a claim. Points
+    still union both ways."""
+    a = _worker_db(tmp_path / "a.json",
+                   points=[_point("C", 8, 8, 100.0)],
+                   best={"route": "C", "bm": 8, "tsteps": 8,
+                         "mcells_per_s": 100.0})
+    a.mark_entries(validated=True, epoch=2)
+    a.save()
+    b = _worker_db(tmp_path / "b.json",
+                   points=[_point("C", 16, 8, 500.0)],   # faster, unproven
+                   best={"route": "C", "bm": 16, "tsteps": 8,
+                         "mcells_per_s": 500.0}, ts="2026-03-01")
+    b.mark_entries(validated=False, epoch=3)             # staged candidate
+    b.save()
+    s = a.merge(TuningDB(str(tmp_path / "b.json")))
+    assert s["points_added"] == 1
+    e = a.entry("cpu", "64x64:float32")
+    assert e["best"]["bm"] == 8                  # validated kept the slot
+    assert e["validated"] is True and e["epoch"] == 2
+    assert {(p["route"], p["bm"]) for p in e["points"]} == \
+        {("C", 8), ("C", 16)}
+    # the mirror merge: the candidate holder CEDES to the validated
+    b2 = TuningDB(str(tmp_path / "b.json"))
+    b2.merge(TuningDB(str(tmp_path / "a.json")))
+    e2 = b2.entry("cpu", "64x64:float32")
+    assert e2["best"]["bm"] == 8 and e2["validated"] is True
+    # equal validation status falls back to the frontier restamp
+    c = _worker_db(tmp_path / "c.json",
+                   points=[_point("C", 24, 8, 900.0)],
+                   best={"route": "C", "bm": 24, "tsteps": 8,
+                         "mcells_per_s": 900.0}, ts="2026-04-01")
+    c.mark_entries(validated=False, epoch=3)
+    b3 = TuningDB(str(tmp_path / "b.json"))      # still a candidate
+    b3.merge(c)
+    assert b3.entry("cpu", "64x64:float32")["best"]["bm"] == 24
+
+
+def test_db_merge_unstamped_incumbent_beats_staged_candidate(tmp_path):
+    """Review regression: an UNSTAMPED entry (a db that predates
+    rollout stamps) counts as the validated incumbent — a staged
+    candidate's faster claim must not displace its best in a merge."""
+    inc = _worker_db(tmp_path / "incumbent.json",
+                     points=[_point("C", 8, 8, 100.0)],
+                     best={"route": "C", "bm": 8, "tsteps": 8,
+                           "mcells_per_s": 100.0})
+    cand = _worker_db(tmp_path / "candidate.json",
+                      points=[_point("C", 16, 8, 999.0)],
+                      best={"route": "C", "bm": 16, "tsteps": 8,
+                            "mcells_per_s": 999.0}, ts="2026-05-01")
+    cand.mark_entries(validated=False, epoch=1)
+    cand.save()
+    inc.merge(TuningDB(str(tmp_path / "candidate.json")))
+    e = inc.entry("cpu", "64x64:float32")
+    assert e["best"]["bm"] == 8                  # incumbent held
+    assert "validated" not in e or e["validated"]
+    # the mirror direction: the CANDIDATE adopting the unstamped
+    # incumbent's best must also shed its own validated=False stamp —
+    # otherwise a later candidate merge (False == False) would let an
+    # unproven faster point displace the adopted proven best
+    cand2 = TuningDB(str(tmp_path / "candidate.json"))
+    cand2.merge(inc)
+    e2 = cand2.entry("cpu", "64x64:float32")
+    assert e2["best"]["bm"] == 8
+    assert e2.get("validated", True) is True
+    cand3 = _worker_db(tmp_path / "candidate3.json",
+                       points=[_point("C", 32, 8, 5000.0)],
+                       best={"route": "C", "bm": 32, "tsteps": 8,
+                             "mcells_per_s": 5000.0}, ts="2026-07-01")
+    cand3.mark_entries(validated=False, epoch=2)
+    cand2.merge(cand3)
+    assert cand2.entry("cpu",
+                       "64x64:float32")["best"]["bm"] == 8
+    # two unstamped dbs keep the plain frontier-restamp behavior
+    d1 = _worker_db(tmp_path / "d1.json",
+                    points=[_point("C", 8, 8, 100.0)],
+                    best={"route": "C", "bm": 8, "tsteps": 8,
+                          "mcells_per_s": 100.0})
+    d2 = _worker_db(tmp_path / "d2.json",
+                    points=[_point("C", 24, 8, 500.0)],
+                    best={"route": "C", "bm": 24, "tsteps": 8,
+                          "mcells_per_s": 500.0}, ts="2026-06-01")
+    d1.merge(d2)
+    assert d1.entry("cpu", "64x64:float32")["best"]["bm"] == 24
+
+
+def test_frontier_table_surfaces_validation_stamps(tmp_path):
+    """The frontier's best row carries the rollout provenance tag —
+    [candidate eN] for a staged db, [validated eN] after promote."""
+    from heat2d_tpu.tune.cli import frontier_table
+
+    db = _worker_db(tmp_path / "a.json",
+                    points=[_point("C", 8, 8, 100.0)],
+                    best={"route": "C", "bm": 8, "tsteps": 8,
+                          "mcells_per_s": 100.0})
+    plain = frontier_table(db, "cpu")
+    assert "<-- best" in plain and "[" not in plain.split("best")[-1]
+    db.mark_entries(validated=False, epoch=4)
+    staged = frontier_table(db, "cpu")
+    assert "<-- best [candidate e4]" in staged
+    db.mark_entries(validated=True, epoch=4)
+    assert "<-- best [validated e4]" in frontier_table(db, "cpu")
+    # an epoch stamp WITHOUT a validated key defaults validated (the
+    # incumbent back-compat rule every consumer applies)
+    for dev in db.data["devices"].values():
+        for e in dev["entries"].values():
+            e.pop("validated", None)
+    assert "<-- best [validated e4]" in frontier_table(db, "cpu")
+
+
 def test_merge_cli_writes_consolidated_db(tmp_path, capsys):
     """heat2d-tpu-tune --merge a.json b.json -o out.json — the
     fleet-wide consolidation entry point; corrupt inputs contribute
