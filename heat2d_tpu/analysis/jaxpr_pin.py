@@ -15,6 +15,7 @@ assert to a readable structural diff when a pin ever breaks.
 from __future__ import annotations
 
 import difflib
+from typing import Optional
 
 
 def jaxpr_text(fn, *args, **kwargs) -> str:
@@ -113,6 +114,47 @@ def band_runner_jaxpr(nx: int = 64, ny: int = 128, steps: int = 10,
     return jaxpr_text(lambda u, a, c: _run_batch_band(u, a, c,
                                                       steps=steps),
                       u0, cxs, cxs)
+
+
+def mesh_runner_jaxpr(nx: int = 16, ny: int = 16, steps: int = 4,
+                      method: str = "jnp", b: Optional[int] = None,
+                      n_devices: Optional[int] = None) -> str:
+    """The mesh-sharded serve batch runner's program (heat2d_tpu/
+    mesh/runner.py) — pins that the scheduler/admission layers are
+    pure host-side math: the traced mesh program is identical with
+    and without them armed."""
+    import jax.numpy as jnp
+
+    from heat2d_tpu.mesh.runner import mesh_batch_runner
+
+    run = mesh_batch_runner(nx, ny, steps, method,
+                            n_devices=n_devices)
+    b = b if b is not None else run.n_devices
+    u0 = jnp.zeros((b, nx, ny), jnp.float32)
+    cxs = _cxys(b)
+    return jaxpr_text(run.jitted, u0, cxs, cxs)
+
+
+def spatial_runner_jaxpr(nx: int = 24, ny: int = 24, steps: int = 8,
+                         gridx: int = 1, gridy: int = 1,
+                         halo: str = "collective",
+                         n_devices: Optional[int] = None) -> str:
+    """The memoized batch x spatial serve runner's program
+    (``ensemble.spatial_batch_runner``) — the fused-vs-collective
+    route pins compare the SERVE path through this (the degraded
+    fused program must be byte-identical to the collective one, and a
+    viable fused program must differ — non-vacuity)."""
+    import jax.numpy as jnp
+
+    from heat2d_tpu.models import ensemble
+
+    run = ensemble.spatial_batch_runner(nx, ny, steps, gridx, gridy,
+                                        halo=halo,
+                                        n_devices=n_devices)
+    meta = run.meta
+    u0 = jnp.zeros((meta.nb, meta.pnx, meta.pny), jnp.float32)
+    c = jnp.zeros((meta.nb,), jnp.float32)
+    return jaxpr_text(run.jitted, u0, c, c)
 
 
 def sharded_runner_jaxpr(cfg, mesh) -> str:

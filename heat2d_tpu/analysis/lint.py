@@ -24,8 +24,8 @@ hand (docs/ANALYSIS.md has the rationale + an example finding for each):
   the obs registry must appear in the docs tables, and every documented
   family must exist in code (dashboards built from the docs must not
   silently watch nothing).
-- **R006 bare locks in serve/fleet/resil** — threaded subsystems must
-  take their mutexes from ``analysis.locks`` so the lock audit
+- **R006 bare locks in serve/fleet/resil/mesh** — threaded subsystems
+  must take their mutexes from ``analysis.locks`` so the lock audit
   (``HEAT2D_LOCK_AUDIT=1``) sees every acquisition.
 
 Pure stdlib ``ast`` — no third-party parser; runs in CI as the
@@ -72,7 +72,8 @@ METRIC_METHODS = {"counter", "gauge", "observe", "series", "timer"}
 #: metric families the drift rule covers (names outside these prefixes
 #: are not part of the documented contract)
 METRIC_RE = re.compile(
-    r"^(serve|fleet|resil|tune|inverse|slo|load|control)_[a-z0-9_]+$")
+    r"^(serve|fleet|resil|tune|inverse|slo|load|control|mesh)"
+    r"_[a-z0-9_]+$")
 
 #: keyword names whose literal string values name a metric family
 #: (e.g. ``SingleFlight(counter="fleet_coalesced_total")``)
@@ -479,7 +480,7 @@ def _rule_r004(rel: str, tree: ast.Module, scopes: _Scopes,
 def _rule_r006(rel: str, tree: ast.Module, scopes: _Scopes,
                src_lines: List[str]) -> List[Finding]:
     if not any(seg in rel.split("/") for seg in ("serve", "fleet",
-                                                 "resil")):
+                                                 "resil", "mesh")):
         return []
     out = []
     for node in ast.walk(tree):
@@ -556,7 +557,7 @@ def _code_metric_names(trees: Dict[str, ast.Module]) -> Tuple[
 
 
 _DOC_METRIC_RE = re.compile(
-    r"`((?:serve|fleet|resil|tune|inverse|slo|load|control)_"
+    r"`((?:serve|fleet|resil|tune|inverse|slo|load|control|mesh)_"
     r"[a-z0-9_*]+)"
     r"(?:\{[^`]*\})?`")
 

@@ -25,7 +25,7 @@ from __future__ import annotations
 import logging
 import math
 import re
-from typing import Dict, List, Optional
+from typing import Any, Callable, Dict, List, Optional
 
 #: the logger jax routes per-compile records through (stable across
 #: the jax versions this repo supports; the regex below is the
@@ -154,15 +154,18 @@ def log2_capacity_budget(max_batch: int) -> int:
 
 #: logged-name filter for the serve engine's batch runners (the
 #: memoized jitted callables serve dispatches through; ensemble.
-#: batch_runner stamps the name)
+#: batch_runner stamps the name — mesh/spatial runners embed the same
+#: stem, so one filter covers every engine flavor)
 SERVE_RUNNER_MATCH = r"batch_runner"
 
 
 def serve_compile_report(*, nx: int = 16, ny: int = 16, steps: int = 4,
                          method: str = "jnp", max_batch: int = 8,
-                         convergence: bool = False) -> dict:
+                         convergence: bool = False,
+                         engine_factory: Optional[Callable[[], Any]]
+                         = None) -> dict:
     """Drive a representative serve workload — one signature, EVERY
-    occupancy 1..max_batch through ``EnsembleEngine.solve_batch`` —
+    occupancy 1..max_batch through the engine's ``solve_batch`` —
     under a ``CompileWatch`` and report the compile accounting.
 
     Returns ``{"compiles": int, "budget": int, "names": {...},
@@ -170,17 +173,31 @@ def serve_compile_report(*, nx: int = 16, ny: int = 16, steps: int = 4,
     gate) asserts ``compiles <= budget``. The engine pads occupancies
     to powers of two, so the runner must compile once per DISTINCT
     capacity, never once per occupancy: O(log max_batch), the exact
-    property the padding design bought."""
+    property the padding design bought.
+
+    ``engine_factory``: builds the engine under report (default the
+    single-chip ``EnsembleEngine``) — how the mesh gate proves the
+    SAME contract holds per mesh config (``mesh.MeshEnsembleEngine``
+    pads to device-multiple capacities: fewer rungs, never more
+    compiles; its occupancies sweep 1..its own max_batch)."""
     from heat2d_tpu.models import ensemble
     from heat2d_tpu.serve.engine import EnsembleEngine
     from heat2d_tpu.serve.schema import SolveRequest
 
-    # a fresh runner cache: reusing an executable another test already
+    # fresh runner caches: reusing an executable another test already
     # compiled would undercount and pass vacuously
     ensemble.batch_runner.cache_clear()
-    engine = EnsembleEngine(max_batch=max_batch)
+    ensemble.spatial_batch_runner.cache_clear()
+    try:
+        from heat2d_tpu.mesh.runner import mesh_batch_runner
+        mesh_batch_runner.cache_clear()
+    except ImportError:  # pragma: no cover - partial install
+        pass
+    engine = (engine_factory() if engine_factory is not None
+              else EnsembleEngine(max_batch=max_batch))
+    max_occupancy = min(max_batch, engine.max_batch)
     with CompileWatch(match=SERVE_RUNNER_MATCH) as watch:
-        for occupancy in range(1, max_batch + 1):
+        for occupancy in range(1, max_occupancy + 1):
             reqs = [SolveRequest(nx=nx, ny=ny, steps=steps,
                                  cx=0.1 + 0.01 * i, cy=0.1,
                                  method=method,
@@ -190,7 +207,7 @@ def serve_compile_report(*, nx: int = 16, ny: int = 16, steps: int = 4,
     capacities = sorted({row["capacity"] for row in engine.launch_log})
     return {
         "compiles": watch.count,
-        "budget": log2_capacity_budget(max_batch),
+        "budget": log2_capacity_budget(max_occupancy),
         "names": watch.counts_by_name(),
         "launches": engine.launches,
         "capacities": capacities,

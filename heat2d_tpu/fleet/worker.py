@@ -93,11 +93,23 @@ def main(argv=None) -> int:
     tracing.activate_from_env(service=service)
     flight.maybe_install_from_env(service=service, registry=registry)
 
+    # Mesh serving is env-armed like every worker knob (the supervisor
+    # passes the environment through): HEAT2D_MESH_SERVE=1 swaps the
+    # single-chip engine for the mesh-aware one, so a fleet can run
+    # every worker's buckets sharded over that worker's attached
+    # devices (heat2d-tpu-load --target fleet --mesh sets it).
+    engine = None
+    if os.environ.get("HEAT2D_MESH_SERVE", "") not in ("", "0"):
+        from heat2d_tpu.mesh import MeshEnsembleEngine
+        # --max-batch becomes the per-chip bound (scales with the
+        # worker's attached mesh instead of being discarded)
+        engine = MeshEnsembleEngine(registry=registry,
+                                    max_batch_per_chip=args.max_batch)
     server = SolveServer(
         max_batch=args.max_batch, max_delay=args.max_delay,
         max_queue=args.queue_depth, cache_size=args.cache_size,
         default_timeout=args.timeout,
-        registry=registry).start()
+        registry=registry, engine=engine).start()
 
     wlock = AuditedLock("fleet.worker.wire")
 

@@ -45,14 +45,23 @@ def _qualifies(row: dict, keepup_margin: float,
 
 
 def fit_capacity(rows: List[dict], units: int, *,
+                 chips_per_unit: int = 1,
                  keepup_margin: float = 0.2,
                  max_shed_rate: float = 0.01) -> dict:
     """Fit the capacity model over surface ``rows`` (each carrying
     ``offered_rps`` / ``achieved_rps`` / ``shed_rate`` / ``slo_ok``).
     Returns the fit dict published into ``kind="load"`` run records
-    and gate baselines."""
+    and gate baselines.
+
+    ``chips_per_unit``: devices behind ONE serving unit — 1 for the
+    classic single-chip targets, the mesh size when the mesh engine is
+    active (a mesh ``ServeTarget`` is one unit spanning N chips), so
+    the fit (and ``advise``) can speak in chips, not just workers."""
     if units < 1:
         raise ValueError(f"units must be >= 1, got {units}")
+    if chips_per_unit < 1:
+        raise ValueError(f"chips_per_unit must be >= 1, got "
+                         f"{chips_per_unit}")
     ranked = sorted(rows, key=lambda r: r.get("offered_rps", 0.0))
     qualifying = [r for r in ranked
                   if _qualifies(r, keepup_margin, max_shed_rate)]
@@ -63,13 +72,17 @@ def fit_capacity(rows: List[dict], units: int, *,
     else:
         max_rps, saturated = 0.0, True
     per_unit = max_rps / units
+    chips = units * chips_per_unit
     return {
         "model": CAPACITY_MODEL,
         "units": int(units),
+        "chips_per_unit": int(chips_per_unit),
+        "chips": int(chips),
         "points": len(ranked),
         "qualifying_points": len(qualifying),
         "max_sustainable_rps": round(max_rps, 4),
         "per_unit_rps": round(per_unit, 4),
+        "per_chip_rps": round(max_rps / chips, 4),
         #: False == the sweep never found the knee: capacity is a
         #: LOWER bound (every offered rate qualified)
         "saturated": bool(saturated),
@@ -89,6 +102,16 @@ def units_for(fit: dict, target_rps: float) -> Optional[int]:
     return max(1, math.ceil(target_rps / per_unit))
 
 
+def chips_for(fit: dict, target_rps: float) -> Optional[int]:
+    """``units_for`` stated in CHIPS: units x the fit's
+    ``chips_per_unit`` (1 on pre-mesh fits, so the two answers agree
+    wherever both exist)."""
+    units = units_for(fit, target_rps)
+    if units is None:
+        return None
+    return units * int(fit.get("chips_per_unit", 1))
+
+
 def sustainable_at(fit: dict, units: int) -> float:
     """The model's predicted sustainable req/s at ``units`` serving
     units (linear extrapolation from the fitted per-unit rate)."""
@@ -105,6 +128,7 @@ def advise(fit: dict, observed_rps: float, current_units: int) -> dict:
     saw a sustainable point; an unsaturated fit makes the advice
     conservative (the fit is a lower bound)."""
     need = units_for(fit, observed_rps)
+    cpu = int(fit.get("chips_per_unit", 1))
     return {
         "model": fit.get("model"),
         "observed_rps": round(float(observed_rps), 4),
@@ -112,6 +136,12 @@ def advise(fit: dict, observed_rps: float, current_units: int) -> dict:
         "needed_units": need,
         "add_units": (None if need is None
                       else max(0, need - int(current_units))),
+        # the same advice in CHIPS (mesh engines span chips_per_unit
+        # devices per serving unit; 1 everywhere else, where these
+        # rows equal the unit rows)
+        "chips_per_unit": cpu,
+        "current_chips": int(current_units) * cpu,
+        "needed_chips": None if need is None else need * cpu,
         "fit_saturated": bool(fit.get("saturated", True)),
         "sustainable_at_current": sustainable_at(fit, current_units),
     }

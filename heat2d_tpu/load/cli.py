@@ -64,6 +64,13 @@ def build_parser() -> argparse.ArgumentParser:
     tgt = p.add_argument_group("target")
     tgt.add_argument("--target", default="serve",
                      choices=["serve", "fleet"])
+    tgt.add_argument("--mesh", action="store_true",
+                     help="serve through the mesh-aware engine "
+                          "(heat2d_tpu/mesh): --target serve gets a "
+                          "MeshEnsembleEngine in-process, --target "
+                          "fleet arms HEAT2D_MESH_SERVE=1 on every "
+                          "worker; the capacity fit gains the "
+                          "chips_per_unit dimension (docs/LOADGEN.md)")
     tgt.add_argument("--workers", type=int, default=2,
                      help="fleet worker subprocesses")
     tgt.add_argument("--max-inflight", type=int, default=256)
@@ -165,12 +172,13 @@ def _make_target(args, registry, profile=None):
         return FleetTarget(workers=args.workers, registry=registry,
                            quotas=quotas,
                            max_inflight=args.max_inflight, env=env,
-                           default_timeout=args.timeout)
+                           default_timeout=args.timeout,
+                           mesh=args.mesh)
     if args.chaos_slow:
         from heat2d_tpu.resil import chaos
         chaos.install(chaos.ChaosConfig(
             launch_latency_s=args.chaos_slow))
-    return ServeTarget(registry=registry)
+    return ServeTarget(registry=registry, mesh=args.mesh)
 
 
 def _surface_markdown(rows: list, fit: dict) -> str:
@@ -254,12 +262,16 @@ def run_load(args, registry) -> int:
             chaos.uninstall()
 
     units = getattr(target, "units", 1)
-    fit = cap_mod.fit_capacity(rows, units)
+    fit = cap_mod.fit_capacity(
+        rows, units,
+        chips_per_unit=getattr(target, "chips_per_unit", 1))
     if registry is not None:
         registry.gauge("load_capacity_rps",
                        fit["max_sustainable_rps"])
         registry.gauge("load_capacity_per_unit_rps",
                        fit["per_unit_rps"])
+        registry.gauge("load_capacity_per_chip_rps",
+                       fit["per_chip_rps"])
     print(_surface_markdown(rows, fit))
 
     if args.max_skew is not None:
@@ -278,6 +290,7 @@ def run_load(args, registry) -> int:
             rows, fit, meta={
                 "profile": args.profile, "replay": args.replay,
                 "target": args.target, "workers": args.workers,
+                "mesh": args.mesh,
                 "seed": args.seed, "duration_s": args.duration,
                 "slo_p99_s": args.slo_p99})
         write_json_atomic(base, args.write_baseline)
@@ -321,6 +334,7 @@ def _write_metrics(args, registry, rows, fit, gate_result,
         "source": ("replay" if args.replay
                    else f"profile:{args.profile or 'uniform'}"),
         "target": args.target,
+        "mesh": args.mesh,
         "workers": (args.workers if args.target == "fleet" else 1),
         "speedup": args.speedup,
         "seed": args.seed,

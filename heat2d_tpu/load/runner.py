@@ -39,29 +39,46 @@ from heat2d_tpu.serve.schema import Rejected
 #: rejection codes that are LOAD SHEDDING (admission said no): the
 #: shed-rate numerator. Timeouts/faults are failures, not shedding;
 #: invalid requests are caller bugs and count as neither.
-SHED_CODES = ("queue_full", "overloaded", "degraded", "quota")
+#: ``mesh_saturated`` is the mesh engine's modeled-capacity admission
+#: verdict (heat2d_tpu/mesh.MeshAdmission) — shedding by design.
+SHED_CODES = ("queue_full", "overloaded", "degraded", "quota",
+              "mesh_saturated")
 
 
 class ServeTarget:
     """An in-process ``SolveServer`` as a load target (1 serving
     unit). ``tenant`` is accepted and ignored — single-process serving
-    has no tenant plane."""
+    has no tenant plane.
+
+    ``mesh=True`` serves through the mesh-aware engine
+    (``heat2d_tpu/mesh``): still ONE serving unit, but spanning every
+    attached chip — ``chips_per_unit`` then carries the mesh size into
+    the capacity fit so sizing advice speaks in chips."""
 
     units = 1
+    chips_per_unit = 1
 
     def __init__(self, registry=None, *, max_batch: int = 8,
                  max_delay: float = 0.005, max_queue: int = 256,
                  launch_deadline: Optional[float] = None,
-                 cache_size: int = 0):
+                 cache_size: int = 0, mesh: bool = False):
         from heat2d_tpu.serve.server import SolveServer
-        self.max_batch = max_batch
+        engine = None
+        if mesh:
+            from heat2d_tpu.mesh import MeshEnsembleEngine
+            # max_batch becomes the per-chip bound under the mesh
+            engine = MeshEnsembleEngine(registry=registry,
+                                        max_batch_per_chip=max_batch)
+            self.chips_per_unit = engine.n_devices
+        self.max_batch = engine.max_batch if engine else max_batch
         # cache_size=0 by default: measured load must exercise the
         # SOLVE path; repeated payload hashes served from cache would
         # inflate the surface (the fleet soak makes the same call).
         self.server = SolveServer(
             max_batch=max_batch, max_delay=max_delay,
             max_queue=max_queue, cache_size=cache_size,
-            launch_deadline=launch_deadline, registry=registry)
+            launch_deadline=launch_deadline, registry=registry,
+            engine=engine)
         self.server.start()
 
     def submit(self, req, tenant: str, timeout: Optional[float]):
@@ -78,12 +95,14 @@ class FleetTarget:
     ``HEAT2D_CHAOS_SLOW_WORKER_S`` — seeds a regression for the gate
     to catch)."""
 
+    chips_per_unit = 1
+
     def __init__(self, workers: int = 2, registry=None, *,
                  quotas: Optional[dict] = None,
                  max_inflight: int = 256,
                  env: Optional[dict] = None,
                  default_timeout: Optional[float] = 30.0,
-                 max_batch: int = 8):
+                 max_batch: int = 8, mesh: bool = False):
         from heat2d_tpu.fleet.router import FleetServer
         self.units = workers
         self.max_batch = max_batch
@@ -91,12 +110,23 @@ class FleetTarget:
         # resolved --platform into the environment) — a hardcoded cpu
         # here would silently fit a "TPU" capacity model on CPU
         platform = os.environ.get("JAX_PLATFORMS", "cpu")
+        env = dict({"JAX_PLATFORMS": platform}, **(env or {}))
+        if mesh:
+            # every worker serves through its mesh engine
+            # (fleet/worker.py's env knob). Co-hosted workers SHARE
+            # the host's devices, so chips-per-unit is the host's
+            # device count split across the workers (floor, min 1) —
+            # a per-worker full count would double-charge the same
+            # physical chips into the capacity fit
+            env.setdefault("HEAT2D_MESH_SERVE", "1")
+            import jax
+            self.chips_per_unit = max(1, len(jax.devices()) // workers)
         self.fleet = FleetServer(
             workers=workers, registry=registry, quotas=quotas,
             max_batch=max_batch,
             max_inflight=max_inflight, cache_size=0,
             worker_cache_size=0, default_timeout=default_timeout,
-            env=dict({"JAX_PLATFORMS": platform}, **(env or {})))
+            env=env)
         self.fleet.start()
 
     def submit(self, req, tenant: str, timeout: Optional[float]):

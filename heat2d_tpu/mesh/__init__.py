@@ -1,0 +1,47 @@
+"""Pod-scale serving — the mesh scheduler subsystem (ROADMAP item 1).
+
+The serve stack built in PRs 2-12 launches every bucket on effectively
+one replica group: ``EnsembleEngine`` dispatches through the
+single-device batch runners while 7 of the 8 attached chips idle (the
+MULTICHIP rounds prove they are green), and PR 7 left the spatial
+socket wired but dark — ``ensemble.spatial_halo_plan`` pre-resolves a
+route/tier/depth per serve signature and stamps every plan
+``compiled: False`` "until the mesh-aware engine lands". This package
+is that engine, mesh-aware along BOTH axes:
+
+- ``runner``    — the mesh-sharded batch runner: a named 1D mesh over
+                  all attached devices, ``NamedSharding(P('batch'))``
+                  on the padded ensemble axis (the GSPMD pattern —
+                  SNIPPETS.md [2]/[3]), capacities padded to device
+                  multiples so the O(log max_batch) compile ladder
+                  survives the mesh.
+- ``scheduler`` — the batch-vs-spatial split per signature bucket from
+                  a resource model (member grid bytes vs per-chip
+                  VMEM, demand from the per-signature counters, tuned
+                  rates from the tune db), plus ``MeshAdmission`` —
+                  shedding on MODELED mesh saturation, not queue depth
+                  alone.
+- ``engine``    — ``MeshEnsembleEngine``: routes each bucket to the
+                  mesh batch runner, the spatial fused-halo runner
+                  (finally flipping the halo plan to
+                  ``compiled: True``), or the single-chip path
+                  (``tier="unplannable"`` shapes fall back with a
+                  ``mesh_fallback_total{reason}`` counter instead of
+                  rejecting) — bitwise-identical results to the
+                  single-chip engine on every route.
+- ``bench``     — ``bench_serve`` strong scaling riding
+                  ``parallel/scaling.py``'s ``kind="multichip"``
+                  records, with mesh-vs-single-chip bitwise parity as
+                  the correctness anchor (the CI ``mesh-serve-gate``).
+
+Everything is opt-in: a ``SolveServer`` built without a mesh engine is
+byte-identical to the PR-2 stack (the jaxpr pins hold with this
+package imported, scheduled, and admitted).
+"""
+
+from heat2d_tpu.mesh.engine import MeshEnsembleEngine
+from heat2d_tpu.mesh.runner import mesh_batch_runner, mesh_capacity
+from heat2d_tpu.mesh.scheduler import MeshAdmission, MeshScheduler
+
+__all__ = ["MeshAdmission", "MeshEnsembleEngine", "MeshScheduler",
+           "mesh_batch_runner", "mesh_capacity"]

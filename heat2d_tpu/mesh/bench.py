@@ -1,0 +1,263 @@
+"""``bench_serve`` — serve-side strong scaling over the device mesh,
+riding ``parallel/scaling.py``'s ``kind="multichip"`` records.
+
+Two numbers per run, with different epistemics, both in the record:
+
+- **Bitwise parity** (the correctness anchor, MEASURED everywhere):
+  the mesh engine's results vs the single-chip engine's on every
+  occupancy rung 1..max, every tested signature — byte-for-byte.
+- **Throughput scaling** — on real hardware (``rate_source="wall"``)
+  the wall-clock request rate of full-capacity launches at 1 chip vs
+  n chips. On a HOST-SIMULATED mesh (CI's
+  ``--xla_force_host_platform_device_count``) the n "chips" share one
+  CPU's cores, so wall clock cannot show device scaling; there the
+  record carries the MODELED surface (``rate_source="modeled"``) —
+  the same resource model the mesh admission control prices work
+  with: each chip advances its local members in parallel (batch DP
+  has no cross-member dependency), charged a per-launch dispatch
+  overhead plus a collective tax on multi-chip meshes. The model's
+  parameters are stated in the payload so the gate's 1→8 efficiency
+  assertion is auditable — it proves the scheduler's capacity math,
+  the compile ladder, and bitwise parity; silicon scaling is
+  ``tpu_smoke.py``'s job (the same split the tune subsystem's
+  SimulatedBackend made for CPU CI).
+
+    serve_scaling_efficiency = rate_n / (n * rate_1)
+
+``main`` writes the record (``scaling_record``) and exits nonzero when
+parity breaks, the efficiency misses ``--min-efficiency``, or a
+spatial signature fails to stamp its halo plan ``compiled: True`` —
+the CI ``mesh-serve-gate``'s teeth.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+from typing import Optional
+
+#: the modeled-surface constants (stated in every payload)
+SERVE_SCALING_MODEL = "heat2d-tpu/serve-scaling-model/v1"
+MODEL_LAUNCH_OVERHEAD_S = 1e-3
+MODEL_COLLECTIVE_TAX_S = 2e-4
+MODEL_PER_CHIP_MCELLS_PER_S = 1000.0
+
+
+def modeled_launch_s(member_cells: float, capacity: int,
+                     n_devices: int,
+                     per_chip_cells_per_s: float) -> float:
+    """Modeled wall time of one full-capacity launch: per-chip local
+    members advance in parallel; multi-chip meshes pay a collective
+    tax (dispatch + the batch axis's gather)."""
+    local = -(-capacity // n_devices)
+    t = MODEL_LAUNCH_OVERHEAD_S + local * member_cells \
+        / per_chip_cells_per_s
+    if n_devices > 1:
+        t += MODEL_COLLECTIVE_TAX_S
+    return t
+
+
+def _reqs(nx, ny, steps, n, method="jnp", base=0.05):
+    from heat2d_tpu.serve.schema import SolveRequest
+
+    return [SolveRequest(nx=nx, ny=ny, steps=steps, method=method,
+                         cx=base + 0.01 * i, cy=0.1) for i in range(n)]
+
+
+def _parity_rungs(mesh_engine, single_engine, nx, ny, steps,
+                  method, rungs) -> list:
+    """Serve every occupancy rung through BOTH engines; byte-compare
+    each member. Returns the per-rung report (all must be True)."""
+    import numpy as np
+
+    out = []
+    for n in rungs:
+        reqs = _reqs(nx, ny, steps, n, method=method,
+                     base=0.05 + 0.001 * n)
+        got = mesh_engine.solve_batch(reqs)
+        want = single_engine.solve_batch(reqs)
+        ok = all(
+            np.asarray(g[0]).tobytes() == np.asarray(w[0]).tobytes()
+            and g[1] == w[1]
+            for g, w in zip(got, want))
+        out.append({"occupancy": n, "bitwise": bool(ok)})
+    return out
+
+
+def _wall_rate(engine, nx, ny, steps, method, capacity,
+               launches: int = 3) -> float:
+    """Measured requests/s of warm full-capacity launches."""
+    reqs = _reqs(nx, ny, steps, capacity, method=method, base=0.3)
+    engine.solve_batch(reqs)                   # warm (compile)
+    t0 = time.monotonic()
+    for i in range(launches):
+        engine.solve_batch(_reqs(nx, ny, steps, capacity,
+                                 method=method, base=0.4 + 0.01 * i))
+    dt = max(time.monotonic() - t0, 1e-9)
+    return launches * capacity / dt
+
+
+def measure_serve_scaling(n_devices: Optional[int] = None,
+                          nx: int = 48, ny: int = 64, steps: int = 8,
+                          method: str = "jnp",
+                          per_chip_mcells_per_s: Optional[float] = None,
+                          wall: bool = True) -> dict:
+    """One serve strong-scaling measurement (module docstring).
+    Returns the ``kind="multichip"`` payload row."""
+    import jax
+
+    from heat2d_tpu.mesh.engine import MeshEnsembleEngine
+    from heat2d_tpu.mesh.scheduler import tuned_rate_mcells
+    from heat2d_tpu.serve.engine import EnsembleEngine
+
+    nd = n_devices or len(jax.devices())
+    single = EnsembleEngine(max_batch=8)
+    meshed = MeshEnsembleEngine(n_devices=nd)
+    rungs = sorted({1, 2, 3, 5, 8})
+    parity = _parity_rungs(meshed, single, nx, ny, steps, method,
+                           rungs)
+    cap_1, cap_n = 8, meshed.max_batch
+    on_tpu = jax.devices()[0].platform == "tpu"
+    rate = (per_chip_mcells_per_s
+            or tuned_rate_mcells(nx, ny)
+            or MODEL_PER_CHIP_MCELLS_PER_S)
+    cells = float(nx) * ny * steps
+    m1 = cap_1 / modeled_launch_s(cells, cap_1, 1, rate * 1e6)
+    mn = cap_n / modeled_launch_s(cells, cap_n, nd, rate * 1e6)
+    payload = {
+        "bench": "serve",
+        "n_devices": nd,
+        "grid": [nx, ny], "steps": steps, "method": method,
+        "max_batch_1chip": cap_1, "max_batch_nchip": cap_n,
+        "parity": all(r["bitwise"] for r in parity),
+        "parity_rungs": parity,
+        "rate_source": "wall" if on_tpu else "modeled",
+        "model": {
+            "name": SERVE_SCALING_MODEL,
+            "per_chip_mcells_per_s": rate,
+            "launch_overhead_s": MODEL_LAUNCH_OVERHEAD_S,
+            "collective_tax_s": MODEL_COLLECTIVE_TAX_S,
+        },
+        "modeled_rps_1chip": m1,
+        "modeled_rps_nchip": mn,
+        "modeled_scaling_efficiency": mn / (nd * m1),
+    }
+    if wall:
+        w1 = _wall_rate(single, nx, ny, steps, method, cap_1)
+        wn = _wall_rate(meshed, nx, ny, steps, method, cap_n)
+        payload.update(wall_rps_1chip=w1, wall_rps_nchip=wn,
+                       wall_scaling_efficiency=wn / (nd * w1))
+    eff_key = ("wall_scaling_efficiency" if on_tpu
+               else "modeled_scaling_efficiency")
+    payload["serve_scaling_efficiency"] = payload[eff_key]
+    return payload
+
+
+def measure_spatial_serve(n_devices: Optional[int] = None,
+                          nx: int = 48, ny: int = 64,
+                          steps: int = 8) -> dict:
+    """Serve one spatial-routed signature through the mesh engine
+    (the split forced via a 1-byte threshold so the leg runs on CI
+    grids) and prove the PR 7 socket closed: the halo plan stamps
+    ``compiled: True`` with the mesh shape, and the spatial results
+    are bitwise the single-chip engine's."""
+    import jax
+    import numpy as np
+
+    from heat2d_tpu.mesh.engine import MeshEnsembleEngine
+    from heat2d_tpu.mesh.scheduler import MeshScheduler
+    from heat2d_tpu.serve.engine import EnsembleEngine
+
+    nd = n_devices or len(jax.devices())
+    if nd < 2:
+        return {"bench": "serve_spatial", "skipped": "one_device"}
+    sched = MeshScheduler(n_devices=nd, spatial_bytes_threshold=1)
+    meshed = MeshEnsembleEngine(n_devices=nd, scheduler=sched)
+    single = EnsembleEngine(max_batch=8)
+    reqs = _reqs(nx, ny, steps, 3, base=0.07)
+    got = meshed.solve_batch(reqs)
+    want = single.solve_batch(reqs)
+    parity = all(
+        np.asarray(g[0]).tobytes() == np.asarray(w[0]).tobytes()
+        for g, w in zip(got, want))
+    sig = reqs[0].signature()
+    plan = meshed.halo_plans.get(sig) or {}
+    decision = meshed.scheduler.decide(reqs[0])
+    return {
+        "bench": "serve_spatial",
+        "n_devices": nd, "grid": [nx, ny], "steps": steps,
+        "route": decision["route"],
+        "parity": bool(parity),
+        "halo_plan": {k: (list(v) if isinstance(v, tuple) else v)
+                      for k, v in plan.items()},
+        "compiled": bool(plan.get("compiled")),
+    }
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="heat2d-tpu-mesh",
+        description="bench_serve: mesh-serving strong scaling + "
+                    "bitwise parity gate (docs/SCALING.md)")
+    p.add_argument("--devices", type=int, default=None)
+    p.add_argument("--nx", type=int, default=48)
+    p.add_argument("--ny", type=int, default=64)
+    p.add_argument("--steps", type=int, default=8)
+    p.add_argument("--method", default="jnp")
+    p.add_argument("--min-efficiency", type=float, default=0.75,
+                   help="gate: serve_scaling_efficiency floor "
+                        "(0.75 at 8 chips == 6x)")
+    p.add_argument("--no-spatial", action="store_true",
+                   help="skip the spatial-route leg")
+    p.add_argument("--no-wall", action="store_true",
+                   help="skip wall-clock rates (parity + model only)")
+    p.add_argument("--out", default=None, metavar="JSON",
+                   help="write the kind='multichip' run record here")
+    args = p.parse_args(argv)
+
+    from heat2d_tpu.parallel.scaling import scaling_record
+
+    failures = []
+    payloads = [measure_serve_scaling(
+        n_devices=args.devices, nx=args.nx, ny=args.ny,
+        steps=args.steps, method=args.method, wall=not args.no_wall)]
+    row = payloads[0]
+    print(f"bench_serve: {row['n_devices']} devices, parity="
+          f"{row['parity']}, {row['rate_source']} efficiency "
+          f"{row['serve_scaling_efficiency']:.3f} "
+          f"({row['serve_scaling_efficiency'] * row['n_devices']:.1f}x"
+          f" at {row['n_devices']} chips)")
+    if not row["parity"]:
+        failures.append(f"mesh-vs-single-chip parity broke: "
+                        f"{row['parity_rungs']}")
+    if row["serve_scaling_efficiency"] < args.min_efficiency:
+        failures.append(
+            f"serve scaling efficiency "
+            f"{row['serve_scaling_efficiency']:.3f} < "
+            f"--min-efficiency {args.min_efficiency}")
+    if not args.no_spatial:
+        sp = measure_spatial_serve(n_devices=args.devices,
+                                   nx=args.nx, ny=args.ny,
+                                   steps=args.steps)
+        payloads.append(sp)
+        if sp.get("skipped"):
+            print(f"bench_serve spatial: SKIP ({sp['skipped']})")
+        else:
+            print(f"bench_serve spatial: route={sp['route']} "
+                  f"compiled={sp['compiled']} parity={sp['parity']}")
+            if not sp["parity"]:
+                failures.append("spatial route parity broke")
+            if sp["route"] != "spatial" or not sp["compiled"]:
+                failures.append(
+                    "spatial signature did not compile a mesh "
+                    f"program: {sp}")
+    scaling_record(payloads, args.out)
+    for f in failures:
+        print(f"FAIL: {f}", file=sys.stderr)
+    print("bench_serve " + ("FAILED" if failures else "passed"))
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,234 @@
+"""``MeshEnsembleEngine`` — the mesh-aware serve engine.
+
+Drop-in for ``serve.engine.EnsembleEngine`` (the server takes either
+through its ``engine=`` socket): same ``solve_batch`` contract, same
+launch accounting, but each bucket routes through the mesh scheduler:
+
+- **batch** buckets launch the mesh-sharded runner
+  (``mesh/runner.py``) at a device-multiple capacity — the padded
+  ensemble axis sharded ``P('batch')`` over every chip;
+- **spatial** buckets launch the memoized batch x spatial program
+  (``ensemble.spatial_batch_runner``) through the fused-halo route —
+  and the signature's pre-resolved halo plan (PR 7's
+  ``compiled: False`` socket) is finally stamped ``compiled: True``
+  with the mesh shape, because the mesh program really built;
+- **single** buckets (1-device processes, non-solve kinds,
+  ``tier="unplannable"`` shapes) fall through to the inherited
+  single-chip path with a ``mesh_fallback_total{reason}`` counter —
+  served, never rejected (the totality contract).
+
+Results are bitwise-identical to the single-chip engine's on every
+route and every occupancy rung — per-member trajectories are
+independent of batch composition and of where the members sit (the
+correctness anchor the CI ``mesh-serve-gate`` asserts; the spatial
+route's fused-vs-collective bitwise equality is PR 7's proven
+contract).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+from heat2d_tpu.resil import chaos
+from heat2d_tpu.serve.engine import EnsembleEngine
+
+
+class MeshEnsembleEngine(EnsembleEngine):
+    """Mesh-aware ensemble engine (module docstring).
+
+    ``max_batch`` is the TOTAL per-launch bound; it defaults to
+    ``max_batch_per_chip * n_devices`` (more chips amortize bigger
+    buckets — callers with a per-chip budget, e.g. the CLIs'
+    ``--max-batch``, pass it as ``max_batch_per_chip`` so the
+    operator's bound scales with the mesh instead of being silently
+    replaced) and is rounded up to a device multiple so
+    ``mesh_capacity``'s cap can never undercut a full bucket.
+    ``scheduler`` defaults to a ``MeshScheduler`` over the same
+    devices; pass one explicitly to share its demand window with a
+    router."""
+
+    def __init__(self, registry=None, max_batch: Optional[int] = None,
+                 n_devices: Optional[int] = None, halo: str = "fused",
+                 scheduler=None, max_batch_per_chip: int = 8):
+        from heat2d_tpu.mesh.runner import attached_devices
+        from heat2d_tpu.mesh.scheduler import MeshScheduler
+
+        nd = len(attached_devices(n_devices))
+        if max_batch is None:
+            max_batch = max(1, max_batch_per_chip) * nd
+        max_batch = -(-max_batch // nd) * nd
+        self.scheduler = (scheduler if scheduler is not None
+                          else MeshScheduler(n_devices=nd,
+                                             registry=registry,
+                                             halo=halo))
+        self.n_devices = nd
+        # spatial_grid arms the inherited per-signature halo-plan
+        # pre-resolve (EnsembleEngine._preresolve_tuned) on multi-chip
+        # meshes; this engine flips the stamp when the spatial program
+        # actually compiles.
+        super().__init__(
+            registry=registry, max_batch=max_batch,
+            spatial_grid=(self.scheduler.spatial_grid()
+                          if nd > 1 else None),
+            halo=halo)
+        #: signature -> memoized spatial runner (built on first
+        #: spatial launch; the build IS the mesh compile)
+        self._spatial_runners: dict = {}
+
+    # -- dispatch ------------------------------------------------------ #
+
+    def solve_batch(self, requests) -> List[Tuple["object", int]]:
+        req0 = requests[0]
+        decision = self.scheduler.decide(req0)
+        route = decision["route"]
+        if route == "batch":
+            return self._solve_batch_mesh(requests, decision)
+        if route == "spatial":
+            return self._solve_spatial(requests, decision)
+        # single-chip fallback: the inherited path, launch row tagged
+        # with the fallback reason — served, never rejected.
+        if self.registry is not None:
+            self.registry.counter("mesh_fallback_total",
+                                  reason=decision.get("reason",
+                                                      "unknown"))
+        out = super().solve_batch(requests)
+        self._tag_launch(decision)
+        return out
+
+    def _tag_launch(self, decision, capacity=None) -> None:
+        row = self.launch_log[-1]
+        row["mesh"] = {"route": decision["route"],
+                       "reason": decision.get("reason"),
+                       "n_devices": self.n_devices}
+        if capacity is not None:
+            row["mesh"]["capacity"] = capacity
+        if self.registry is not None:
+            self.registry.counter("mesh_launches_total",
+                                  route=decision["route"])
+
+    # -- batch-axis route ---------------------------------------------- #
+
+    def _solve_batch_mesh(self, requests,
+                          decision) -> List[Tuple["object", int]]:
+        chaos.launch_point()
+        import contextlib
+
+        import numpy as np
+
+        from heat2d_tpu.mesh.runner import (mesh_batch_runner,
+                                            mesh_capacity)
+        from heat2d_tpu.models import ensemble
+
+        req0 = requests[0]
+        tuned = self._preresolve_tuned(req0)
+        n = len(requests)
+        capacity = mesh_capacity(n, self.max_batch, self.n_devices)
+        cxs = [r.cx for r in requests]
+        cys = [r.cy for r in requests]
+        # Pad members replicate the LAST real member (the single-chip
+        # padding contract: an inert twin, bitwise the same trajectory)
+        # up to a device-multiple capacity so the batch axis shards.
+        cxs += [cxs[-1]] * (capacity - n)
+        cys += [cys[-1]] * (capacity - n)
+        cxs, cys, u0 = ensemble._validated_batch(
+            req0.nx, req0.ny, cxs, cys, None)
+        interval, sensitivity = req0.schedule()
+        runner = mesh_batch_runner(
+            req0.nx, req0.ny, req0.steps, req0.method,
+            convergence=req0.convergence, interval=interval,
+            sensitivity=sensitivity, n_devices=self.n_devices)
+        timer = (self.registry.timer("serve_launch_s")
+                 if self.registry is not None
+                 else contextlib.nullcontext())
+        with timer:
+            out = runner(u0, cxs, cys)
+            if req0.convergence:
+                u, steps_done = out
+                u = np.asarray(u)
+                steps_done = [int(k) for k in np.asarray(steps_done)]
+            else:
+                u = np.asarray(out)
+                steps_done = [req0.steps] * capacity
+        self._account(req0, n, capacity, tuned, decision)
+        return [(u[i], steps_done[i]) for i in range(n)]
+
+    # -- spatial route ------------------------------------------------- #
+
+    def _spatial_runner(self, req0, decision):
+        from heat2d_tpu.models import ensemble
+
+        sig = req0.signature()
+        runner = self._spatial_runners.get(sig)
+        if runner is not None:
+            return runner
+        gx, gy = decision["spatial_grid"]
+        interval, sensitivity = req0.schedule()
+        runner = ensemble.spatial_batch_runner(
+            req0.nx, req0.ny, req0.steps, gx, gy,
+            convergence=req0.convergence, interval=interval,
+            sensitivity=sensitivity, halo=self.halo,
+            n_devices=self.n_devices)
+        self._spatial_runners[sig] = runner
+        # The PR 7 socket, closed: the plan row finally records that
+        # the mesh program actually built (and on what mesh).
+        plan = self.halo_plans.get(sig)
+        if plan is not None:
+            plan["compiled"] = True
+            plan["mesh"] = (gx, gy)
+            plan["local_batch"] = runner.nb
+        if self.registry is not None:
+            self.registry.counter("mesh_spatial_compiled_total")
+        return runner
+
+    def _solve_spatial(self, requests,
+                       decision) -> List[Tuple["object", int]]:
+        chaos.launch_point()
+        import contextlib
+
+        import numpy as np
+
+        from heat2d_tpu.mesh.runner import mesh_capacity
+        from heat2d_tpu.models import ensemble
+
+        req0 = requests[0]
+        tuned = self._preresolve_tuned(req0)
+        runner = self._spatial_runner(req0, decision)
+        n = len(requests)
+        # Capacity ladder over the LOCAL batch unit: one spatial wave
+        # advances nb members (one per submesh row), so capacities are
+        # nb multiples — same O(log max_batch) discipline.
+        capacity = mesh_capacity(n, self.max_batch, runner.nb)
+        cxs = [r.cx for r in requests]
+        cys = [r.cy for r in requests]
+        cxs += [cxs[-1]] * (capacity - n)
+        cys += [cys[-1]] * (capacity - n)
+        cxs, cys, u0 = ensemble._validated_batch(
+            req0.nx, req0.ny, cxs, cys, None)
+        timer = (self.registry.timer("serve_launch_s")
+                 if self.registry is not None
+                 else contextlib.nullcontext())
+        with timer:
+            u, k = runner(u0, cxs, cys)
+            u = np.asarray(u)
+            steps_done = [int(s) for s in np.asarray(k)]
+        self._account(req0, n, capacity, tuned, decision)
+        return [(u[i], steps_done[i]) for i in range(n)]
+
+    # -- shared accounting --------------------------------------------- #
+
+    def _account(self, req0, n, capacity, tuned, decision) -> None:
+        """The inherited launch bookkeeping (launch_log / first_launch
+        / serve metrics), shared by both mesh routes."""
+        self.launches += 1
+        compile_key = (req0.signature(), capacity, decision["route"])
+        first_launch = compile_key not in self._launched
+        self._launched.add(compile_key)
+        row = {"signature": req0.signature(), "occupancy": n,
+               "capacity": capacity, "tuned_config": tuned,
+               "first_launch": first_launch}
+        if self.spatial_grid is not None:
+            row["halo_plan"] = self.halo_plans.get(req0.signature())
+        self.launch_log.append(row)
+        if self.registry is not None:
+            self.registry.counter("serve_launches_total")
+        self._tag_launch(decision, capacity=capacity)

@@ -1,0 +1,118 @@
+"""The mesh-sharded batch runner — ``ensemble.batch_runner``'s twin
+with the padded member axis sharded over every attached chip.
+
+The GSPMD pattern (SNIPPETS.md [2]/[3]): build a named 1D mesh over
+the devices, place the ``(B, nx, ny)`` batch (and the per-member
+diffusivity vectors) with ``NamedSharding(P('batch'))``, and jit ONE
+program — each device advances its local members through the same
+single-chip kernel paths (``shard_map`` over the batch axis, so the
+Pallas routes work unchanged; the batch axis has no cross-member math
+to collectivize on the fixed-step paths, and convergence early-exit
+stays device-local exactly like ``run_ensemble_sharded``).
+
+Two contracts carry over from the single-chip runner, both tested:
+
+- **Bitwise parity.** Per-member trajectories are independent of
+  batch composition (the property the single-chip padding design
+  already relies on: a pad member is an inert replica), so the mesh
+  runner's cropped results are bitwise-identical to the single-chip
+  ``batch_runner``'s at every occupancy rung.
+- **The compile ladder.** Capacities pad to the next power of two AND
+  to a device multiple (an uneven batch axis cannot shard), so a
+  signature compiles one program per distinct capacity in
+  ``{nd, 2*nd, 4*nd, ...} ∩ [nd, max_batch]`` — at most
+  ``log2(max_batch) + 1`` programs, the same O(log max_batch) bound
+  the recompile sentinel gates (``analysis/recompile.py``).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+
+def attached_devices(n_devices: Optional[int] = None) -> list:
+    """The first ``n_devices`` attached devices (all, when None)."""
+    import jax
+
+    devices = list(jax.devices())
+    return devices[:n_devices] if n_devices else devices
+
+
+def mesh_capacity(n: int, max_batch: int, n_devices: int) -> int:
+    """Padded launch capacity for ``n`` members on an ``n_devices``
+    mesh: next power of two >= n, rounded up to a device multiple (an
+    uneven batch axis cannot shard; the mesh always holds at least one
+    member per device — inert replicas, like every pad), capped at the
+    largest device multiple <= ``max_batch`` (``MeshEnsembleEngine``
+    keeps its max_batch a device multiple, so the cap never undercuts
+    a bucket)."""
+    if n_devices < 1:
+        raise ValueError(f"n_devices must be >= 1, got {n_devices}")
+    cap = max_batch - max_batch % n_devices or n_devices
+    p = 1
+    while p < n:
+        p *= 2
+    p = -(-p // n_devices) * n_devices     # device multiple
+    return max(min(p, cap), -(-n // n_devices) * n_devices)
+
+
+@functools.lru_cache(maxsize=128)
+def mesh_batch_runner(nx: int, ny: int, steps: int, method: str = "auto",
+                      convergence: bool = False, interval: int = 20,
+                      sensitivity: float = 0.1,
+                      n_devices: Optional[int] = None):
+    """The per-(signature, mesh) COMPILE-CACHED mesh-sharded runner: a
+    ``(u0, cxs, cys) -> batch`` (fixed-step) or ``-> (batch,
+    steps_done)`` (convergence) callable whose batch axis is sharded
+    ``NamedSharding(P('batch'))`` over the first ``n_devices`` attached
+    devices. Memoized like ``ensemble.batch_runner`` so steady-state
+    traffic on a warm signature never retraces; callers pad the batch
+    to a ``mesh_capacity`` (a device multiple) before launching.
+
+    The returned callable exposes ``n_devices`` / ``method`` for
+    launch-record provenance.
+    """
+    import jax
+    import numpy as np
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    from heat2d_tpu.models import ensemble
+    from heat2d_tpu.parallel.mesh import shard_map_compat
+
+    method = ensemble._pick_method(method, nx, ny)
+    devices = attached_devices(n_devices)
+    nd = len(devices)
+    mesh = Mesh(np.asarray(devices), ("batch",))
+    if convergence:
+        local = ensemble._conv_runner(method, steps, interval,
+                                      sensitivity)
+    else:
+        local = functools.partial(ensemble._BATCH_RUNNERS[method],
+                                  steps=steps)
+    mapped = shard_map_compat(local, mesh, in_specs=P("batch"),
+                              out_specs=P("batch"), check_vma=False)
+    # A stable name, like batch_runner's: compile logs / the recompile
+    # sentinel attribute every mesh compile to this runner (host-side
+    # metadata only — the traced program is unchanged).
+    try:
+        mapped.__name__ = f"mesh_batch_runner_{method}"
+    except (AttributeError, TypeError):
+        pass
+    jitted = jax.jit(mapped)
+    sharding = NamedSharding(mesh, P("batch"))
+
+    def run(u0, cxs, cys):
+        if u0.shape[0] % nd:
+            raise ValueError(
+                f"mesh batch axis {u0.shape[0]} is not a multiple of "
+                f"the {nd}-device mesh — pad with mesh_capacity first")
+        u0 = jax.device_put(u0, sharding)
+        cxs = jax.device_put(cxs, sharding)
+        cys = jax.device_put(cys, sharding)
+        return jitted(u0, cxs, cys)
+
+    run.n_devices = nd
+    run.method = method
+    run.jitted = jitted      # the traced program (jaxpr pins)
+    return run

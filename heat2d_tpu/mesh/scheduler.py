@@ -1,0 +1,288 @@
+"""The mesh scheduler — batch-vs-spatial split per signature bucket,
+and admission control on MODELED mesh capacity.
+
+Two separable concerns live here, both host-side pure-ish math (no
+jax on the decision path — devices are only counted):
+
+- ``MeshScheduler.decide(req0)`` — ONE routing decision per serve
+  signature, memoized like every other per-signature pre-resolve
+  (tuned band config, halo plan):
+
+  * **batch** — many-small-request traffic: the member fits a chip
+    comfortably, so the win is throughput — shard the padded member
+    axis over the whole mesh (``mesh/runner.py``).
+  * **spatial** — huge-grid traffic: the member's working set exceeds
+    the per-chip VMEM envelope (``spatial_bytes_threshold``, default
+    the live per-chip VMEM total — past it a single chip must
+    band-stream from HBM), so the win is latency — decompose each
+    member over a near-square submesh through the proven fused-halo
+    route (``spatial_halo_plan``, PR 7's kernel-F/overlap tiers).
+  * **single** — everything the mesh cannot take: 1-device processes,
+    non-solve request kinds, and ``tier="unplannable"`` shapes (the
+    PR 7 totality contract: the plan resolve never fails a request
+    the single-chip runner serves fine) — recorded as
+    ``mesh_fallback_total{reason}`` and served by the single-chip
+    engine, never rejected.
+
+  The decision row carries the tune db's per-device-kind answer
+  (measured Mcells/s for the shape, when one exists) and the current
+  per-signature demand (a ``CounterDeltas`` window over the serve /
+  fleet ``*_signature_requests_total`` families — the same primitive
+  the control plane's retuner uses) so launch records and the
+  capacity model see WHY a route was picked.
+
+- ``MeshAdmission`` — the breaker sheds on repeated failures and the
+  batcher on queue depth; neither knows the mesh is saturated until
+  latency collapses. This models it instead: every admitted solve
+  charges its cell-update work (``nx * ny * steps`` — the convergence
+  budget is an upper bound, conservative the right way) to a sliding
+  window, and a leader whose work would push the windowed offered
+  rate past ``headroom x`` the modeled mesh capacity (chips x
+  per-chip rate, tune-db-informed) is shed with
+  ``Rejected("mesh_saturated")`` BEFORE it queues. Cache hits and
+  coalesced followers never reach it (they cost no launch), matching
+  the breaker's shed-compute-not-answers contract.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Optional
+
+from heat2d_tpu.analysis.locks import AuditedLock
+from heat2d_tpu.serve.schema import Rejected
+
+#: default per-chip serve rate for the admission model when the tune
+#: db holds no measured rate for the device kind — deliberately
+#: conservative (a v5e measures ~2.2e5 Mcells/s on the saturated
+#: kernel, a CPU worker orders of magnitude less; an overestimate
+#: would never shed).
+DEFAULT_PER_CHIP_MCELLS_PER_S = 500.0
+
+
+def grid_bytes(nx: int, ny: int, itemsize: int = 4) -> int:
+    """One member's grid bytes — the resource model's unit."""
+    return int(nx) * int(ny) * itemsize
+
+
+def _per_chip_vmem_bytes() -> int:
+    """The live per-chip VMEM total the split threshold defaults to
+    (the same detection every kernel planner uses)."""
+    from heat2d_tpu.ops import pallas_stencil as ps
+
+    return ps._vmem_total()[0]
+
+
+def tuned_rate_mcells(nx: int, ny: int,
+                      dtype: str = "float32") -> Optional[float]:
+    """The tune db's measured Mcells/s for this shape on THIS device
+    kind (``tune.runtime.measured_rate`` — the same lookup ladder as
+    every config consult), or None — the admission model's per-chip
+    rate source."""
+    from heat2d_tpu.tune import runtime as tune_runtime
+
+    return tune_runtime.measured_rate(nx, ny, dtype)
+
+
+class MeshScheduler:
+    """Per-signature routing decisions over an ``n_devices`` mesh.
+
+    ``demand_source``: optional ``(registry, prefix)`` pair naming the
+    per-signature request counters demand is read from (the router's
+    ``fleet_signature_requests_total`` fleet-side, the server's
+    ``serve_signature_requests_total`` in-process). ``halo`` is the
+    spatial route's requested halo (default "fused" — the proven
+    overlap route; degradation is the plan's job, not the
+    scheduler's)."""
+
+    def __init__(self, n_devices: Optional[int] = None, registry=None,
+                 halo: str = "fused",
+                 spatial_bytes_threshold: Optional[int] = None,
+                 demand_source=None):
+        from heat2d_tpu.mesh.runner import attached_devices
+        from heat2d_tpu.obs.metrics import CounterDeltas
+
+        self.n_devices = len(attached_devices(n_devices))
+        self.registry = registry
+        self.halo = halo
+        self.spatial_bytes_threshold = (
+            _per_chip_vmem_bytes() if spatial_bytes_threshold is None
+            else int(spatial_bytes_threshold))
+        self.demand_source = demand_source
+        self._deltas = CounterDeltas()
+        self._decisions: dict = {}
+        self._lock = AuditedLock("mesh.scheduler")
+
+    # -- demand -------------------------------------------------------- #
+
+    def _demand(self, sig_str: str) -> Optional[float]:
+        """Requests seen for this signature since the last decision
+        tick (a window, not a cumulative count), or None without a
+        demand source."""
+        if self.demand_source is None:
+            return None
+        registry, prefix = self.demand_source
+        if registry is None:
+            return None
+        total = 0.0
+        for k, d in self._deltas.tick(
+                registry, prefix + "_signature_requests_total").items():
+            if dict(k).get("signature") == sig_str:
+                total += d
+        return total
+
+    # -- the split ----------------------------------------------------- #
+
+    def spatial_grid(self) -> tuple:
+        """The near-square submesh each spatial member decomposes
+        over — the whole mesh (one member in flight at a time is the
+        latency-optimal shape for huge grids)."""
+        from heat2d_tpu.parallel.scaling import square_mesh
+
+        return square_mesh(self.n_devices)
+
+    def decide(self, req0) -> dict:
+        """The memoized routing decision for ``req0``'s signature."""
+        sig = req0.signature()
+        with self._lock:
+            hit = self._decisions.get(sig)
+        if hit is not None:
+            return hit
+        d = self._decide(req0)
+        with self._lock:
+            d = self._decisions.setdefault(sig, d)
+        if self.registry is not None:
+            self.registry.counter("mesh_route_total", route=d["route"])
+        return d
+
+    def _decide(self, req0) -> dict:
+        bytes_ = grid_bytes(req0.nx, req0.ny)
+        out = {
+            "signature": str(req0.signature()),
+            "n_devices": self.n_devices,
+            "member_bytes": bytes_,
+            "spatial_bytes_threshold": self.spatial_bytes_threshold,
+            "demand": self._demand(str(req0.signature())),
+            "tuned_mcells_per_s": tuned_rate_mcells(
+                req0.nx, req0.ny, getattr(req0, "dtype", "float32")),
+        }
+        if getattr(req0, "request_kind", "solve") != "solve":
+            return dict(out, route="single", reason="request_kind")
+        if self.n_devices < 2:
+            return dict(out, route="single", reason="one_device")
+        if bytes_ <= self.spatial_bytes_threshold:
+            return dict(out, route="batch", reason="fits_chip",
+                        spatial_grid=None)
+        from heat2d_tpu.models import ensemble
+
+        gx, gy = self.spatial_grid()
+        plan = ensemble.spatial_halo_plan(req0.nx, req0.ny, gx, gy,
+                                          halo=self.halo)
+        if plan.get("tier") == "unplannable":
+            # The PR 7 totality contract, followed through: shapes the
+            # decomposition cannot take are SERVED (single-chip), not
+            # rejected — the fallback is a counter, never an error.
+            return dict(out, route="single", reason="unplannable",
+                        plan=plan)
+        return dict(out, route="spatial", reason="exceeds_chip",
+                    spatial_grid=(gx, gy), plan=plan)
+
+    def decisions(self) -> dict:
+        """signature -> decision row (a copy; run-record provenance)."""
+        with self._lock:
+            return dict(self._decisions)
+
+
+class MeshAdmission:
+    """Modeled-saturation admission control (module docstring).
+
+    ``clock`` is injectable so shedding scenarios are deterministic on
+    any host speed (the ``resil/retry.Watchdog`` pattern)."""
+
+    def __init__(self, n_devices: Optional[int] = None, registry=None,
+                 per_chip_mcells_per_s: Optional[float] = None,
+                 window_s: float = 2.0, headroom: float = 1.25,
+                 clock=None):
+        from heat2d_tpu.mesh.runner import attached_devices
+
+        if window_s <= 0:
+            raise ValueError(f"window_s must be > 0, got {window_s}")
+        if headroom <= 0:
+            raise ValueError(f"headroom must be > 0, got {headroom}")
+        self.n_devices = len(attached_devices(n_devices))
+        self.registry = registry
+        self.per_chip_mcells_per_s = per_chip_mcells_per_s
+        self.window_s = window_s
+        self.headroom = headroom
+        self.clock = clock if clock is not None else time.monotonic
+        self._window: list = []     # (t, cells) of admitted work
+        self._lock = AuditedLock("mesh.admission")
+
+    # -- the model ----------------------------------------------------- #
+
+    @staticmethod
+    def work_cells(req) -> float:
+        """Cell updates one request costs the mesh: nx * ny * steps.
+        A convergence run may exit early — charging the budget is the
+        conservative direction for admission (never under-shed)."""
+        return float(req.nx) * float(req.ny) * float(max(req.steps, 1))
+
+    def capacity_cells_per_s(self, req=None) -> float:
+        """Modeled mesh capacity: chips x per-chip rate. The rate is,
+        in order: the constructor's explicit rate, the tune db's
+        measured rate for the request's shape on this device kind, the
+        conservative default."""
+        rate = self.per_chip_mcells_per_s
+        if rate is None and req is not None:
+            rate = tuned_rate_mcells(req.nx, req.ny,
+                                     getattr(req, "dtype", "float32"))
+        if rate is None:
+            rate = DEFAULT_PER_CHIP_MCELLS_PER_S
+        return rate * 1e6 * self.n_devices
+
+    # -- admission ----------------------------------------------------- #
+
+    def admit(self, req) -> Optional[Rejected]:
+        """Charge ``req`` to the window, or return the structured
+        rejection (``Rejected("mesh_saturated")``) WITHOUT charging —
+        shed work must not consume the capacity it was refused.
+
+        Non-solve request kinds (inverse optimizations) pass through
+        unpriced: the scheduler routes them OFF the mesh (single-chip,
+        their own dispatch lane), so they consume no mesh capacity —
+        and ``work_cells`` would under-charge an iterations-long
+        optimization loop by orders of magnitude anyway. Their own
+        lane's deadline/breaker plumbing bounds them."""
+        if getattr(req, "request_kind", "solve") != "solve":
+            return None
+        now = self.clock()
+        work = self.work_cells(req)
+        capacity = self.capacity_cells_per_s(req)
+        limit = capacity * self.headroom * self.window_s
+        with self._lock:
+            cut = now - self.window_s
+            self._window = [(t, w) for t, w in self._window if t > cut]
+            pending = sum(w for _, w in self._window)
+            ok = pending + work <= limit
+            if ok:
+                self._window.append((now, work))
+            offered = (pending + work) / self.window_s
+        self._emit(offered, capacity, shed=not ok)
+        if ok:
+            return None
+        return Rejected(
+            "mesh_saturated",
+            f"modeled mesh saturation: offered {offered:.3g} cells/s "
+            f"over a {self.window_s}s window exceeds {self.headroom}x "
+            f"the modeled {capacity:.3g} cells/s mesh capacity "
+            f"({self.n_devices} chips)",
+            offered_cells_per_s=offered,
+            capacity_cells_per_s=capacity)
+
+    def _emit(self, offered: float, capacity: float, shed: bool) -> None:
+        if self.registry is None:
+            return
+        self.registry.gauge("mesh_offered_cells_per_s", offered)
+        self.registry.gauge("mesh_capacity_cells_per_s", capacity)
+        if shed:
+            self.registry.counter("mesh_admission_shed_total")

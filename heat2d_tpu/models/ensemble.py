@@ -35,6 +35,7 @@ a single launch.
 from __future__ import annotations
 
 import functools
+import types
 
 import jax
 import jax.numpy as jnp
@@ -1013,11 +1014,82 @@ def _build_spatial(nx, ny, steps, gridx, gridy, u0, cxs, cys, devices,
     mapped = shard_map_compat(
         local, mesh, in_specs=(P("b", "x", "y"), P("b"), P("b")),
         out_specs=(P("b", "x", "y"), P("b")), check_vma=False)
+    # A stable name (the batch_runner convention): compile logs and
+    # the recompile sentinel attribute spatial serve compiles to this
+    # runner. Host-side metadata only.
+    try:
+        mapped.__name__ = "spatial_batch_runner"
+    except (AttributeError, TypeError):
+        pass
     u0 = jax.device_put(u0, NamedSharding(mesh, P("b", "x", "y")))
     bsh = NamedSharding(mesh, P("b"))
     cxs = jax.device_put(cxs, bsh)
     cys = jax.device_put(cys, bsh)
-    return jax.jit(mapped), (u0, cxs, cys), b
+    meta = types.SimpleNamespace(mesh=mesh, nb=nb, pnx=pnx, pny=pny,
+                                 spatial=spatial)
+    return jax.jit(mapped), (u0, cxs, cys), b, meta
+
+
+@functools.lru_cache(maxsize=64)
+def spatial_batch_runner(nx: int, ny: int, steps: int, gridx: int,
+                         gridy: int, convergence: bool = False,
+                         interval: int = 20, sensitivity: float = 0.1,
+                         halo: str = "fused", halo_depth=None,
+                         n_devices=None):
+    """The per-signature COMPILE-CACHED batch x spatial runner — the
+    serve twin of ``batch_runner`` for members decomposed over a
+    (gridx, gridy) submesh (the mesh-aware engine's spatial route,
+    heat2d_tpu/mesh). The 3-axis program is built ONCE per signature
+    (the jitted shard_map is shape-polymorphic over the batch axis —
+    the capacity ladder's compile discipline is the caller's, exactly
+    like the single-chip runner); each call pads the batch to a local-
+    batch multiple with inert members, places the operands on the
+    mesh, and crops on return. Returns ``run(u0, cxs, cys) -> (u, k)``
+    with ``run.nb`` (members resident per launch wave) and ``run.meta``
+    exposed for launch-record provenance."""
+    spatial = gridx * gridy
+    devices = list(jax.devices())
+    if n_devices:
+        devices = devices[:n_devices]
+    nb = len(devices) // spatial
+    if nb < 1:
+        raise ValueError(
+            f"spatial_batch_runner needs gridx*gridy = {spatial} "
+            f"devices; have {len(devices)}")
+    # The program is independent of the batch contents: build it from
+    # a representative nb-member batch (the dummy placement is the one
+    # build-time cost; launches reuse fn + meta forever).
+    dummy_u = jnp.zeros((nb, nx, ny), jnp.float32)
+    dummy_c = jnp.zeros((nb,), jnp.float32)
+    fn, _args, _b, meta = _build_spatial(
+        nx, ny, steps, gridx, gridy, dummy_u, dummy_c, dummy_c,
+        devices, convergence, interval, sensitivity,
+        halo_depth=halo_depth, halo=halo)
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    gsh = NamedSharding(meta.mesh, P("b", "x", "y"))
+    bsh = NamedSharding(meta.mesh, P("b"))
+
+    def run(u0, cxs, cys):
+        b = u0.shape[0]
+        pad = (-b) % meta.nb
+        if pad:       # inert members (cx=cy=0), cropped on return
+            cxs = jnp.concatenate([cxs, jnp.zeros((pad,), cxs.dtype)])
+            cys = jnp.concatenate([cys, jnp.zeros((pad,), cys.dtype)])
+            u0 = jnp.concatenate(
+                [u0, jnp.zeros((pad,) + u0.shape[1:], u0.dtype)],
+                axis=0)
+        if (meta.pnx, meta.pny) != (nx, ny):
+            u0 = jnp.pad(u0, ((0, 0), (0, meta.pnx - nx),
+                              (0, meta.pny - ny)))
+        u, k = fn(jax.device_put(u0, gsh), jax.device_put(cxs, bsh),
+                  jax.device_put(cys, bsh))
+        return u[:b, :nx, :ny], k[:b]
+
+    run.nb = meta.nb
+    run.meta = meta
+    run.jitted = fn
+    return run
 
 
 def run_ensemble_spatial(nx: int, ny: int, steps: int, cxs, cys,
@@ -1031,7 +1103,7 @@ def run_ensemble_spatial(nx: int, ny: int, steps: int, cxs, cys,
     composition test pins this (``halo="fused"`` included: the overlap
     route is bitwise-equal to the collective one)."""
     cxs, cys, u0 = _validated_batch(nx, ny, cxs, cys, u0)
-    fn, args, b = _build_spatial(
+    fn, args, b, _meta = _build_spatial(
         nx, ny, steps, gridx, gridy, u0, cxs, cys, devices,
         convergence, interval, sensitivity, halo_depth=halo_depth,
         halo=halo)
@@ -1059,7 +1131,7 @@ def timed_ensemble(nx: int, ny: int, steps: int, cxs, cys, u0=None,
     cxs, cys, u0 = _validated_batch(nx, ny, cxs, cys, u0)
     if spatial_grid is not None:
         gx, gy = spatial_grid
-        fn, args, b = _build_spatial(
+        fn, args, b, _meta = _build_spatial(
             nx, ny, steps, gx, gy, u0, cxs, cys, devices,
             convergence, interval, sensitivity, halo_depth=halo_depth,
             halo=halo)

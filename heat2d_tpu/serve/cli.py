@@ -56,6 +56,20 @@ def build_parser() -> argparse.ArgumentParser:
                    help="result-cache entries (content-addressed LRU)")
     s.add_argument("--timeout", type=float, default=30.0,
                    help="per-request queue timeout in seconds")
+    m = p.add_argument_group("mesh serving (docs/SERVING.md)")
+    m.add_argument("--mesh", action="store_true",
+                   help="serve through the mesh-aware engine "
+                        "(heat2d_tpu/mesh): buckets shard over every "
+                        "attached device on the batch axis, huge-grid "
+                        "signatures dispatch through the fused-halo "
+                        "spatial route, per-bucket split recorded; "
+                        "--max-batch then bounds members PER CHIP")
+    m.add_argument("--mesh-admission-mcells", type=float, default=None,
+                   metavar="R",
+                   help="with --mesh: arm modeled-capacity admission "
+                        "control at R Mcells/s per chip (default: "
+                        "admission off; the tune db's measured rate "
+                        "is consulted when armed without a rate)")
     p.add_argument("--metrics-out", default=None, metavar="PATH",
                    help="write telemetry JSONL (events + snapshot + the "
                         "kind='serve' run record)")
@@ -126,13 +140,32 @@ def _selftest_workload(client):
     return fired, failures
 
 
+def _mesh_kwargs(args, registry) -> dict:
+    """engine/admission kwargs for ``SolveServer`` when ``--mesh``:
+    the mesh-aware engine over every attached device, plus modeled-
+    capacity admission when a rate was given."""
+    if not args.mesh:
+        return {}
+    from heat2d_tpu.mesh import MeshAdmission, MeshEnsembleEngine
+    # --max-batch becomes the PER-CHIP bound: the engine's launch
+    # bound scales with the mesh instead of discarding the flag.
+    out = {"engine": MeshEnsembleEngine(
+        registry=registry, max_batch_per_chip=args.max_batch)}
+    if args.mesh_admission_mcells is not None:
+        out["admission"] = MeshAdmission(
+            registry=registry,
+            per_chip_mcells_per_s=args.mesh_admission_mcells)
+    return out
+
+
 def run_selftest(args, registry) -> int:
     from heat2d_tpu.serve.server import Client, SolveServer
 
     server = SolveServer(
         max_batch=args.max_batch, max_delay=max(args.max_delay, 0.05),
         max_queue=args.queue_depth, cache_size=args.cache_size,
-        default_timeout=args.timeout, registry=registry)
+        default_timeout=args.timeout, registry=registry,
+        **_mesh_kwargs(args, registry))
     with server:
         fired, failures = _selftest_workload(Client(server))
 
@@ -181,7 +214,8 @@ def run_requests(args, registry) -> int:
     server = SolveServer(
         max_batch=args.max_batch, max_delay=args.max_delay,
         max_queue=args.queue_depth, cache_size=args.cache_size,
-        default_timeout=args.timeout, registry=registry)
+        default_timeout=args.timeout, registry=registry,
+        **_mesh_kwargs(args, registry))
     rc = 0
     try:
         with server:
@@ -237,6 +271,17 @@ def _write_metrics(args, registry, server, extra=None) -> None:
         extra["trace"] = {"dir": args.trace_dir,
                           "spans_emitted": (t.spans_emitted
                                             if t is not None else 0)}
+    if getattr(server.engine, "scheduler", None) is not None:
+        # Mesh provenance (docs/SERVING.md): the per-signature split
+        # decisions and the halo plans — with the compiled stamp the
+        # spatial route flips when its mesh program really builds.
+        extra["mesh"] = {
+            "n_devices": server.engine.n_devices,
+            "decisions": list(
+                server.engine.scheduler.decisions().values()),
+            "halo_plans": {str(sig): plan for sig, plan
+                           in server.engine.halo_plans.items()},
+        }
     if not args.metrics_out:
         return
     from heat2d_tpu.obs.record import build_record
@@ -247,7 +292,8 @@ def _write_metrics(args, registry, server, extra=None) -> None:
             {"signature": list(map(str, row["signature"])),
              "occupancy": row["occupancy"],
              "capacity": row["capacity"],
-             "tuned_config": row.get("tuned_config")}
+             "tuned_config": row.get("tuned_config"),
+             **({"mesh": row["mesh"]} if "mesh" in row else {})}
             for row in server.engine.launch_log],
         # Per-signature tuned-config pre-resolve (docs/TUNING.md):
         # which signatures run measured kernel configs vs heuristics.

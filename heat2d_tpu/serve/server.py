@@ -63,7 +63,16 @@ class SolveServer:
                  registry=None, retry_policy: Optional[RetryPolicy] = None,
                  launch_deadline: Optional[float] = None,
                  breaker: Optional[DegradedMode] = None,
-                 deadline_clock=None):
+                 deadline_clock=None, engine=None, admission=None):
+        """``engine``: the solve executor — default a single-chip
+        ``EnsembleEngine``; pass a ``mesh.MeshEnsembleEngine`` to
+        serve over the whole device mesh (its own ``max_batch``, a
+        device multiple, then drives the batcher so buckets fill the
+        mesh). ``admission``: optional modeled-capacity admission
+        control (``mesh.MeshAdmission``) — leaders it refuses are shed
+        with the structured rejection it returns BEFORE queueing,
+        beside (not instead of) the breaker and queue-depth checks;
+        cache hits and coalesced followers never consult it."""
         if registry is None:
             from heat2d_tpu.obs import get_registry
             registry = get_registry()
@@ -82,8 +91,11 @@ class SolveServer:
                         else breaker)
         self.cache = ResultCache(cache_size, registry=registry)
         self.flight = SingleFlight(registry=registry)
-        self.engine = EnsembleEngine(registry=registry,
-                                     max_batch=max_batch)
+        self.engine = (EnsembleEngine(registry=registry,
+                                      max_batch=max_batch)
+                       if engine is None else engine)
+        max_batch = self.engine.max_batch
+        self.admission = admission
         #: lazily-built inverse engine + its dedicated dispatch lane
         #: (heat2d_tpu/diff): optimization loops are long-lived host
         #: work, so they run on their own single-worker thread — an
@@ -202,6 +214,16 @@ class SolveServer:
                 "backend recovers", content_hash=key,
                 breaker_state=self.breaker.state))
             return fut
+        if leader and self.admission is not None:
+            # Modeled mesh-capacity admission (mesh.MeshAdmission):
+            # sheds on the resource model's saturation verdict, not
+            # queue depth — only work that would COST a launch (cache
+            # hits answered above, followers ride the leader).
+            rej = self.admission.admit(req)
+            if rej is not None:
+                self._count("rejected_" + rej.code)
+                self.flight.fail(key, rej)
+                return fut
         if not leader:
             self._count("coalesced")
             out = coalesced_future(fut)

@@ -253,6 +253,26 @@ def adjoint_config(nrows: int, ny: int,
     return band_config(nrows, ny, dtype, allow_window=False)
 
 
+def measured_rate(nx: int, ny: int,
+                  dtype: str = "float32") -> Optional[float]:
+    """The db's measured Mcells/s for this shape on THIS device kind
+    (exact or nearest entry, same lookup ladder as every config
+    consult), or None without a db / a stored rate. A RATE, not a
+    config: the mesh scheduler and admission control price work with
+    it (heat2d_tpu/mesh, docs/SERVING.md) — nothing about the compiled
+    program changes, so no live re-validation is needed and the
+    jaxpr-pinned free-when-off contract is untouched."""
+    db = active_db()
+    if db is None:
+        return None
+    from heat2d_tpu.ops import pallas_stencil as ps
+
+    cfg = db.lookup(ps._vmem_total()[1], nx, ny, dtype)
+    if cfg is None or not cfg.mcells_per_s:
+        return None
+    return float(cfg.mcells_per_s)
+
+
 def _record_applied(nrows: int, ny: int, dtype: str,
                     cfg: TunedConfig) -> None:
     key = (nrows, ny, dtype)

@@ -2,8 +2,8 @@
 ``heat2d_tpu.analysis.jaxpr_pin`` library.
 
 Every "subsystem X is free when off" acceptance pin (obs, tune, diff,
-tracing, chaos, fused-halo, lock-audit) goes through these; a broken
-pin now fails with a readable structural diff of the two traced
+tracing, chaos, fused-halo, lock-audit, mesh) goes through these; a
+broken pin now fails with a readable structural diff of the two traced
 programs instead of a bare ``assert a == b`` over multi-thousand-line
 strings."""
 
@@ -12,11 +12,14 @@ from heat2d_tpu.analysis.jaxpr_pin import (assert_jaxpr_differs,
                                            band_runner_jaxpr,
                                            batch_runner_jaxpr,
                                            diff_jaxprs, jaxpr_text,
+                                           mesh_runner_jaxpr,
                                            sharded_runner_jaxpr,
-                                           solver_jaxpr)
+                                           solver_jaxpr,
+                                           spatial_runner_jaxpr)
 
 __all__ = [
     "assert_jaxpr_differs", "assert_jaxpr_equal", "band_runner_jaxpr",
     "batch_runner_jaxpr", "diff_jaxprs", "jaxpr_text",
-    "sharded_runner_jaxpr", "solver_jaxpr",
+    "mesh_runner_jaxpr", "sharded_runner_jaxpr", "solver_jaxpr",
+    "spatial_runner_jaxpr",
 ]

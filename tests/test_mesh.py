@@ -1,0 +1,491 @@
+"""Pod-scale mesh serving (heat2d_tpu/mesh/): the batch-vs-spatial
+scheduler, mesh-sharded runner parity (bitwise on every occupancy
+rung), the spatial route's compiled:True stamp, modeled-capacity
+admission control, the O(log max_batch) compile contract per mesh
+config, and the chips_per_unit capacity satellite (ISSUE 13)."""
+
+import numpy as np
+import pytest
+
+import jax
+
+from heat2d_tpu.mesh import (MeshAdmission, MeshEnsembleEngine,
+                             MeshScheduler)
+from heat2d_tpu.mesh.runner import mesh_batch_runner, mesh_capacity
+from heat2d_tpu.models import ensemble
+from heat2d_tpu.obs import MetricsRegistry
+from heat2d_tpu.serve.engine import EnsembleEngine
+from heat2d_tpu.serve.schema import Rejected, SolveRequest
+from tests._pin import (assert_jaxpr_differs, assert_jaxpr_equal,
+                        batch_runner_jaxpr, mesh_runner_jaxpr,
+                        spatial_runner_jaxpr)
+
+ND = len(jax.devices())
+NX, NY, STEPS = 16, 20, 6
+
+multichip = pytest.mark.skipif(ND < 8, reason="needs 8 devices")
+
+
+def req(cx=0.1, cy=0.1, **kw):
+    kw.setdefault("nx", NX)
+    kw.setdefault("ny", NY)
+    kw.setdefault("steps", STEPS)
+    kw.setdefault("method", "jnp")
+    return SolveRequest(cx=cx, cy=cy, **kw)
+
+
+def reqs(n, **kw):
+    return [req(cx=0.1 + 0.01 * i, **kw) for i in range(n)]
+
+
+def grids(pairs):
+    return [np.asarray(u).tobytes() for u, _ in pairs]
+
+
+# --------------------------------------------------------------------- #
+# capacity rule
+# --------------------------------------------------------------------- #
+
+def test_mesh_capacity_power_of_two_device_multiples():
+    # classic ladder at nd=1
+    assert [mesh_capacity(n, 8, 1) for n in (1, 2, 3, 5, 8)] \
+        == [1, 2, 4, 8, 8]
+    # device multiples at nd=4: never below one member per device
+    assert mesh_capacity(1, 32, 4) == 4
+    assert mesh_capacity(5, 32, 4) == 8
+    assert mesh_capacity(9, 32, 4) == 16
+    assert mesh_capacity(17, 32, 4) == 32
+    # cap is the largest device multiple <= max_batch
+    assert mesh_capacity(8, 10, 4) == 8
+    # a bucket bigger than the cap still gets a shardable capacity
+    assert mesh_capacity(12, 10, 4) == 12
+    with pytest.raises(ValueError):
+        mesh_capacity(1, 8, 0)
+
+
+def test_mesh_capacity_ladder_is_log_bounded():
+    caps = {mesh_capacity(n, 64, 8) for n in range(1, 65)}
+    assert caps == {8, 16, 32, 64}          # log2(64/8)+1 rungs
+
+
+# --------------------------------------------------------------------- #
+# mesh runner parity — bitwise on every occupancy rung
+# --------------------------------------------------------------------- #
+
+def test_mesh_runner_bitwise_parity_every_rung():
+    """The mesh-sharded runner's cropped results equal the single-chip
+    batch_runner's byte-for-byte at every occupancy, across DIFFERENT
+    pad capacities (the batch-composition-independence the padding
+    design rests on)."""
+    import jax.numpy as jnp
+
+    single = ensemble.batch_runner(NX, NY, STEPS, "jnp")
+    meshed = mesh_batch_runner(NX, NY, STEPS, "jnp", n_devices=ND)
+    for n in (1, 2, 3, 5, 8):
+        cxs = [0.1 + 0.01 * i for i in range(n)]
+        cap_s = mesh_capacity(n, 8, 1)
+        cap_m = mesh_capacity(n, 8 * ND, ND)
+        pad_s = jnp.asarray(cxs + [cxs[-1]] * (cap_s - n), jnp.float32)
+        pad_m = jnp.asarray(cxs + [cxs[-1]] * (cap_m - n), jnp.float32)
+        u_s = jnp.broadcast_to(jnp.zeros((NX, NY), jnp.float32) + 1.0,
+                               (cap_s, NX, NY))
+        u_m = jnp.broadcast_to(jnp.zeros((NX, NY), jnp.float32) + 1.0,
+                               (cap_m, NX, NY))
+        a = np.asarray(single(u_s, pad_s, pad_s))[:n]
+        b = np.asarray(meshed(u_m, pad_m, pad_m))[:n]
+        np.testing.assert_array_equal(a, b)
+
+
+def test_mesh_runner_rejects_unshardable_batch():
+    meshed = mesh_batch_runner(NX, NY, STEPS, "jnp", n_devices=ND)
+    if ND == 1:
+        pytest.skip("every batch shards on one device")
+    import jax.numpy as jnp
+    bad = ND + 1
+    with pytest.raises(ValueError, match="multiple"):
+        meshed(jnp.zeros((bad, NX, NY), jnp.float32),
+               jnp.zeros((bad,)), jnp.zeros((bad,)))
+
+
+def test_engine_parity_every_rung_fixed_and_convergence():
+    """MeshEnsembleEngine.solve_batch == EnsembleEngine.solve_batch,
+    bitwise, on every occupancy rung — fixed-step AND the convergence
+    early-exit schedule (steps_done included)."""
+    meshed = MeshEnsembleEngine(n_devices=ND)
+    single = EnsembleEngine(max_batch=8)
+    for n in (1, 2, 3, 5, 8):
+        rs = reqs(n)
+        assert grids(meshed.solve_batch(rs)) \
+            == grids(single.solve_batch(rs))
+    conv = dict(convergence=True, interval=5, sensitivity=1e-4,
+                steps=40)
+    for n in (1, 4):
+        rs = reqs(n, **conv)
+        a = meshed.solve_batch(rs)
+        b = single.solve_batch(rs)
+        assert grids(a) == grids(b)
+        assert [s for _, s in a] == [s for _, s in b]
+
+
+@multichip
+def test_engine_routes_batch_on_mesh():
+    meshed = MeshEnsembleEngine(n_devices=ND)
+    meshed.solve_batch(reqs(3))
+    row = meshed.launch_log[-1]
+    assert row["mesh"]["route"] == "batch"
+    assert row["mesh"]["n_devices"] == ND
+    assert row["capacity"] % ND == 0
+
+
+# --------------------------------------------------------------------- #
+# the scheduler's split
+# --------------------------------------------------------------------- #
+
+def test_scheduler_split_decisions():
+    reg = MetricsRegistry()
+    s = MeshScheduler(n_devices=ND, registry=reg)
+    d = s.decide(req())
+    if ND < 2:
+        assert d["route"] == "single" and d["reason"] == "one_device"
+    else:
+        assert d["route"] == "batch" and d["reason"] == "fits_chip"
+    # memoized per signature: same row object, one route count
+    assert s.decide(req(cx=0.9)) is d
+    assert reg.find_counters("mesh_route_total")
+    # non-solve kinds stay on the single-chip path
+    class FakeInverse:
+        nx, ny, steps = NX, NY, STEPS
+        request_kind = "inverse"
+        dtype = "float32"
+
+        def signature(self):
+            return ("inverse", NX, NY)
+    assert s.decide(FakeInverse())["reason"] == "request_kind"
+
+
+@multichip
+def test_scheduler_spatial_when_member_exceeds_threshold():
+    s = MeshScheduler(n_devices=ND, spatial_bytes_threshold=1)
+    d = s.decide(req(nx=48, ny=64))
+    assert d["route"] == "spatial"
+    assert d["spatial_grid"] == s.spatial_grid()
+    assert d["plan"]["tier"] in ("overlap", "ici", "window",
+                                 "collective")
+
+
+@multichip
+def test_unplannable_routes_single_chip_with_counter():
+    """The totality follow-through: a shape the (2, 4) decomposition
+    cannot take is SERVED single-chip (bitwise the single-chip
+    answer) with mesh_fallback_total{reason="unplannable"} — never
+    rejected."""
+    reg = MetricsRegistry()
+    sched = MeshScheduler(n_devices=ND, registry=reg,
+                          spatial_bytes_threshold=1)
+    meshed = MeshEnsembleEngine(n_devices=ND, scheduler=sched,
+                                registry=reg)
+    single = EnsembleEngine(max_batch=8)
+    rs = reqs(2, nx=15, ny=18)           # 15 % 2, 18 % 4 != 0
+    assert sched.decide(rs[0])["reason"] == "unplannable"
+    assert grids(meshed.solve_batch(rs)) \
+        == grids(single.solve_batch(rs))
+    fallbacks = reg.find_counters("mesh_fallback_total")
+    assert {dict(k)["reason"]: v for k, v in fallbacks.items()} \
+        == {"unplannable": 1}
+    assert meshed.launch_log[-1]["mesh"]["route"] == "single"
+    # the plan row records WHY (the PR 7 error-carrying plan)
+    plan = meshed.halo_plans[rs[0].signature()]
+    assert plan["tier"] == "unplannable" and "error" in plan
+
+
+# --------------------------------------------------------------------- #
+# spatial route: compiled:True + bitwise vs collective/single-chip
+# --------------------------------------------------------------------- #
+
+@multichip
+def test_spatial_route_compiles_plan_and_matches_single_chip():
+    reg = MetricsRegistry()
+    sched = MeshScheduler(n_devices=ND, registry=reg,
+                          spatial_bytes_threshold=1)
+    meshed = MeshEnsembleEngine(n_devices=ND, scheduler=sched,
+                                registry=reg)
+    single = EnsembleEngine(max_batch=8)
+    rs = reqs(3, nx=48, ny=64)
+    sig = rs[0].signature()
+    # pre-launch: the PR 7 socket still reads compiled: False
+    meshed._preresolve_tuned(rs[0])
+    assert meshed.halo_plans[sig]["compiled"] is False
+    assert grids(meshed.solve_batch(rs)) \
+        == grids(single.solve_batch(rs))
+    plan = meshed.halo_plans[sig]
+    assert plan["compiled"] is True          # the socket, closed
+    assert plan["mesh"] == sched.spatial_grid()
+    assert meshed.launch_log[-1]["mesh"]["route"] == "spatial"
+    assert meshed.launch_log[-1]["halo_plan"]["compiled"] is True
+    assert reg.find_counters("mesh_spatial_compiled_total")
+    # warm relaunch reuses the memoized spatial runner
+    launches = meshed.launches
+    meshed.solve_batch(reqs(2, nx=48, ny=64))
+    assert meshed.launches == launches + 1
+
+
+def test_spatial_runner_jaxpr_degraded_fused_equals_collective():
+    """The serve spatial runner inherits PR 7's degradation contract:
+    on a 1x1 grid there is nothing to overlap, so the fused program
+    is byte-identical to the collective one."""
+    a = spatial_runner_jaxpr(24, 24, 8, 1, 1, halo="collective",
+                             n_devices=1)
+    b = spatial_runner_jaxpr(24, 24, 8, 1, 1, halo="fused",
+                             n_devices=1)
+    assert_jaxpr_equal(a, b, "spatial serve runner (1x1 degraded)")
+
+
+@multichip
+def test_spatial_runner_jaxpr_fused_differs_and_is_bitwise():
+    """Non-vacuity + parity: on a real 2x2 submesh the fused program
+    DIFFERS from the collective one, and their served results are
+    bitwise-identical (the PR 7 overlap contract through the serve
+    path)."""
+    a = spatial_runner_jaxpr(32, 32, 8, 2, 2, halo="collective",
+                             n_devices=4)
+    b = spatial_runner_jaxpr(32, 32, 8, 2, 2, halo="fused",
+                             n_devices=4)
+    assert_jaxpr_differs(a, b, "spatial serve runner (2x2 fused)")
+    rc = ensemble.spatial_batch_runner(32, 32, 8, 2, 2,
+                                       halo="collective", n_devices=4)
+    rf = ensemble.spatial_batch_runner(32, 32, 8, 2, 2, halo="fused",
+                                       n_devices=4)
+    import jax.numpy as jnp
+    u0 = jnp.broadcast_to(
+        jnp.arange(32 * 32, dtype=jnp.float32).reshape(32, 32),
+        (3, 32, 32))
+    cx = jnp.asarray([0.1, 0.12, 0.14], jnp.float32)
+    uc, kc = rc(u0, cx, cx)
+    uf, kf = rf(u0, cx, cx)
+    np.testing.assert_array_equal(np.asarray(uc), np.asarray(uf))
+    np.testing.assert_array_equal(np.asarray(kc), np.asarray(kf))
+
+
+# --------------------------------------------------------------------- #
+# admission control — modeled saturation, deterministic clock
+# --------------------------------------------------------------------- #
+
+def make_admission(reg=None, cells_per_launch=None, **kw):
+    clock = {"t": 0.0}
+    # capacity chosen so ~2 requests fit one window
+    kw.setdefault("per_chip_mcells_per_s",
+                  2 * NX * NY * STEPS / 1e6 / kw.get("window_s", 1.0)
+                  / max(ND, 1) / kw.get("headroom", 1.0))
+    kw.setdefault("window_s", 1.0)
+    kw.setdefault("headroom", 1.0)
+    adm = MeshAdmission(n_devices=ND, registry=reg,
+                        clock=lambda: clock["t"], **kw)
+    return adm, clock
+
+
+def test_admission_sheds_on_modeled_saturation():
+    reg = MetricsRegistry()
+    adm, clock = make_admission(reg)
+    assert adm.admit(req()) is None
+    assert adm.admit(req(cx=0.2)) is None
+    rej = adm.admit(req(cx=0.3))         # window full: shed
+    assert isinstance(rej, Rejected)
+    assert rej.code == "mesh_saturated"
+    assert rej.fields["offered_cells_per_s"] \
+        > rej.fields["capacity_cells_per_s"]
+    assert reg.find_counters("mesh_admission_shed_total")
+    # shed work was NOT charged: the window drains on the clock and
+    # admission resumes exactly when the model says capacity frees
+    clock["t"] = 1.01
+    assert adm.admit(req(cx=0.4)) is None
+
+
+def test_admission_through_the_server():
+    """A saturated leader is shed with rejected_mesh_saturated while
+    cache hits keep answering (the shed-compute-not-answers
+    contract)."""
+    from heat2d_tpu.serve.server import SolveServer
+
+    reg = MetricsRegistry()
+    adm, clock = make_admission()
+    server = SolveServer(registry=reg, max_delay=0.02,
+                         admission=adm)
+    with server:
+        a = server.submit(req()).result(60)
+        b = server.submit(req(cx=0.2)).result(60)
+        assert not a.cache_hit and not b.cache_hit
+        with pytest.raises(Rejected, match="mesh_saturated"):
+            server.submit(req(cx=0.3)).result(60)
+        # the first request again: a cache hit, served while saturated
+        hit = server.submit(req()).result(60)
+        assert hit.cache_hit
+    counts = reg.snapshot()["counters"]
+    assert counts["serve_requests_total{outcome=rejected_"
+                  "mesh_saturated}"] >= 1
+
+
+def test_admission_exempts_non_solve_kinds():
+    """Inverse requests route OFF the mesh (scheduler) and their cost
+    is iterations-scaled, not nx*ny*steps — admission must neither
+    price nor shed them, and must not let them distort the solve
+    window."""
+    adm, _clock = make_admission()
+
+    class FakeInverse:
+        nx, ny, steps = 1_000_000, 1_000_000, 1_000_000
+        request_kind = "inverse"
+    assert adm.admit(FakeInverse()) is None     # never shed
+    # and never charged: the solve window is still empty
+    assert adm.admit(req()) is None
+    assert adm.admit(req(cx=0.2)) is None
+
+
+def test_engine_max_batch_per_chip_scales_with_mesh():
+    """The CLIs' --max-batch survives --mesh as a PER-CHIP bound
+    rather than being silently replaced by the engine default."""
+    e = MeshEnsembleEngine(n_devices=ND, max_batch_per_chip=2)
+    assert e.max_batch == 2 * ND
+    # explicit total still wins
+    e2 = MeshEnsembleEngine(n_devices=ND, max_batch=3 * ND,
+                            max_batch_per_chip=2)
+    assert e2.max_batch == 3 * ND
+
+
+def test_admission_validation():
+    with pytest.raises(ValueError):
+        MeshAdmission(n_devices=ND, window_s=0)
+    with pytest.raises(ValueError):
+        MeshAdmission(n_devices=ND, headroom=0)
+
+
+def test_mesh_saturated_is_a_shed_code():
+    from heat2d_tpu.load.runner import SHED_CODES
+    assert "mesh_saturated" in SHED_CODES
+
+
+# --------------------------------------------------------------------- #
+# compile budget — O(log max_batch) per mesh config
+# --------------------------------------------------------------------- #
+
+def test_serve_compile_report_mesh_engine_holds_budget():
+    from heat2d_tpu.analysis.recompile import serve_compile_report
+
+    rep = serve_compile_report(
+        max_batch=8,
+        engine_factory=lambda: MeshEnsembleEngine(n_devices=ND))
+    assert rep["compiles"] <= rep["budget"], rep
+    if ND > 1:
+        # device-multiple padding: every capacity shards
+        assert all(c % ND == 0 for c in rep["capacities"]), rep
+        assert all("mesh_batch_runner" in n for n in rep["names"]), rep
+
+
+def test_serve_compile_report_single_chip_unchanged():
+    from heat2d_tpu.analysis.recompile import serve_compile_report
+
+    rep = serve_compile_report(max_batch=8)
+    assert rep["compiles"] <= rep["budget"], rep
+    assert rep["capacities"] == [1, 2, 4, 8]
+
+
+# --------------------------------------------------------------------- #
+# free-when-off pins
+# --------------------------------------------------------------------- #
+
+def test_single_chip_runner_program_untouched_by_mesh():
+    """Building/serving through the whole mesh stack must leave the
+    single-chip batch runner's traced program byte-identical — the
+    mesh is a new engine, not a tax on the old one."""
+    before = batch_runner_jaxpr(NX, NY, STEPS, "jnp")
+    meshed = MeshEnsembleEngine(n_devices=ND)
+    meshed.solve_batch(reqs(2))
+    adm, _ = make_admission()
+    adm.admit(req(cx=0.5))
+    after = batch_runner_jaxpr(NX, NY, STEPS, "jnp")
+    assert_jaxpr_equal(before, after, "single-chip batch runner")
+
+
+def test_mesh_runner_program_independent_of_scheduler_state():
+    """Scheduler decisions and admission are host-side math: the mesh
+    runner's traced program is identical with them armed."""
+    before = mesh_runner_jaxpr(NX, NY, STEPS, "jnp", n_devices=ND)
+    reg = MetricsRegistry()
+    sched = MeshScheduler(n_devices=ND, registry=reg)
+    sched.decide(req())
+    adm, _ = make_admission(reg)
+    adm.admit(req(cx=0.7))
+    after = mesh_runner_jaxpr(NX, NY, STEPS, "jnp", n_devices=ND)
+    assert_jaxpr_equal(before, after, "mesh batch runner")
+
+
+# --------------------------------------------------------------------- #
+# bench_serve payload
+# --------------------------------------------------------------------- #
+
+def test_measure_serve_scaling_payload():
+    from heat2d_tpu.mesh.bench import measure_serve_scaling
+
+    p = measure_serve_scaling(n_devices=ND, nx=16, ny=20, steps=4,
+                              wall=False)
+    assert p["parity"] is True
+    assert all(r["bitwise"] for r in p["parity_rungs"])
+    assert p["n_devices"] == ND
+    assert 0 < p["modeled_scaling_efficiency"] <= 1.0
+    assert p["model"]["name"].startswith("heat2d-tpu/serve-scaling")
+    assert p["serve_scaling_efficiency"] \
+        == p["modeled_scaling_efficiency"]
+    if ND >= 8:
+        assert p["serve_scaling_efficiency"] >= 0.75   # >= 6x at 8
+
+
+@multichip
+def test_measure_spatial_serve_payload():
+    from heat2d_tpu.mesh.bench import measure_spatial_serve
+
+    p = measure_spatial_serve(n_devices=ND, nx=48, ny=64, steps=8)
+    assert p["route"] == "spatial"
+    assert p["compiled"] is True and p["parity"] is True
+    assert p["halo_plan"]["mesh"] == [2, 4]
+
+
+# --------------------------------------------------------------------- #
+# chips_per_unit capacity satellite
+# --------------------------------------------------------------------- #
+
+def test_fit_capacity_chips_dimension():
+    from heat2d_tpu.load.capacity import (advise, chips_for,
+                                          fit_capacity, units_for)
+
+    rows = [{"offered_rps": r, "achieved_rps": r, "shed_rate": 0.0,
+             "slo_ok": True} for r in (4.0, 8.0)]
+    rows.append({"offered_rps": 16.0, "achieved_rps": 9.0,
+                 "shed_rate": 0.2, "slo_ok": False})
+    fit = fit_capacity(rows, 2, chips_per_unit=8)
+    assert fit["chips_per_unit"] == 8 and fit["chips"] == 16
+    assert fit["per_chip_rps"] == pytest.approx(8.0 / 16)
+    assert units_for(fit, 12.0) == 3
+    assert chips_for(fit, 12.0) == 24
+    adv = advise(fit, observed_rps=12.0, current_units=2)
+    assert adv["needed_units"] == 3 and adv["needed_chips"] == 24
+    assert adv["current_chips"] == 16 and adv["chips_per_unit"] == 8
+    # pre-mesh fits: chips rows equal unit rows
+    fit1 = fit_capacity(rows, 2)
+    assert fit1["chips_per_unit"] == 1
+    assert fit1["chips"] == fit1["units"]
+    assert chips_for(fit1, 12.0) == units_for(fit1, 12.0)
+    with pytest.raises(ValueError):
+        fit_capacity(rows, 2, chips_per_unit=0)
+
+
+def test_serve_target_mesh_chips_per_unit():
+    from heat2d_tpu.load.runner import ServeTarget
+
+    t = ServeTarget(registry=MetricsRegistry(), mesh=True)
+    try:
+        assert t.units == 1
+        assert t.chips_per_unit == ND
+        assert t.server.engine.n_devices == ND
+        fut = t.submit(req(), "tenant", 60.0)
+        assert fut.result(60).steps_done == STEPS
+    finally:
+        t.close()

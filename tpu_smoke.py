@@ -463,6 +463,32 @@ def main() -> int:
         print("SKIP fused halo mesh checks (1 device attached)",
               file=sys.stderr)
 
+    # Mesh serving (heat2d_tpu/mesh, docs/SERVING.md): the mesh-aware
+    # engine on REAL chips — batch route bitwise vs the single-chip
+    # engine on several occupancy rungs, wall-clock strong scaling
+    # recorded (rate_source="wall" on hardware), and the spatial route
+    # stamping its halo plan compiled:True with bitwise parity.
+    if ndev >= 2:
+        from heat2d_tpu.mesh.bench import (measure_serve_scaling,
+                                           measure_spatial_serve)
+
+        row = measure_serve_scaling(n_devices=ndev, nx=256, ny=256,
+                                    steps=16)
+        assert row["parity"], row["parity_rungs"]
+        assert row["rate_source"] == "wall", row["rate_source"]
+        print(f"PASS mesh serve batch route bitwise "
+              f"({ndev} chips, wall efficiency "
+              f"{row['wall_scaling_efficiency']:.3f})")
+        sp = measure_spatial_serve(n_devices=ndev, nx=256 * gxs,
+                                   ny=256 * gys, steps=16)
+        assert sp["route"] == "spatial" and sp["parity"], sp
+        assert sp["compiled"] is True, sp
+        print(f"PASS mesh serve spatial route compiled "
+              f"(tier={sp['halo_plan'].get('tier')}) bitwise")
+    else:
+        print("SKIP mesh serve checks (1 device attached)",
+              file=sys.stderr)
+
     print("ALL TPU SMOKE PATHS PASS")
     return 0
 
