@@ -76,6 +76,21 @@ def build_record(value: float, method: str, elapsed: float,
     # context beside the driver-contract keys above, which stay as-is.
     from heat2d_tpu.obs.record import attach_context
     attach_context(rec, "bench")
+    # Wall-clock-to-solution at matched accuracy — the algorithmic-
+    # speed headline beside the kernel-speed one (docs/ALGORITHMS.md):
+    # explicit at the stability edge vs Crank-Nicolson ADI at 256x the
+    # step size to the same t_final, each row carrying
+    # time_to_solution_s + accuracy (L2 vs the analytic separable-mode
+    # solution). Guarded: a tts failure degrades to an error string,
+    # never a lost headline metric.
+    try:
+        from heat2d_tpu.models import solution
+        import jax
+        on_tpu = jax.default_backend() == "tpu"
+        rec["time_to_solution"] = solution.bench_tts(
+            quick=QUICK, on_tpu=on_tpu)
+    except Exception as e:  # noqa: BLE001 — record, don't lose bench
+        rec["time_to_solution"] = {"error": f"{type(e).__name__}: {e}"}
     bound = calibrated_bound_mcells(nx, ny)
     if bound is not None and method == "two-point" and mode == "pallas":
         # Only the pallas route's two-point marginal is comparable to

@@ -1,0 +1,143 @@
+"""The CI ``implicit-gate`` — the implicit-route acceptance, as a
+program (``python -m heat2d_tpu.analysis.implicit_gate``).
+
+Four legs, every one an ISSUE-14 acceptance criterion:
+
+1. **Algorithmic speed**: ADI reaches a fixed ``t_final`` at matched
+   L2 accuracy (vs the analytic separable-mode solution) in >= 100x
+   fewer steps and >= 10x lower MODELED wall-clock than the explicit
+   scheme (``models/solution.py`` — the model is deterministic, so
+   the verdict does not ride CI host jitter; measured wall-clock is
+   printed beside it).
+2. **Serve repeatability**: a ``method="adi"`` bucket answers
+   bitwise-identically across independent engines AND across launch
+   capacities (the pad-parity contract every explicit route already
+   carries).
+3. **Mesh parity**: on the host-simulated 8-device mesh, the
+   mesh-sharded runner's ADI answers are bitwise the single-chip
+   runner's (the route rides the PR 13 machinery unchanged).
+4. **Compile ladder**: the recompile sentinel proves the
+   O(log max_batch) padded-capacity contract holds for BOTH new
+   routes (``analysis/recompile.serve_compile_report``).
+
+Exit 0 iff every leg passes; failures print as ``FAIL: ...`` lines.
+"""
+
+from __future__ import annotations
+
+import sys
+
+
+def run_gate(out=sys.stdout) -> int:
+    import numpy as np
+
+    failures = []
+
+    def check(name, ok, detail=""):
+        line = f"{'PASS' if ok else 'FAIL'}: {name}"
+        if detail:
+            line += f" ({detail})"
+        print(line, file=out if ok else sys.stderr)
+        if not ok:
+            failures.append(name)
+
+    # -- leg 1: wall-clock-to-solution at matched accuracy ----------- #
+    from heat2d_tpu.models import solution
+
+    tts = solution.time_to_solution(
+        257, 257, steps_explicit=2560, step_ratio=256,
+        methods=("explicit", "adi"))
+    s = tts["summary"]
+    check("adi >= 100x fewer steps",
+          s["adi_steps_ratio"] >= 100.0,
+          f"ratio {s['adi_steps_ratio']:.0f}x")
+    check("adi >= 10x modeled wall-clock-to-solution",
+          s["adi_modeled_speedup"] >= 10.0,
+          f"modeled {s['adi_modeled_speedup']:.1f}x, measured "
+          f"{s['adi_wall_speedup']:.1f}x")
+    rows = {r["method"]: r for r in tts["rows"]}
+    check("adi matched L2 accuracy (f32)", s["adi_matched_accuracy"],
+          f"adi {rows['adi']['accuracy']:.3e} vs explicit "
+          f"{rows['explicit']['accuracy']:.3e}")
+    # The f64 twin separates the algorithms from f32 roundoff: here
+    # truncation dominates, and the O(dt^2) leg must sit STRICTLY at
+    # or below the O(dt) leg's error despite 256x fewer steps.
+    tts64 = solution.time_to_solution(
+        257, 257, steps_explicit=2560, step_ratio=256,
+        methods=("explicit", "adi"), dtype=np.float64)
+    r64 = {r["method"]: r for r in tts64["rows"]}
+    check("adi <= explicit L2 error (f64, truncation-dominated)",
+          r64["adi"]["accuracy"] <= r64["explicit"]["accuracy"],
+          f"adi {r64['adi']['accuracy']:.3e} vs explicit "
+          f"{r64['explicit']['accuracy']:.3e}")
+
+    # -- leg 2: serve-route bitwise repeatability -------------------- #
+    from heat2d_tpu.serve.engine import EnsembleEngine
+    from heat2d_tpu.serve.schema import SolveRequest
+
+    req = SolveRequest(nx=24, ny=32, steps=4, cx=8.0, cy=6.0,
+                       method="adi")
+    twin = SolveRequest(nx=24, ny=32, steps=4, cx=3.0, cy=2.0,
+                        method="adi")
+    a = EnsembleEngine(max_batch=8).solve_batch([req])[0]
+    b = EnsembleEngine(max_batch=8).solve_batch([req])[0]
+    check("adi answers bitwise-repeatably across engines",
+          np.asarray(a[0]).tobytes() == np.asarray(b[0]).tobytes())
+    # Different occupancy -> different padded capacity -> a different
+    # compiled program; pad parity must keep the member bitwise.
+    c = EnsembleEngine(max_batch=8).solve_batch([req, twin])[0]
+    check("adi bitwise across launch capacities",
+          np.asarray(a[0]).tobytes() == np.asarray(c[0]).tobytes())
+
+    # -- leg 3: mesh parity on the sim mesh -------------------------- #
+    import jax
+
+    nd = len(jax.devices())
+    if nd >= 2:
+        import jax.numpy as jnp
+
+        from heat2d_tpu.mesh.runner import mesh_batch_runner, \
+            mesh_capacity
+        from heat2d_tpu.models import ensemble
+
+        b_ = mesh_capacity(nd, 4 * nd, nd)
+        u0 = jnp.broadcast_to(
+            jnp.asarray(np.random.default_rng(14).normal(
+                size=(24, 32)).astype(np.float32)), (b_, 24, 32))
+        cxs = jnp.asarray([4.0 + i for i in range(b_)], jnp.float32)
+        cys = jnp.asarray([2.0 + i for i in range(b_)], jnp.float32)
+        mesh_run = mesh_batch_runner(24, 32, 4, "adi")
+        single = ensemble.batch_runner(24, 32, 4, "adi")
+        got = np.asarray(mesh_run(u0, cxs, cys))
+        want = np.asarray(single(u0, cxs, cys))
+        check(f"mesh({nd} devices) adi bitwise == single-chip",
+              got.tobytes() == want.tobytes())
+    else:
+        check("mesh adi parity", True, "skipped: 1 device")
+
+    # -- leg 4: the compile ladder for both routes ------------------- #
+    from heat2d_tpu.analysis.recompile import serve_compile_report
+
+    for method in ("adi", "mg"):
+        rep = serve_compile_report(method=method, max_batch=8)
+        check(f"{method} compile ladder O(log max_batch)",
+              rep["compiles"] <= rep["budget"],
+              f"{rep['compiles']} compiles <= budget {rep['budget']}, "
+              f"capacities {rep['capacities']}")
+
+    print(("implicit-gate FAILED" if failures else
+           "implicit-gate passed"), file=out)
+    return 1 if failures else 0
+
+
+def main(argv=None) -> int:
+    # x64 for the truncation-dominated f64 accuracy leg (f32 arrays
+    # stay f32 — the flag only unlocks the wider dtype).
+    import jax
+
+    jax.config.update("jax_enable_x64", True)
+    return run_gate()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

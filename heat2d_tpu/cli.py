@@ -34,6 +34,15 @@ def build_parser() -> argparse.ArgumentParser:
                     "(capabilities of patschris/Heat2D)")
     p.add_argument("--mode", default="serial",
                    choices=["serial", "pallas", "dist1d", "dist2d", "hybrid"])
+    p.add_argument("--method", default="explicit",
+                   choices=["explicit", "adi", "mg"],
+                   help="time-stepping scheme (docs/ALGORITHMS.md): "
+                        "explicit forward Euler (stability-limited "
+                        "cx+cy <= 1/2), Crank-Nicolson ADI on batched "
+                        "tridiagonal solves, or multigrid-solved CN — "
+                        "the implicit schemes are unconditionally "
+                        "stable, so --cx/--cy become dt-scaled "
+                        "diffusion numbers chosen by accuracy")
     g = p.add_argument_group("problem (reference #define names)")
     g.add_argument("--nxprob", type=int, default=10)
     g.add_argument("--nyprob", type=int, default=10)
@@ -484,7 +493,7 @@ def main(argv=None) -> int:
             accum_dtype=args.accum_dtype, numworkers=args.numworkers,
             strict_baseline=args.strict_baseline, debug=args.debug,
             halo_depth=args.halo_depth, halo=args.halo,
-            bitwise_parity=args.bitwise_parity)
+            bitwise_parity=args.bitwise_parity, method=args.method)
     except ConfigError as e:
         print(f"{e}\nQuitting...", file=sys.stderr)
         return 1

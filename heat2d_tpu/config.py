@@ -51,6 +51,21 @@ MODES = ("serial", "pallas", "dist1d", "dist2d", "hybrid")
 #:                "fused" never fails, it only ever falls back.
 HALO_ROUTES = ("collective", "fused")
 
+#: Time-stepping schemes (docs/ALGORITHMS.md):
+#:   explicit — the reference's forward-Euler 5-point update; fastest
+#:              per step but stability-limited (cx + cy <= 1/2,
+#:              ops/stability.py), so t_final costs O(1/dx^2) steps.
+#:   adi      — Crank-Nicolson ADI (Peaceman-Rachford) on batched
+#:              tridiagonal Thomas solves (ops/tridiag.py):
+#:              unconditionally stable, O(dt^2) — dt chosen by
+#:              accuracy, typically 100-1000x fewer steps to the same
+#:              physical time.
+#:   mg       — unsplit Crank-Nicolson solved per step by geometric
+#:              multigrid V-cycles (ops/multigrid.py): no splitting
+#:              error; the iterative route for steady/convergence
+#:              solves.
+TIME_METHODS = ("explicit", "adi", "mg")
+
 
 @dataclasses.dataclass(frozen=True)
 class HeatConfig:
@@ -76,6 +91,10 @@ class HeatConfig:
 
     # -- execution ----------------------------------------------------------
     mode: str = "serial"
+    # Time-stepping scheme (TIME_METHODS). "explicit" keeps every
+    # pre-existing route byte-identical (jaxpr-pinned); the implicit
+    # schemes are unconditionally stable and skip the stability box.
+    method: str = "explicit"
     # Wide-halo depth T for the distributed modes: each halo exchange
     # carries a T-deep ghost ring and the shard advances T steps locally
     # per exchange — 4 ppermutes per T steps instead of 4T (the distributed
@@ -148,6 +167,22 @@ class HeatConfig:
         if self.halo not in HALO_ROUTES:
             raise ConfigError(
                 f"halo must be one of {HALO_ROUTES}, got {self.halo!r}")
+        if self.method not in TIME_METHODS:
+            raise ConfigError(
+                f"method must be one of {TIME_METHODS}, got "
+                f"{self.method!r}")
+        if self.method == "explicit":
+            # Explicit routes validate against the stability box; the
+            # implicit routes skip it by design (ops/stability.py).
+            from heat2d_tpu.ops.stability import (
+                check_explicit_stability)
+            check_explicit_stability(self.cx, self.cy,
+                                     where="explicit scheme")
+        elif self.mode not in ("serial", "pallas"):
+            raise ConfigError(
+                f"method {self.method!r} runs single-device modes "
+                f"(serial/pallas) only; distributed implicit sweeps "
+                f"are not built yet — got mode {self.mode!r}")
 
     # Convenience views ------------------------------------------------- #
 

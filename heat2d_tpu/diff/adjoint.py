@@ -98,7 +98,16 @@ class DiffSpec:
 def _step(spec: DiffSpec, u, a, b):
     """One forward step. ``accum_dtype=None``: accumulate in u's dtype
     (pure-f32 fast path; true f64 under x64 instead of a silent
-    truncation through float32)."""
+    truncation through float32). ``method="adi"`` swaps the MATH for
+    the implicit Crank-Nicolson ADI step (ops/tridiag.py): the
+    per-step pullback below (``jax.vjp`` of this step) then rides the
+    implicit differentiation of the tridiagonal solves — the backward
+    pass solves the TRANSPOSE system (thomas_solve's custom_vjp),
+    never an unrolled elimination trace. FD-parity-tested like every
+    other route (tests/test_implicit.py)."""
+    if spec.method == "adi":
+        from heat2d_tpu.ops.tridiag import adi_step
+        return adi_step(u, a, b)
     if spec.coeff == "const":
         return stencil_step(u, a, b, accum_dtype=None)
     return stencil_step_var(u, a, b)
@@ -202,12 +211,16 @@ def _resolve_method(method: str, nx: int, ny: int, coeff: str,
     if method not in METHODS:
         raise ValueError(f"method must be one of {METHODS}, got {method!r}")
     if coeff == "var":
-        if method == "band":
+        if method in ("band", "adi"):
             raise ValueError(
-                "method='band' supports coeff='const' only (the band "
-                "kernels take scalar diffusivities; the variable-"
-                "coefficient route runs the jnp step)")
+                f"method={method!r} supports coeff='const' only (the "
+                "band/tridiagonal kernels take scalar diffusivities; "
+                "the variable-coefficient route runs the jnp step)")
         return "jnp"
+    if method == "adi":
+        # The ADI primal is per-step on both adjoint routes (no fused
+        # band form), so full storage and checkpointing both compose.
+        return "adi"
     if adjoint == "full":
         # Full storage records EVERY step state — its forward is
         # necessarily the per-step scan, and custom_vjp's fwd must

@@ -41,12 +41,10 @@ from typing import Callable, Optional
 import numpy as np
 
 from heat2d_tpu.diff.vocab import TARGETS
-
-#: Stability box for the projected diffusivity iterates: the explicit
-#: scheme needs kx + ky <= 1/2, i.e. isotropic kappa <= 1/4; 0.24
-#: leaves margin, and the floor keeps the field physical (kappa >= 0)
-#: and the solve sensitive to it.
-KAPPA_MIN, KAPPA_MAX = 1e-4, 0.24
+# The stability box now lives in ops/stability.py (ONE home for the
+# kx + ky <= 1/2 projection — PR 14's factoring); re-exported here
+# for back-compat with every existing import site.
+from heat2d_tpu.ops.stability import KAPPA_MIN, KAPPA_MAX  # noqa: F401
 
 
 def synthetic_diffusivity(nx: int, ny: int, base: float = 0.08,
@@ -258,11 +256,8 @@ class InverseProblem:
     def project(self) -> Optional[Callable]:
         if self.target != "diffusivity":
             return None
-        import jax.numpy as jnp
-
-        def clamp(p):
-            return jnp.clip(p, KAPPA_MIN, KAPPA_MAX)
-        return clamp
+        from heat2d_tpu.ops.stability import project_stable
+        return project_stable
 
     def value_and_grad(self) -> Callable:
         """``params -> (loss, grad)``: the memoized compiled runner for

@@ -12,8 +12,11 @@ COEFFS = ("const", "var")
 #: reverse-mode storage strategies
 ADJOINTS = ("checkpoint", "full")
 
-#: primal multi-step routes
-METHODS = ("auto", "jnp", "band")
+#: primal multi-step routes ("adi": the implicit Crank-Nicolson ADI
+#: step — different MATH, not just a different kernel; its adjoint
+#: rides the implicit differentiation of the tridiagonal solves,
+#: ops/tridiag.thomas_solve's custom_vjp)
+METHODS = ("auto", "jnp", "band", "adi")
 
 #: inverse-problem recovery targets
 TARGETS = ("init", "diffusivity")

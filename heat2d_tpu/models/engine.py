@@ -18,7 +18,13 @@ never syncs to the host mid-run (the reference syncs implicitly via
 MPI_Allreduce; here the psum/sum stays in the carry).
 
 ``step_fn`` is any ``u -> u`` (single-device golden model, Pallas kernel,
-or a shard-local step with ppermute halo exchange inside ``shard_map``);
+a shard-local step with ppermute halo exchange inside ``shard_map``, or —
+since the implicit routes landed — a Crank-Nicolson ADI sweep
+(``ops/tridiag.adi_step``) or a multigrid-solved CN step
+(``ops/multigrid.mg_step``): the loops are scheme-agnostic, which is
+exactly how ``config.method`` composes without a second engine — the
+solver's implicit runner feeds these same loops, with the per-INTERVAL
+residual pair meaning the same thing at any step size);
 ``residual_fn`` is ``(u_new, u_old) -> scalar`` and performs its own psum
 when running sharded.
 

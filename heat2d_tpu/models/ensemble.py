@@ -610,8 +610,42 @@ def _run_batch_band(u0, cxs, cys, *, steps):
     return u[:, :nx] if m_pad > nx else u
 
 
+def _run_batch_adi(u0, cxs, cys, *, steps):
+    """Implicit route: Crank-Nicolson ADI (Peaceman-Rachford) with
+    batched tridiagonal Thomas solves (ops/tridiag.py). The (cx, cy)
+    here are the ADI step's diffusion numbers — unconditionally
+    stable, so they may sit far past the explicit kx+ky <= 1/2 box:
+    that is the whole point (100-1000x fewer steps to the same
+    physical time, docs/ALGORITHMS.md). Kernel TD on a viable TPU
+    shape; the scan route (correct everywhere) otherwise."""
+    from heat2d_tpu.ops import tridiag as td
+
+    _, nx, ny = u0.shape
+    if td.adi_kernel_viable(nx, ny, u0.dtype):
+        return td.batched_adi_kernel(u0, cxs, cys, steps=steps)
+    return td.batched_adi_scan(u0, cxs, cys, steps=steps)
+
+
+def _run_batch_mg(u0, cxs, cys, *, steps):
+    """Implicit route: unsplit Crank-Nicolson stepped by geometric
+    multigrid V-cycles (ops/multigrid.py) — the preconditioned
+    iterative route for the steady/convergence path; the existing
+    stencil kernel is the smoother. vmapped per member (the V-cycle
+    recursion is static, so the batch shares one program)."""
+    from heat2d_tpu.ops import multigrid as mgrid
+
+    cxs = jnp.asarray(cxs, u0.dtype)
+    cys = jnp.asarray(cys, u0.dtype)
+
+    def one(u, cx, cy):
+        return mgrid.mg_multi_step(u, steps, cx, cy)
+
+    return jax.vmap(one)(u0, cxs, cys)
+
+
 _BATCH_RUNNERS = {"jnp": _run_batch_jnp, "pallas": _run_batch_pallas,
-                  "band": _run_batch_band}
+                  "band": _run_batch_band, "adi": _run_batch_adi,
+                  "mg": _run_batch_mg}
 
 
 # --------------------------------------------------------------------- #

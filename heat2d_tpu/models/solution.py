@@ -1,0 +1,211 @@
+"""Wall-clock-to-solution at matched accuracy — the headline harness.
+
+Mcells/s measures how fast a kernel burns steps; it says nothing about
+how fast a method reaches an ANSWER. This module measures the thing
+the ROADMAP's algorithmic-speed item is actually about: the wall-clock
+(and modeled) time for each time-stepping scheme to reach the same
+physical time ``t_final`` at the same (or better) L2 accuracy against
+the analytic separable-mode solution (``ops/analytic.py`` — the
+semi-discrete reference, so the comparison isolates time-stepping
+error; both schemes share the spatial operator exactly).
+
+The contract (ISSUE 14 / the CI ``implicit-gate``): the explicit
+scheme is pinned to the stability box (``ops/stability.py`` validates
+it here — implicit legs skip the check by design), so its step count
+scales as O(1/dx^2); the Crank-Nicolson ADI leg runs ``step_ratio``x
+fewer steps at ``step_ratio``x the diffusion number — the SAME
+``t_final`` — and must land at matched accuracy. The modeled speedup
+uses a step-cost model in explicit-sweep units (an ADI step is ~10
+sweep-equivalents: two tridiagonal sweeps, two half-RHS stencils and
+the transposes), so the verdict is deterministic on any host while
+the measured wall-clock rides beside it (``tpu_smoke.py`` records the
+real-hardware numbers).
+
+Emitted metric families (docs/ALGORITHMS.md): ``adi_time_to_solution_s``
+/ ``adi_wall_speedup`` / ``mg_time_to_solution_s`` gauges when a
+registry is given.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from heat2d_tpu.ops import analytic
+from heat2d_tpu.ops.stability import check_explicit_stability
+
+#: Step-cost model in explicit-sweep-equivalent units (the modeled
+#: wall-clock's deterministic backbone): one explicit step streams the
+#: grid once; an ADI step runs 2 tridiagonal sweeps (forward + back
+#: substitution each) + 2 half-RHS stencils + transpose traffic; an
+#: MG step runs MG_CYCLES V(2,2) cycles of smoothing sweeps (each a
+#: stencil pass) plus the transfer hierarchy (~4/3 of the finest
+#: level).
+STEP_UNITS = {"explicit": 1.0, "adi": 10.0, "mg": 16.0}
+
+#: Accuracy-match margin: the implicit leg's L2 error may exceed the
+#: explicit leg's by at most this factor (the analytic expectation is
+#: that it sits ORDERS below — O(dt^2) vs O(dt)), OR sit below the
+#: dtype's roundoff floor: an ADI step at diffusion number c forms
+#: intermediates ~c x the state that cancel back down, so its
+#: per-step roundoff is ~c*eps while the explicit leg's is ~eps —
+#: both are noise, not discretization error, and the floor keeps the
+#: verdict about the algorithm (under x64 the floor is irrelevant:
+#: truncation dominates and ADI sits strictly below — the f64 leg of
+#: the CI gate asserts exactly that).
+ACCURACY_MARGIN = 1.5
+
+
+def accuracy_floor(dtype) -> float:
+    """Roundoff floor for the matched-accuracy verdict: ~400 eps
+    relative L2 (f32: ~5e-5; f64: ~9e-14, i.e. inert)."""
+    return 400.0 * float(np.finfo(np.dtype(dtype)).eps)
+
+
+def modeled_wall_s(method: str, nx: int, ny: int, steps: int,
+                   unit_mcells_per_s: float = 1000.0) -> float:
+    """Modeled time-to-solution: steps x per-step sweep units x the
+    per-sweep cell cost. The rate cancels out of every speedup ratio —
+    it only scales the absolute numbers."""
+    units = STEP_UNITS[method]
+    return steps * units * nx * ny / (unit_mcells_per_s * 1e6)
+
+
+def _run_leg(method: str, u0, steps: int, cx: float, cy: float,
+             use_kernels: bool):
+    """One timed leg: (final grid, elapsed_s). The runner is built per
+    leg and jitted; timing excludes compile/warmup (the reference
+    protocol, utils/timing.timed_call)."""
+    import jax
+    import jax.numpy as jnp
+
+    from heat2d_tpu.models import engine
+    from heat2d_tpu.ops.stencil import stencil_step
+    from heat2d_tpu.utils.timing import timed_call
+
+    u0 = jnp.asarray(u0)
+    if method == "explicit":
+        if use_kernels:
+            from heat2d_tpu.models.ensemble import _run_batch_band
+
+            def run(u):
+                c = jnp.full((1,), cx, u.dtype)
+                d = jnp.full((1,), cy, u.dtype)
+                return _run_batch_band(u[None], c, d, steps=steps)[0]
+        else:
+            def run(u):
+                return engine.run_fixed(
+                    lambda v: stencil_step(v, cx, cy,
+                                           accum_dtype=None),
+                    u, steps)[0]
+    elif method == "adi":
+        from heat2d_tpu.ops import tridiag as td
+        if use_kernels and td.adi_kernel_viable(*u0.shape, u0.dtype):
+            def run(u):
+                c = jnp.full((1,), cx, u.dtype)
+                d = jnp.full((1,), cy, u.dtype)
+                return td.batched_adi_kernel(u[None], c, d,
+                                             steps=steps)[0]
+        else:
+            def run(u):
+                return td.adi_multi_step(u, steps, cx, cy)
+    elif method == "mg":
+        from heat2d_tpu.ops import multigrid as mgrid
+
+        def run(u):
+            return mgrid.mg_multi_step(u, steps, cx, cy)
+    else:
+        raise ValueError(f"unknown method {method!r}")
+
+    fn = jax.jit(run)
+    out, elapsed = timed_call(fn, u0)
+    return np.asarray(out), float(elapsed)
+
+
+def time_to_solution(nx: int, ny: int, *, steps_explicit: int,
+                     step_ratio: int, cx: float = 0.2, cy: float = 0.2,
+                     methods=("explicit", "adi"), dtype=np.float32,
+                     use_kernels: bool = False,
+                     registry=None) -> dict:
+    """Run every method to the same ``t_final`` and compare.
+
+    The explicit leg runs ``steps_explicit`` steps at (cx, cy) —
+    validated against the stability box, the implicit legs skip the
+    check — and each implicit leg runs ``steps_explicit //
+    step_ratio`` steps at ``step_ratio``x the diffusion numbers: the
+    same dimensionless physical time ``that = c * steps`` on both
+    axes. Returns ``{"rows": [...], "summary": {...}}`` — the
+    ``time_to_solution`` block of bench records (bench.py,
+    docs/ALGORITHMS.md)."""
+    if step_ratio < 1:
+        raise ValueError(f"step_ratio must be >= 1, got {step_ratio}")
+    that_x = cx * steps_explicit
+    that_y = cy * steps_explicit
+    u0 = analytic.separable_mode(nx, ny, dtype)
+    ref = analytic.mode_solution(nx, ny, that_x, that_y, np.float64)
+
+    rows = []
+    for method in methods:
+        if method == "explicit":
+            steps, lcx, lcy = steps_explicit, cx, cy
+            # The explicit route's guard (ops/stability.py): a clear
+            # ConfigError naming the limit, BEFORE a diverging run.
+            check_explicit_stability(lcx, lcy,
+                                     where="time-to-solution explicit "
+                                           "leg")
+        else:
+            steps = max(1, steps_explicit // step_ratio)
+            lcx, lcy = that_x / steps, that_y / steps
+        u, elapsed = _run_leg(method, u0, steps, lcx, lcy, use_kernels)
+        rows.append({
+            "method": method,
+            "steps": steps,
+            "cx": lcx, "cy": lcy,
+            "time_to_solution_s": elapsed,
+            "modeled_s": modeled_wall_s(method, nx, ny, steps),
+            "accuracy": analytic.l2_error(u, ref),
+        })
+
+    by = {r["method"]: r for r in rows}
+    summary = {"nx": nx, "ny": ny, "that_x": that_x, "that_y": that_y,
+               "dtype": np.dtype(dtype).name}
+    if "explicit" in by:
+        exp = by["explicit"]
+        for method, r in by.items():
+            if method == "explicit":
+                continue
+            tag = method
+            summary[f"{tag}_steps_ratio"] = exp["steps"] / r["steps"]
+            summary[f"{tag}_wall_speedup"] = (
+                exp["time_to_solution_s"] / r["time_to_solution_s"]
+                if r["time_to_solution_s"] > 0 else float("nan"))
+            summary[f"{tag}_modeled_speedup"] = (
+                exp["modeled_s"] / r["modeled_s"])
+            summary[f"{tag}_matched_accuracy"] = bool(
+                r["accuracy"] <= max(ACCURACY_MARGIN * exp["accuracy"],
+                                     accuracy_floor(dtype)))
+    if registry is not None:
+        if "adi" in by:
+            registry.gauge("adi_time_to_solution_s",
+                           by["adi"]["time_to_solution_s"])
+            if "adi_wall_speedup" in summary:
+                registry.gauge("adi_wall_speedup",
+                               summary["adi_wall_speedup"])
+        if "mg" in by:
+            registry.gauge("mg_time_to_solution_s",
+                           by["mg"]["time_to_solution_s"])
+    return {"rows": rows, "summary": summary}
+
+
+def bench_tts(quick: bool = False, on_tpu: bool = False,
+              registry=None) -> dict:
+    """The bench.py / tpu_smoke.py shape of the comparison: explicit
+    at the stability edge vs ADI at 256x the step size, grid sized so
+    the explicit leg stays a sub-second side measurement beside the
+    headline Mcells/s run."""
+    nx = ny = 257 if quick else 513
+    steps = 640 if quick else 2560
+    return time_to_solution(
+        nx, ny, steps_explicit=steps, step_ratio=256,
+        cx=0.2, cy=0.2, use_kernels=on_tpu, registry=registry)

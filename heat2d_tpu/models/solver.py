@@ -154,6 +154,9 @@ class Heat2DSolver:
             return self._runner
 
         accum = jnp.dtype(cfg.accum_dtype)
+        if cfg.method != "explicit":
+            self._runner = self._make_implicit_runner(accum, tap)
+            return self._runner
         if cfg.mode == "pallas":
             try:
                 from heat2d_tpu.ops.pallas_stencil import (
@@ -189,6 +192,59 @@ class Heat2DSolver:
 
         self._runner = jax.jit(run)
         return self._runner
+
+    def _make_implicit_runner(self, accum, tap):
+        """Compiled runner for the implicit schemes (config.method
+        "adi"/"mg"): the SAME engine loops drive a Crank-Nicolson
+        step instead of the explicit stencil — fixed-step through one
+        fused multi-step, convergence through the chunked loop with
+        the usual residual pair. Unconditionally stable: (cx, cy) are
+        dt-scaled diffusion numbers chosen by accuracy, not by the
+        kx+ky <= 1/2 box (ops/stability.py; config validated this).
+        mode="pallas" + method="adi" engages kernel TD
+        (ops/tridiag.py) on viable shapes; everything else runs the
+        scan/jnp route."""
+        cfg = self.config
+        from heat2d_tpu.ops import multigrid as mgrid
+        from heat2d_tpu.ops import tridiag as td
+
+        if cfg.method == "adi":
+            use_kernel = (cfg.mode == "pallas"
+                          and td.adi_kernel_viable(cfg.nxprob,
+                                                   cfg.nyprob))
+            if use_kernel:
+                cxa = jnp.full((1,), cfg.cx, jnp.float32)
+                cya = jnp.full((1,), cfg.cy, jnp.float32)
+
+                def step(u):
+                    return td.adi_sweep_kernel(u[None], cxa, cya)[0]
+
+                def multi(u, n):
+                    return td.batched_adi_kernel(u[None], cxa, cya,
+                                                 steps=n)[0]
+            else:
+                def step(u):
+                    return td.adi_step(u, cfg.cx, cfg.cy)
+
+                def multi(u, n):
+                    return td.adi_multi_step(u, n, cfg.cx, cfg.cy)
+        else:
+            def step(u):
+                return mgrid.mg_step(u, cfg.cx, cfg.cy)
+
+            def multi(u, n):
+                return mgrid.mg_multi_step(u, n, cfg.cx, cfg.cy)
+
+        def run(u):
+            if cfg.convergence:
+                return engine.run_convergence_chunked(
+                    multi, step, lambda a, b: residual_sq(a, b, accum),
+                    u, cfg.steps, cfg.interval, cfg.sensitivity,
+                    tap=tap)
+            u = multi(u, cfg.steps)
+            return u, jnp.asarray(cfg.steps, jnp.int32)
+
+        return jax.jit(run)
 
     def run(self, u0=None, timed: bool = True, warmup: bool = True,
             gather: bool = True) -> RunResult:

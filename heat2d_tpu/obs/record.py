@@ -18,6 +18,17 @@ Observability payload rows (PR 9, docs/OBSERVABILITY.md):
   emitted, post-mortem count for fleets).
 - ``slo`` — per-signature SLO evaluation rows (obs/slo.py: p50/p99 vs
   target, error rate, burn rate, ok) when an SLO target was given.
+
+Algorithmic-speed payload rows (PR 14, docs/ALGORITHMS.md): bench
+records (and the tpu_smoke implicit section) carry a
+``time_to_solution`` block — per-method rows with ``steps``,
+``time_to_solution_s`` (measured wall-clock to a fixed physical
+t_final), ``modeled_s`` (the deterministic step-cost model), and
+``accuracy`` (L2 error vs the analytic separable-mode solution), plus
+a summary with per-route ``*_steps_ratio`` / ``*_wall_speedup`` /
+``*_modeled_speedup`` / ``*_matched_accuracy`` — so BENCH_r*
+trajectories compare methods at equal ACCURACY, not equal steps
+(models/solution.py).
 """
 
 from __future__ import annotations

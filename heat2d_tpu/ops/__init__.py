@@ -5,6 +5,11 @@ from heat2d_tpu.ops.stencil import (
     stencil_step_var,
     residual_sq,
 )
+from heat2d_tpu.ops.stability import (
+    check_explicit_stability,
+    is_implicit,
+    stability_limit,
+)
 
 __all__ = [
     "inidat",
@@ -13,4 +18,7 @@ __all__ = [
     "stencil_step_padded",
     "stencil_step_var",
     "residual_sq",
+    "check_explicit_stability",
+    "is_implicit",
+    "stability_limit",
 ]

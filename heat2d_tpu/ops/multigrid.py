@@ -1,0 +1,183 @@
+"""Geometric multigrid V-cycle — the preconditioned iterative route.
+
+The second implicit time-stepping route (``method="mg"``): instead of
+splitting the Crank-Nicolson operator into 1-D tridiagonal factors
+(``ops/tridiag.py``), solve the UNSPLIT 2-D system
+
+    A u1 = (I - cx/2 dxx - cy/2 dyy) u1 = (I + cx/2 dxx + cy/2 dyy) u
+
+per step with a fixed number of geometric V-cycles. No splitting
+error (pure O(dt^2) CN), and the machinery is exactly what a
+steady-state / convergence solve wants: A is an SPD shifted Laplacian,
+so each V(nu1, nu2) cycle contracts the error by a grid-independent
+factor — the step count to a fixed residual does not grow with
+resolution, unlike every pointwise iteration.
+
+The smoother REUSES the existing explicit stencil kernel: one damped-
+Jacobi sweep on ``A u = rhs`` is algebraically
+
+    u <- stencil_step(u, w*cx/(2D), w*cy/(2D)) + (w/D) * (rhs - u)
+
+with ``D = 1 + cx + cy`` (the diagonal of A) — the same 5-point
+update the explicit route saturates the VPU with, at rescaled
+coefficients, plus an elementwise correction (docs/ALGORITHMS.md
+derives this). Restriction is full-weighting, prolongation bilinear,
+the coarse operator the rediscretized CN system (diffusion numbers
+scale by 1/4 per level — c ~ 1/dx^2). Vertex-centered coarsening
+applies while both dimensions are odd (2^k + 1 grids coarsen to
+3x3); a dimension that cannot coarsen stops the hierarchy and the
+coarsest level is relaxed to convergence with extra smoothing
+sweeps.
+
+Edges are held (clamped BC) at every level: the residual is zero on
+edges, so coarse corrections vanish there by construction.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax
+
+from heat2d_tpu.ops.stencil import stencil_step
+
+#: default cycle shape: nu1/nu2 pre/post smoothing sweeps, coarsest-
+#: level relaxation count, V-cycles per CN step.
+MG_NU1 = 2
+MG_NU2 = 2
+MG_COARSE_SWEEPS = 24
+MG_CYCLES = 2
+MG_OMEGA = 0.8          # damped-Jacobi weight (4/5 is optimal for the
+#                         pure 5-point Laplacian; A is easier)
+MG_MIN_SIZE = 5         # stop coarsening below 5 points per axis
+
+
+def _interior(x):
+    return x[..., 1:-1, 1:-1]
+
+
+def cn_apply(u, cx, cy):
+    """``A u`` on the interior with held edges passed through:
+    ``u - (cx/2) dxx u - (cy/2) dyy u`` (edge cells: identity rows)."""
+    c = _interior(u)
+    sx = u[2:, 1:-1] + u[:-2, 1:-1]
+    sy = u[1:-1, 2:] + u[1:-1, :-2]
+    new = c - 0.5 * cx * (sx - 2.0 * c) - 0.5 * cy * (sy - 2.0 * c)
+    return u.at[1:-1, 1:-1].set(new)
+
+
+def cn_rhs(u, cx, cy):
+    """The CN right-hand side ``(I + cx/2 dxx + cy/2 dyy) u`` on the
+    interior, edges passed through (the held boundary values the
+    identity rows consume)."""
+    c = _interior(u)
+    sx = u[2:, 1:-1] + u[:-2, 1:-1]
+    sy = u[1:-1, 2:] + u[1:-1, :-2]
+    new = c + 0.5 * cx * (sx - 2.0 * c) + 0.5 * cy * (sy - 2.0 * c)
+    return u.at[1:-1, 1:-1].set(new)
+
+
+def residual(u, rhs, cx, cy):
+    """``rhs - A u`` on the interior, ZERO on edges (identity rows are
+    satisfied exactly once the edge values are held)."""
+    r = rhs - cn_apply(u, cx, cy)
+    return jnp.zeros_like(r).at[1:-1, 1:-1].set(_interior(r))
+
+
+def smooth(u, rhs, cx, cy, omega: float = MG_OMEGA):
+    """One damped-Jacobi sweep on ``A u = rhs`` — the existing
+    explicit stencil kernel at rescaled coefficients plus an
+    elementwise correction (module docstring). Edges held."""
+    dinv = omega / (1.0 + cx + cy)
+    s = stencil_step(u, 0.5 * cx * dinv, 0.5 * cy * dinv,
+                     accum_dtype=None)
+    corr = dinv * (_interior(rhs) - _interior(u))
+    return s.at[1:-1, 1:-1].set(_interior(s) + corr)
+
+
+def can_coarsen(nx: int, ny: int) -> bool:
+    """Vertex-centered coarsening keeps the boundary in place only on
+    odd sizes; both axes must stay >= MG_MIN_SIZE after halving."""
+    return (nx % 2 == 1 and ny % 2 == 1
+            and (nx - 1) // 2 + 1 >= MG_MIN_SIZE
+            and (ny - 1) // 2 + 1 >= MG_MIN_SIZE)
+
+
+def restrict(r):
+    """Full-weighting restriction of a zero-edge residual onto the
+    (nc, mc) = ((n+1)/2, (m+1)/2) coarse grid: the [1 2 1]^T[1 2 1]/16
+    stencil at even fine points; coarse edges stay zero."""
+    c = r[2:-2:2, 2:-2:2]
+    n4 = (r[1:-3:2, 2:-2:2] + r[3:-1:2, 2:-2:2]
+          + r[2:-2:2, 1:-3:2] + r[2:-2:2, 3:-1:2])
+    d4 = (r[1:-3:2, 1:-3:2] + r[1:-3:2, 3:-1:2]
+          + r[3:-1:2, 1:-3:2] + r[3:-1:2, 3:-1:2])
+    interior = (4.0 * c + 2.0 * n4 + d4) / 16.0
+    nc = (r.shape[0] - 1) // 2 + 1
+    mc = (r.shape[1] - 1) // 2 + 1
+    out = jnp.zeros((nc, mc), r.dtype)
+    return out.at[1:-1, 1:-1].set(interior)
+
+
+def prolong(e, shape):
+    """Bilinear prolongation of a zero-edge coarse correction onto the
+    fine grid ``shape``: coincident points copy, edge-midpoints
+    average 2 neighbors, cell-centers average 4."""
+    n, m = shape
+    out = jnp.zeros(shape, e.dtype)
+    out = out.at[::2, ::2].set(e)
+    out = out.at[1::2, ::2].set(0.5 * (e[:-1, :] + e[1:, :]))
+    out = out.at[::2, 1::2].set(0.5 * (e[:, :-1] + e[:, 1:]))
+    out = out.at[1::2, 1::2].set(
+        0.25 * (e[:-1, :-1] + e[:-1, 1:] + e[1:, :-1] + e[1:, 1:]))
+    return out
+
+
+def v_cycle(u, rhs, cx, cy, nu1: int = MG_NU1, nu2: int = MG_NU2):
+    """One V(nu1, nu2) cycle on ``A u = rhs`` (static recursion —
+    level shapes are compile-time constants, so the whole cycle traces
+    into one program)."""
+    for _ in range(nu1):
+        u = smooth(u, rhs, cx, cy)
+    nx, ny = u.shape
+    if can_coarsen(nx, ny):
+        r = residual(u, rhs, cx, cy)
+        rc = restrict(r)
+        # Rediscretized coarse operator: c ~ alpha*dt/dx^2, and the
+        # coarse spacing doubles -> diffusion numbers quarter.
+        ec = v_cycle(jnp.zeros_like(rc), rc, cx / 4.0, cy / 4.0,
+                     nu1, nu2)
+        u = u + prolong(ec, u.shape)
+    else:
+        for _ in range(MG_COARSE_SWEEPS):
+            u = smooth(u, rhs, cx, cy)
+    for _ in range(nu2):
+        u = smooth(u, rhs, cx, cy)
+    return u
+
+
+def mg_solve(u0, rhs, cx, cy, cycles: int = MG_CYCLES):
+    """``cycles`` V-cycles on ``A u = rhs`` from initial guess ``u0``."""
+    u = u0
+    for _ in range(cycles):
+        u = v_cycle(u, rhs, cx, cy)
+    return u
+
+
+def mg_step(u, cx, cy, cycles: int = MG_CYCLES):
+    """One Crank-Nicolson step at diffusion numbers (cx, cy), solved
+    by ``cycles`` V-cycles from the previous state as initial guess
+    (for smooth solutions the guess is O(dt) from the answer, so two
+    cycles land far below the CN truncation error). Unconditionally
+    stable; edges held."""
+    cx = jnp.asarray(cx, u.dtype)
+    cy = jnp.asarray(cy, u.dtype)
+    return mg_solve(u, cn_rhs(u, cx, cy), cx, cy, cycles=cycles)
+
+
+def mg_multi_step(u, steps: int, cx, cy, cycles: int = MG_CYCLES):
+    """``steps`` CN/multigrid steps."""
+    if steps == 0:
+        return u
+    return lax.fori_loop(0, steps,
+                         lambda _, v: mg_step(v, cx, cy, cycles=cycles),
+                         u, unroll=False)

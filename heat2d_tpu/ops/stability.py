@@ -1,0 +1,91 @@
+"""Explicit-scheme stability — the ONE home of the kx+ky <= 1/2 box.
+
+The forward-Euler 5-point update ``u' = u + cx*dxx(u) + cy*dyy(u)`` is
+stable iff ``cx + cy <= 1/2`` (von Neumann: the worst mode's
+amplification factor is ``1 - 4cx - 4cy``, inside [-1, 1] exactly on
+that box). Before this module the bound lived as magic numbers in
+``diff/inverse.py`` (the projected-iterate clamp) and as an implicit
+assumption everywhere else; it now lives here once:
+
+- ``stability_limit(dx, dy)`` — the physical form: the largest stable
+  ``alpha * dt`` for grid spacings (dx, dy). With the repo's
+  dimensionless convention (``cx = alpha*dt/dx**2``) and dx = dy = 1
+  this is the familiar 1/4 (i.e. cx = cy = 1/4, cx + cy = 1/2).
+- ``check_explicit_stability(cx, cy)`` — the explicit routes' guard: a
+  clear ``ConfigError`` naming the limit instead of a silently
+  diverging run. IMPLICIT routes (method "adi"/"mg",
+  ``ops/tridiag.py`` / ``ops/multigrid.py``) are unconditionally
+  stable and deliberately never call it — dt is chosen by accuracy
+  there, which is the whole algorithmic-speed story
+  (docs/ALGORITHMS.md).
+- ``KAPPA_MIN``/``KAPPA_MAX``/``project_stable`` — the inverse
+  driver's projected-iterate box (isotropic kappa: kx = ky = kappa,
+  so kappa <= 1/4; 0.24 leaves margin), re-exported by
+  ``diff/inverse.py`` for back-compat.
+
+jax-free on purpose: config validation and serving admission import
+this on host-side paths.
+"""
+
+from __future__ import annotations
+
+from heat2d_tpu.config import ConfigError
+
+#: The dimensionless coefficient-sum bound: cx + cy <= 1/2.
+EXPLICIT_COEFF_LIMIT = 0.5
+
+#: Stability box for projected diffusivity iterates (diff/inverse.py):
+#: isotropic kappa (kx = ky) must satisfy 2*kappa <= 1/2; 0.24 leaves
+#: margin below the exact 0.25, and the floor keeps the field physical
+#: (kappa >= 0) and the solve sensitive to it.
+KAPPA_MIN, KAPPA_MAX = 1e-4, 0.24
+
+#: Methods that skip the explicit stability box entirely (A-stable
+#: time discretizations: Crank-Nicolson ADI, multigrid-solved CN).
+IMPLICIT_METHODS = ("adi", "mg")
+
+
+def stability_limit(dx: float = 1.0, dy: float = 1.0) -> float:
+    """The largest stable ``alpha * dt`` for the explicit scheme on
+    spacings (dx, dy): ``1 / (2 * (dx**-2 + dy**-2))``. At dx = dy = 1
+    this is 1/4 — equivalently the dimensionless box
+    ``cx + cy <= 1/2`` with ``cx = alpha*dt/dx**2``."""
+    if dx <= 0 or dy <= 0:
+        raise ConfigError(f"grid spacings must be > 0, got dx={dx} "
+                          f"dy={dy}")
+    return 0.5 / (dx ** -2 + dy ** -2)
+
+
+def is_implicit(method: str) -> bool:
+    """True for unconditionally stable time-stepping routes — they
+    skip ``check_explicit_stability`` by design."""
+    return method in IMPLICIT_METHODS
+
+
+def check_explicit_stability(cx: float, cy: float,
+                             where: str = "explicit step") -> None:
+    """Explicit routes' guard: raise a ``ConfigError`` NAMING the
+    limit when (cx, cy) sit outside the stability box. Implicit
+    routes must not call this (``is_implicit``)."""
+    if cx < 0 or cy < 0:
+        raise ConfigError(
+            f"{where}: diffusivity coefficients must be >= 0, got "
+            f"cx={cx} cy={cy}")
+    if cx + cy > EXPLICIT_COEFF_LIMIT:
+        raise ConfigError(
+            f"{where}: cx + cy = {cx + cy:g} exceeds the explicit "
+            f"stability limit cx + cy <= {EXPLICIT_COEFF_LIMIT} "
+            f"(alpha*dt <= {stability_limit():g} at unit spacing — "
+            f"ops/stability.py). Use an implicit method "
+            f"(--method adi or mg), which is unconditionally stable, "
+            f"or reduce the time step")
+
+
+def project_stable(kappa):
+    """Clamp an isotropic per-cell diffusivity field into the
+    explicit stability box [KAPPA_MIN, KAPPA_MAX] — the inverse
+    driver's per-iterate projection (jax import deferred: the clamp
+    runs inside traced optimizer steps)."""
+    import jax.numpy as jnp
+
+    return jnp.clip(kappa, KAPPA_MIN, KAPPA_MAX)

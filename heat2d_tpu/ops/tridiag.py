@@ -1,0 +1,441 @@
+"""Batched tridiagonal solves + Crank-Nicolson ADI — the implicit
+time-stepping core (ROADMAP item 2: algorithmic speed).
+
+The explicit 5-point kernel sits at 98% of the memory-bandwidth bound,
+but its stability box (``cx + cy <= 1/2`` — ``ops/stability.py``)
+makes reaching a physical time ``t_final`` cost O(1/dx^2) steps. The
+Peaceman-Rachford ADI scheme here is UNCONDITIONALLY stable, so dt is
+chosen by accuracy (O(dt^2) — twice the explicit order) and typically
+100-1000x fewer steps reach the same answer at the same L2 error
+(``ops/analytic.py`` is the oracle; ``models/solution.py`` turns the
+comparison into the wall-clock-to-solution bench metric).
+
+One ADI step at diffusion numbers (cx, cy) = alpha*dt/dx^2:
+
+    half 1 (implicit in x):  (I - cx/2 dxx) u* = (I + cy/2 dyy) u
+    half 2 (implicit in y):  (I - cy/2 dyy) u1 = (I + cx/2 dxx) u*
+
+Each half is ny (resp. nx) INDEPENDENT constant-coefficient
+tridiagonal systems — a natural batched Thomas solve:
+
+- ``thomas_solve`` — the jnp golden model (lax.scan forward sweep +
+  back substitution, systems batched over trailing axes), carrying a
+  ``custom_vjp`` that IMPLICITLY differentiates the solve: the
+  backward pass solves the TRANSPOSE tridiagonal system instead of
+  unrolling the scan (``x = T^-1 b  =>  bbar = T^-T xbar``,
+  ``Tbar = -lam xbar^T`` restricted to the three bands). This is what
+  makes ``diff/adjoint.py``'s per-step pullback of the ADI operator
+  an O(n) solve rather than an O(n) stored scan — validated against
+  finite differences like PR 6 (tests/test_implicit.py).
+- A Pallas kernel (kernel TD) solving many systems along the LANE
+  dimension: the forward elimination's scalar recurrence runs in SMEM
+  scratch while each row op is a full (1, w) lane vector — the
+  sequential dependence lives on the 8-sublane axis, the parallelism
+  on the 128-lane axis. The y half runs either as an explicit
+  transpose + the same row kernel (``variant="xpose"``) or as a
+  strided second pass eliminating along lanes (``variant="strided"``)
+  — the two transpose strategies the autotune space measures
+  (``tune/space.py`` routes "adi" / "adi_s").
+
+Boundary semantics match the explicit kernels exactly: edge cells are
+never updated (identity boundary rows; ``_hold_edges`` restores the
+edge-column systems the lane-batched solve runs redundantly).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+
+#: Default lane-panel width for the row-solve kernel (the "adi" tune
+#: space's bm axis): panels this wide keep the VPU lanes full while
+#: bounding the per-program VMEM working set.
+DEFAULT_PANEL = 512
+
+#: Transpose strategies for the second (y) sweep.
+VARIANTS = ("xpose", "strided")
+
+
+# --------------------------------------------------------------------- #
+# jnp golden model: scan-based Thomas with implicit differentiation
+# --------------------------------------------------------------------- #
+
+def _thomas_primal(dl, d, du, rhs):
+    """Forward sweep + back substitution along axis 0. Bands are (n,)
+    vectors; ``rhs`` is (n, ...) — every trailing slice an independent
+    system. No pivoting: the CN matrices here are strictly diagonally
+    dominant (|1 + c| > 2 * |c/2|), where Thomas is unconditionally
+    stable."""
+    n = rhs.shape[0]
+    bshape = (n,) + (1,) * (rhs.ndim - 1)
+    dlb = jnp.reshape(dl.astype(rhs.dtype), bshape)
+    db = jnp.reshape(d.astype(rhs.dtype), bshape)
+    dub = jnp.reshape(du.astype(rhs.dtype), bshape)
+
+    def fwd(carry, row):
+        cp_prev, dp_prev = carry
+        dli, di, dui, bi = row
+        m = di - dli * cp_prev
+        cp = dui / m
+        dp = (bi - dli * dp_prev) / m
+        return (cp, dp), (cp, dp)
+
+    zero = jnp.zeros_like(rhs[0])
+    (_, _), (cps, dps) = lax.scan(fwd, (zero, zero),
+                                  (dlb, db, dub, rhs))
+
+    def back(x_next, row):
+        cp, dp = row
+        x = dp - cp * x_next
+        return x, x
+
+    _, xs = lax.scan(back, zero, (cps, dps), reverse=True)
+    return xs
+
+
+@jax.custom_vjp
+def thomas_solve(dl, d, du, rhs):
+    """Solve the tridiagonal system ``T x = rhs`` along axis 0, with
+    ``T``'s bands (dl, d, du): row i reads
+    ``dl[i]*x[i-1] + d[i]*x[i] + du[i]*x[i+1] = rhs[i]``
+    (``dl[0]`` and ``du[n-1]`` are ignored by convention — pass 0).
+    ``rhs`` may carry trailing batch axes (independent systems).
+
+    Differentiable in all four arguments via IMPLICIT differentiation
+    (module docstring): reverse-mode costs one transpose-system solve,
+    never a stored elimination trace."""
+    return _thomas_primal(dl, d, du, rhs)
+
+
+def _thomas_fwd(dl, d, du, rhs):
+    x = _thomas_primal(dl, d, du, rhs)
+    return x, (dl, d, du, x)
+
+
+def _thomas_bwd(res, xbar):
+    dl, d, du, x = res
+    # lam = T^-T xbar: the transpose's bands are the shifted originals
+    # ((T^T)[i, i-1] = T[i-1, i] = du[i-1]).
+    dl_t = jnp.concatenate([jnp.zeros((1,), du.dtype), du[:-1]])
+    du_t = jnp.concatenate([dl[1:], jnp.zeros((1,), dl.dtype)])
+    lam = _thomas_primal(dl_t, d, du_t, xbar)
+    axes = tuple(range(1, x.ndim))
+    zero_row = jnp.zeros_like(x[:1])
+    x_up = jnp.concatenate([zero_row, x[:-1]])    # x[i-1]
+    x_dn = jnp.concatenate([x[1:], zero_row])     # x[i+1]
+    dl_bar = -jnp.sum(lam * x_up, axis=axes).astype(dl.dtype)
+    d_bar = -jnp.sum(lam * x, axis=axes).astype(d.dtype)
+    du_bar = -jnp.sum(lam * x_dn, axis=axes).astype(du.dtype)
+    return dl_bar, d_bar, du_bar, lam
+
+
+thomas_solve.defvjp(_thomas_fwd, _thomas_bwd)
+
+
+# --------------------------------------------------------------------- #
+# the CN-ADI step (jnp route)
+# --------------------------------------------------------------------- #
+
+def _cn_bands(n: int, c, dtype):
+    """Bands of the half-step matrix ``I - (c/2) dxx`` with identity
+    boundary rows (edges held — the clamped BC of every kernel in
+    this repo): interior rows (-c/2, 1+c, -c/2), rows 0/n-1 (0, 1, 0).
+    ``c`` may be a traced scalar — the bands are differentiable."""
+    c = jnp.asarray(c, dtype)
+    i = jnp.arange(n)
+    interior = (i >= 1) & (i <= n - 2)
+    a = jnp.where(interior, -0.5 * c, jnp.zeros((), dtype))
+    d = jnp.where(interior, 1.0 + c, jnp.ones((), dtype))
+    return a, d, a
+
+
+def _rhs_half(u, c, axis: int):
+    """``u + (c/2) * d2(u)`` along ``axis`` on the FULL interior,
+    edges passed through unchanged (they are the held boundary values
+    the identity rows consume). Works batched: ``u`` is (..., nx, ny)
+    and ``axis`` counts from the grid dims (0 = rows, 1 = cols);
+    ``c`` broadcasts (scalar, or (B, 1, 1) per-member)."""
+    c = 0.5 * c
+    ctr = u[..., 1:-1, 1:-1]
+    if axis == 0:
+        s = u[..., 2:, 1:-1] + u[..., :-2, 1:-1]
+    else:
+        s = u[..., 1:-1, 2:] + u[..., 1:-1, :-2]
+    new = ctr + c * (s - 2.0 * ctr)
+    mid = jnp.concatenate(
+        [u[..., 1:-1, :1], new, u[..., 1:-1, -1:]], axis=-1)
+    return jnp.concatenate([u[..., :1, :], mid, u[..., -1:, :]],
+                           axis=-2)
+
+
+def _hold_edges(v, u):
+    """Restore the held boundary from ``u`` on all four edges of
+    ``v`` (the lane-batched solves run the edge-column systems
+    redundantly; identity rows already keep edge ROWS exact)."""
+    mid = jnp.concatenate(
+        [u[..., 1:-1, :1], v[..., 1:-1, 1:-1], u[..., 1:-1, -1:]],
+        axis=-1)
+    return jnp.concatenate([u[..., :1, :], mid, u[..., -1:, :]],
+                           axis=-2)
+
+
+def adi_step(u, cx, cy):
+    """One Peaceman-Rachford ADI step on an (nx, ny) grid at diffusion
+    numbers (cx, cy) — unconditionally stable, O(dt^2) accurate,
+    edges held. Differentiable in (u, cx, cy): the tridiagonal solves
+    carry the implicit-diff custom_vjp, so ``diff/adjoint.py`` can
+    wrap this step exactly like the explicit one."""
+    nx, ny = u.shape[-2], u.shape[-1]
+    cx = jnp.asarray(cx, u.dtype)
+    cy = jnp.asarray(cy, u.dtype)
+    rhs1 = _rhs_half(u, cy, axis=1)
+    dl, d, du = _cn_bands(nx, cx, u.dtype)
+    ustar = _hold_edges(thomas_solve(dl, d, du, rhs1), u)
+    rhs2 = _rhs_half(ustar, cx, axis=0)
+    dl, d, du = _cn_bands(ny, cy, u.dtype)
+    u1 = thomas_solve(dl, d, du, jnp.swapaxes(rhs2, -1, -2))
+    return _hold_edges(jnp.swapaxes(u1, -1, -2), u)
+
+
+def adi_multi_step(u, steps: int, cx, cy):
+    """``steps`` ADI steps (jnp route). The band/elimination
+    coefficients are loop-invariant — XLA hoists them out of the
+    fori_loop, so the per-step cost is the two sweeps alone."""
+    if steps == 0:
+        return u
+    return lax.fori_loop(0, steps,
+                         lambda _, v: adi_step(v, cx, cy), u,
+                         unroll=False)
+
+
+# --------------------------------------------------------------------- #
+# kernel TD: batched Thomas along the lane dimension (Pallas)
+# --------------------------------------------------------------------- #
+
+def _coeff_loops(s_ref, cp_ref, mi_ref, n: int):
+    """The scalar elimination recurrence into SMEM scratch: cp/mi are
+    the per-row back-substitution and normalization scalars of the
+    constant-coefficient CN matrix (identity boundary rows). Runs once
+    per program — O(n) scalar work against O(n*w) vector work."""
+    c = s_ref[0, 0, 0]
+    a = -0.5 * c
+    b = 1.0 + c
+    cp_ref[0] = jnp.zeros((), cp_ref.dtype)
+    mi_ref[0] = jnp.ones((), mi_ref.dtype)
+
+    def coeff(i, _):
+        interior = jnp.logical_and(i >= 1, i <= n - 2)
+        ai = jnp.where(interior, a, 0.0)
+        bi = jnp.where(interior, b, 1.0)
+        m = bi - ai * cp_ref[i - 1]
+        mi_ref[i] = 1.0 / m
+        cp_ref[i] = jnp.where(interior, a, 0.0) / m
+        return 0
+
+    lax.fori_loop(1, n, coeff, 0, unroll=False)
+    return a
+
+
+def _tridiag_rows_kernel(s_ref, rhs_ref, out_ref, cp_ref, mi_ref, *, n):
+    """Solve along axis 0 (sublanes) of one member's (n, w) lane
+    panel: every lane an independent system. Forward sweep and back
+    substitution walk rows sequentially; each row op is a (1, w)
+    vector — the lane axis carries the batch parallelism."""
+    a = _coeff_loops(s_ref, cp_ref, mi_ref, n)
+    out_ref[0, 0, :] = rhs_ref[0, 0, :]
+
+    def fwd(i, _):
+        ai = jnp.where(jnp.logical_and(i >= 1, i <= n - 2), a, 0.0)
+        prev = out_ref[0, pl.ds(i - 1, 1), :]
+        out_ref[0, pl.ds(i, 1), :] = (
+            rhs_ref[0, pl.ds(i, 1), :] - ai * prev) * mi_ref[i]
+        return 0
+
+    lax.fori_loop(1, n, fwd, 0, unroll=False)
+
+    def back(k, _):
+        i = n - 2 - k
+        nxt = out_ref[0, pl.ds(i + 1, 1), :]
+        out_ref[0, pl.ds(i, 1), :] = (
+            out_ref[0, pl.ds(i, 1), :] - cp_ref[i] * nxt)
+        return 0
+
+    lax.fori_loop(0, n - 1, back, 0, unroll=False)
+
+
+def _tridiag_lanes_kernel(s_ref, rhs_ref, out_ref, cp_ref, mi_ref, *, n):
+    """The STRIDED second pass: solve along axis 1 (lanes) of one
+    member's (h, n) row panel — every sublane row an independent
+    system, elimination marching across lanes. Lane-serial by
+    construction (each op touches an (h, 1) column): the honest
+    no-transpose alternative the tune space measures against
+    ``variant="xpose"``."""
+    a = _coeff_loops(s_ref, cp_ref, mi_ref, n)
+    out_ref[0, :, pl.ds(0, 1)] = rhs_ref[0, :, pl.ds(0, 1)]
+
+    def fwd(j, _):
+        aj = jnp.where(jnp.logical_and(j >= 1, j <= n - 2), a, 0.0)
+        prev = out_ref[0, :, pl.ds(j - 1, 1)]
+        out_ref[0, :, pl.ds(j, 1)] = (
+            rhs_ref[0, :, pl.ds(j, 1)] - aj * prev) * mi_ref[j]
+        return 0
+
+    lax.fori_loop(1, n, fwd, 0, unroll=False)
+
+    def back(k, _):
+        j = n - 2 - k
+        nxt = out_ref[0, :, pl.ds(j + 1, 1)]
+        out_ref[0, :, pl.ds(j, 1)] = (
+            out_ref[0, :, pl.ds(j, 1)] - cp_ref[j] * nxt)
+        return 0
+
+    lax.fori_loop(0, n - 1, back, 0, unroll=False)
+
+
+def plan_adi_panel(ny: int, panel: int | None = None) -> int:
+    """Lane-panel width for the row-solve kernel: the largest divisor
+    of ``ny`` that is <= the target and lane-aligned when possible —
+    panels partition the lane axis exactly (no pad lanes to firewall:
+    every lane is a real system)."""
+    # ``panel`` is a static host-side knob (the tune space's bm axis),
+    # never a traced value.
+    target = DEFAULT_PANEL if panel is None else panel
+    if target >= ny or ny <= 0:
+        return ny
+    for w in range(min(target, ny), 0, -1):
+        if ny % w == 0 and (w % 128 == 0 or w == ny or ny % 128):
+            return w
+    return ny
+
+
+def _solve_rows(scal, rhs, bn: int):
+    """Batched x-solve: grid (B, ny/bn) over members x lane panels,
+    each program solving its panel's systems along axis 0."""
+    from heat2d_tpu.ops.pallas_stencil import (_interpret, _mem_spaces,
+                                               _parallel_grid)
+
+    b, n, ny = rhs.shape
+    npan = ny // bn
+    mspace, smem = _mem_spaces()
+    return pl.pallas_call(
+        functools.partial(_tridiag_rows_kernel, n=n),
+        out_shape=jax.ShapeDtypeStruct(rhs.shape, rhs.dtype),
+        grid=(b, npan),
+        in_specs=[
+            pl.BlockSpec((1, 1, 1), lambda i, j: (i, 0, 0), **smem),
+            pl.BlockSpec((1, n, bn), lambda i, j: (i, 0, j), **mspace),
+        ],
+        out_specs=pl.BlockSpec((1, n, bn), lambda i, j: (i, 0, j),
+                               **mspace),
+        scratch_shapes=[_smem_scratch(n, rhs.dtype),
+                        _smem_scratch(n, rhs.dtype)],
+        interpret=_interpret(),
+        **_parallel_grid(2))(scal, rhs)
+
+
+def _solve_lanes(scal, rhs, bp: int):
+    """Batched strided y-solve: grid (B, nx/bp) over members x row
+    panels, each program eliminating along the full lane axis."""
+    from heat2d_tpu.ops.pallas_stencil import (_interpret, _mem_spaces,
+                                               _parallel_grid)
+
+    b, nx, n = rhs.shape
+    npan = nx // bp
+    mspace, smem = _mem_spaces()
+    return pl.pallas_call(
+        functools.partial(_tridiag_lanes_kernel, n=n),
+        out_shape=jax.ShapeDtypeStruct(rhs.shape, rhs.dtype),
+        grid=(b, npan),
+        in_specs=[
+            pl.BlockSpec((1, 1, 1), lambda i, j: (i, 0, 0), **smem),
+            pl.BlockSpec((1, bp, n), lambda i, j: (i, j, 0), **mspace),
+        ],
+        out_specs=pl.BlockSpec((1, bp, n), lambda i, j: (i, j, 0),
+                               **mspace),
+        scratch_shapes=[_smem_scratch(n, rhs.dtype),
+                        _smem_scratch(n, rhs.dtype)],
+        interpret=_interpret(),
+        **_parallel_grid(2))(scal, rhs)
+
+
+def _smem_scratch(n: int, dtype):
+    """(n,) scalar scratch for the elimination recurrence — SMEM on
+    the chip; the interpreter allocates a host buffer either way."""
+    from jax.experimental.pallas import tpu as pltpu
+    return pltpu.SMEM((n,), dtype)
+
+
+def adi_kernel_viable(nx: int, ny: int, dtype=jnp.float32) -> bool:
+    """Gate for the Pallas TD route on a REAL TPU backend: f32,
+    lane-aligned width, and the member resident in VMEM (the
+    band-streamed tridiag is future work — off-envelope shapes keep
+    the scan route, which is correct everywhere)."""
+    from heat2d_tpu.ops import pallas_stencil as ps
+
+    return (ps._on_tpu() and jnp.dtype(dtype) == jnp.float32
+            and ny % 128 == 0 and nx % 8 == 0
+            and ps.fits_vmem((nx, ny), dtype))
+
+
+def adi_sweep_kernel(u, cxs, cys, *, panel=None, variant="xpose"):
+    """One batched ADI step through kernel TD. ``u`` is (B, nx, ny);
+    ``cxs``/``cys`` per-member diffusion numbers. ``variant`` picks
+    the second pass: "xpose" (explicit transpose + row kernel) or
+    "strided" (lane-elimination kernel, no transpose)."""
+    if variant not in VARIANTS:
+        raise ValueError(
+            f"variant must be one of {VARIANTS}, got {variant!r}")
+    b, nx, ny = u.shape
+    cb = jnp.reshape(jnp.asarray(cxs, u.dtype), (b, 1, 1))
+    db_ = jnp.reshape(jnp.asarray(cys, u.dtype), (b, 1, 1))
+    bn = plan_adi_panel(ny, panel)
+    rhs1 = _rhs_half(u, db_, 1)
+    ustar = _hold_edges(_solve_rows(cb, rhs1, bn), u)
+    rhs2 = _rhs_half(ustar, cb, 0)
+    if variant == "xpose":
+        bp = plan_adi_panel(nx, panel)
+        u1 = _solve_rows(db_, jnp.swapaxes(rhs2, 1, 2), bp)
+        u1 = jnp.swapaxes(u1, 1, 2)
+    else:
+        bp = plan_adi_panel(nx, panel)
+        u1 = _solve_lanes(db_, rhs2, bp)
+    return _hold_edges(u1, u)
+
+
+# --------------------------------------------------------------------- #
+# batched multi-step entries (the ensemble runners' building blocks)
+# --------------------------------------------------------------------- #
+
+def batched_adi_scan(u0, cxs, cys, *, steps: int):
+    """(B, nx, ny) batch advanced ``steps`` ADI steps through the jnp
+    scan route (vmapped per member) — correct on every backend/dtype;
+    the serve route off the kernel envelope and the diff primal."""
+    if steps == 0:
+        return u0
+    cxs = jnp.asarray(cxs, u0.dtype)
+    cys = jnp.asarray(cys, u0.dtype)
+
+    def one(u, cx, cy):
+        return adi_multi_step(u, steps, cx, cy)
+
+    return jax.vmap(one)(u0, cxs, cys)
+
+
+def batched_adi_kernel(u0, cxs, cys, *, steps: int, panel=None,
+                       variant="xpose"):
+    """Kernel-TD route: ``steps`` batched sweeps, time loop outside
+    the kernel (each step is 2 tridiagonal launches + the elementwise
+    half-RHS stencils, which XLA fuses around them)."""
+    if steps == 0:
+        return u0
+    cxs = jnp.asarray(cxs, u0.dtype)
+    cys = jnp.asarray(cys, u0.dtype)
+    return lax.fori_loop(
+        0, steps,
+        lambda _, v: adi_sweep_kernel(v, cxs, cys, panel=panel,
+                                      variant=variant),
+        u0, unroll=False)

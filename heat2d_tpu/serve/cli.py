@@ -137,6 +137,28 @@ def _selftest_workload(client):
                 np.asarray(results[-2].u).tobytes():
             failures.append("coalesced duplicates returned different "
                             "grids")
+
+    # Implicit route: a method="adi" request (diffusion numbers far
+    # past the explicit stability box — the implicit win) must answer
+    # through the real server path and answer bitwise-repeatably (the
+    # repeat is a cache hit sharing the stored grid). The stronger
+    # across-LAUNCH-CAPACITY pad-parity leg needs independent engines,
+    # so it lives in analysis/implicit_gate.py leg 2 and
+    # tests/test_implicit.py, not here.
+    import numpy as np
+    adi = SolveRequest(nx=24, ny=32, steps=4, cx=8.0, cy=6.0,
+                       method="adi")
+    try:
+        first = client.solve(adi, timeout=120)
+        again = client.solve(adi, timeout=60)
+        fired += 2
+        if not again.cache_hit:
+            failures.append("adi repeat was not a cache hit")
+        if np.asarray(again.u).tobytes() != \
+                np.asarray(first.u).tobytes():
+            failures.append("adi repeat not bitwise-identical")
+    except Exception as e:  # noqa: BLE001 — report, don't crash
+        failures.append(f"adi request failed: {e!r}")
     return fired, failures
 
 

@@ -28,7 +28,13 @@ import json
 #: at the ensemble entry — cli.py's unsupported-flag check).
 SUPPORTED_DTYPES = ("float32",)
 
-SUPPORTED_METHODS = ("auto", "jnp", "pallas", "band")
+#: "adi"/"mg" are the implicit time-stepping routes (ops/tridiag.py,
+#: ops/multigrid.py): unconditionally stable, so a request's (cx, cy)
+#: are dt-scaled diffusion numbers far past the explicit kx+ky <= 1/2
+#: box — the ensemble runners dispatch them like any other method and
+#: the whole serving stack (signature bucketing, padded-capacity
+#: compile ladder, mesh sharding) absorbs them unchanged.
+SUPPORTED_METHODS = ("auto", "jnp", "pallas", "band", "adi", "mg")
 
 
 class Rejected(Exception):

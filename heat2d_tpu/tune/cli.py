@@ -127,10 +127,15 @@ def search_problem(db: TuningDB, problem: Problem, *, backend=None,
     key = problem.key()
 
     def key_for(c):
-        # Fused points measure a multi-chip mesh program — they live
-        # in their own frontier so a global-mesh rate can never win
-        # the single-chip best (and vice versa); see Problem.fused_key.
-        return problem.fused_key() if c.route == "fused" else key
+        # Fused points measure a multi-chip mesh program, ADI points a
+        # different ALGORITHM's per-step cost — each lives in its own
+        # frontier so neither can win (or be shadowed by) the
+        # single-chip explicit best; see Problem.fused_key/adi_key.
+        if c.route == "fused":
+            return problem.fused_key()
+        if c.route.startswith("adi"):
+            return problem.adi_key()
+        return key
 
     cands, pruned = candidate_space(
         problem, routes=routes, bm_grid=bm_grid, t_ladder=t_ladder,
@@ -142,7 +147,7 @@ def search_problem(db: TuningDB, problem: Problem, *, backend=None,
     measured_already = {
         k: db.measured_keys(
             kind, k, ("ok", "oom", "compile_error", "timeout", "error"))
-        for k in (key, problem.fused_key())}
+        for k in (key, problem.fused_key(), problem.adi_key())}
     wrote_pruned = False
     for c, reason in pruned:
         if (c.route, c.bm, c.tsteps) in measured_already[key_for(c)]:
@@ -160,7 +165,7 @@ def search_problem(db: TuningDB, problem: Problem, *, backend=None,
     terminal = (tuple(s for s in TERMINAL_STATUSES if s != "pruned")
                 if probe_past_envelope else TERMINAL_STATUSES)
     done = {k: db.measured_keys(kind, k, terminal)
-            for k in (key, problem.fused_key())}
+            for k in (key, problem.fused_key(), problem.adi_key())}
     measured = failed = cached = 0
     u = None
     if backend is None and any(
@@ -193,7 +198,7 @@ def search_problem(db: TuningDB, problem: Problem, *, backend=None,
         registry.counter("tune_points_cached_total", value=cached)
 
     best = None
-    for k in (key, problem.fused_key()):
+    for k in (key, problem.fused_key(), problem.adi_key()):
         entry = db.entry(kind, k)
         ok_points = [p for p in (entry or {}).get("points", [])
                      if p.get("status") == "ok"]

@@ -320,6 +320,37 @@ def _measure_real(u, problem: Problem, cand: Candidate, *, lo, hi, reps,
             cand, "ok", step_time_s=step,
             mcells_per_s=problem.cells * ndev / step / 1e6,
             warmup_s=warmup)
+    if cand.route in ("adi", "adi_s"):
+        # Per-ADI-step marginal: an ADI step costs ~an order of
+        # magnitude more than an explicit sweep, so the span scales
+        # down; the datum is comparable only within the adi: frontier
+        # (its own db key namespace).
+        import jax.numpy as jnp
+
+        from heat2d_tpu.ops import tridiag as tdk
+
+        variant = "strided" if cand.route == "adi_s" else "xpose"
+        c1 = jnp.full((1,), 8.0, jnp.float32)
+        fn = jax.jit(
+            lambda v, n: tdk.batched_adi_kernel(
+                v[None], c1, c1, steps=n, panel=cand.bm,
+                variant=variant)[0],
+            static_argnums=1)
+        lo_a, hi_a = max(lo // 50, 2), max(hi // 50, 20)
+        first = timed_call(fn, u, lo_a)
+        warmup = first.warmup_s
+        if compile_timeout_s is not None and warmup is not None \
+                and warmup > compile_timeout_s:
+            return MeasureOutcome(
+                cand, "timeout", warmup_s=warmup,
+                error=f"compile+warmup {warmup:.1f}s over the "
+                      f"{compile_timeout_s:.0f}s wall")
+        step = min_of_two_point(fn, u, lo_a, hi_a, reps=reps)
+        return MeasureOutcome(
+            cand, "ok", step_time_s=step,
+            mcells_per_s=(problem.nx - 2) * (problem.ny - 2)
+            / step / 1e6,
+            warmup_s=warmup)
     if cand.route == "vmem":
         fn = jax.jit(lambda v, n: ps.multi_step_vmem(v, n, 0.1, 0.1),
                      static_argnums=1)
@@ -463,6 +494,32 @@ class SimulatedBackend:
             seam = 6 * t * (nx + ny) / problem.cells
             return (max(compute, ici_s) + compute * seam
                     + self.LAUNCH_S_PER_PROGRAM / t)
+        if cand.route in ("adi", "adi_s"):
+            # Per-ADI-STEP model (a different algorithm — two
+            # tridiagonal sweeps + two half-RHS stencils; comparable
+            # only within the adi: frontier): ~10 grid passes of HBM
+            # stream, a launch term shrinking with the panel width,
+            # the explicit-transpose variant paying 4 extra transpose
+            # passes and the strided variant a lane-serialization
+            # compute tax on its second sweep.
+            bn = cand.bm
+            if bn <= 0 or ny % bn:
+                raise SimulatedCompileError(
+                    f"adi panel {bn} does not tile the {ny}-lane axis")
+            est = 3 * nx * bn * itemsize
+            if est > self.HARD_LIMIT_BYTES:
+                raise SimulatedOOM(
+                    f"tridiag panel {est / 2**20:.1f} MB over the "
+                    f"{self.HARD_LIMIT_BYTES / 2**20:.0f} MB core")
+            adi_compute = 8 * problem.cells / self.VPU_CELLS_PER_S
+            stream = 10 * grid_bytes / self.HBM_BYTES_PER_S
+            launches = -(-ny // bn) + -(-nx // bn)
+            if cand.route == "adi":
+                stream += 4 * grid_bytes / self.HBM_BYTES_PER_S
+            else:
+                adi_compute += 64 * problem.cells / self.VPU_CELLS_PER_S
+            return (adi_compute + stream
+                    + launches * self.LAUNCH_S_PER_PROGRAM)
         if cand.route == "vmem":
             if 3 * grid_bytes > self.HARD_LIMIT_BYTES // 2:
                 raise SimulatedOOM(

@@ -41,11 +41,26 @@ from heat2d_tpu.ops import pallas_stencil as ps
 DEFAULT_T_LADDER = (4, 8, 12, 16)
 DEFAULT_BM_GRID = (32, 48, 64, 96, 128, 160, 192, 224, 256, 320)
 
-ROUTES = ("vmem", "C", "C2", "fused")
+#: "adi"/"adi_s" are the implicit-route tridiagonal kernel's search
+#: dimensions (ops/tridiag.py kernel TD): the knob is the lane-panel
+#: width (rides in ``bm``), and the route name carries the transpose
+#: strategy for the second (y) sweep — "adi" runs an explicit
+#: transpose + the same row kernel, "adi_s" the strided lane-
+#: elimination pass. Measured step times are PER ADI STEP (a
+#: different algorithm — two tridiagonal sweeps + two half-RHS
+#: stencils), so the points live under their own ``adi:`` db keys
+#: (``Problem.adi_key``) exactly like the fused route's: an implicit
+#: per-step rate must never shadow the explicit frontier's best.
+ROUTES = ("vmem", "C", "C2", "fused", "adi", "adi_s")
 
 #: Overlap-depth ladder for the fused halo route (candidate T values;
 #: the distributed default DEFAULT_HALO_DEPTH=8 rides in the middle).
 DEFAULT_FUSED_T_LADDER = (2, 4, 8, 16)
+
+#: Lane-panel ladder for the ADI tridiagonal kernel (panels must tile
+#: the member's lane axis exactly — candidates are pruned to
+#: divisors; the planner's own pick is seeded in).
+DEFAULT_ADI_PANELS = (128, 256, 512, 1024)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -59,6 +74,15 @@ class Problem:
         """The db problem key — shape and dtype; the route rides in the
         candidate/entry, not the key (one frontier per shape)."""
         return f"{self.nx}x{self.ny}:{self.dtype}"
+
+    def adi_key(self) -> str:
+        """The db key for this shape's ADI (implicit-route) frontier.
+        ADI points measure a DIFFERENT algorithm's per-step cost (two
+        tridiagonal sweeps + two half-RHS stencils), so they live in
+        their own namespace like the fused route's — the prefix
+        breaks the "NXxNY:dtype" parse, keeping these entries
+        invisible to the band lookup ladder."""
+        return f"adi:{self.nx}x{self.ny}:{self.dtype}"
 
     def fused_key(self) -> str:
         """The db key for this shape's FUSED-route frontier. Fused
@@ -179,6 +203,31 @@ def candidate_space(problem: Problem, routes=None, bm_grid=None,
                                   f"{limit / 2**20:.0f} MB VMEM limit"))
             else:
                 cands.append(c)
+
+    adi_routes = [r for r in ("adi", "adi_s") if r in routes]
+    if adi_routes:
+        # Implicit-route dimension: lane-panel width x transpose
+        # strategy (the route name). Knobs ride in bm; tsteps is 0
+        # (no temporal blocking — the time loop sits outside the
+        # tridiagonal sweeps).
+        from heat2d_tpu.ops.tridiag import plan_adi_panel
+        panels = set(DEFAULT_ADI_PANELS)
+        panels.add(plan_adi_panel(ny))
+        for route in adi_routes:
+            for bn in sorted(panels):
+                c = Candidate(route, bn, 0)
+                if bn > ny or ny % bn:
+                    pruned.append((c, "panel does not tile the "
+                                      "member's lane axis"))
+                    continue
+                est = 3 * nx * bn * itemsize
+                if est > limit and not probe_past_envelope:
+                    pruned.append((c, f"tridiag panel working set "
+                                      f"{est / 2**20:.1f} MB over the "
+                                      f"{limit / 2**20:.0f} MB VMEM "
+                                      f"limit"))
+                else:
+                    cands.append(c)
 
     # Seed the bm axis with the heuristic planners' own picks so the
     # search result can only match or beat the static policy.

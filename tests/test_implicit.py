@@ -1,0 +1,567 @@
+"""Implicit time-stepping (ISSUE 14): batched tridiagonal solves,
+Crank-Nicolson ADI, multigrid, and the wall-clock-to-solution
+contract — plus the free-when-off pins proving the explicit hot path
+is byte-identical with the new routes registered."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from heat2d_tpu.config import ConfigError, HeatConfig
+from heat2d_tpu.ops import analytic, multigrid as mg, stability
+from heat2d_tpu.ops import tridiag as td
+
+from tests._pin import (assert_jaxpr_differs, assert_jaxpr_equal,
+                        band_runner_jaxpr, batch_runner_jaxpr,
+                        solver_jaxpr)
+
+
+def dense_tridiag(dl, d, du):
+    n = len(d)
+    T = np.diag(np.asarray(d, np.float64))
+    T += np.diag(np.asarray(dl, np.float64)[1:], -1)
+    T += np.diag(np.asarray(du, np.float64)[:-1], 1)
+    return T
+
+
+def random_bands(rng, n):
+    dl = np.zeros(n)
+    du = np.zeros(n)
+    d = np.ones(n)
+    dl[1:-1] = rng.normal(size=n - 2) * 0.3
+    du[1:-1] = rng.normal(size=n - 2) * 0.3
+    d[1:-1] = 3.0 + rng.normal(size=n - 2) * 0.2
+    return dl, d, du
+
+
+# --------------------------------------------------------------------- #
+# thomas_solve: the jnp golden model + implicit differentiation
+# --------------------------------------------------------------------- #
+
+def test_thomas_matches_dense_solve(rng):
+    n = 23
+    dl, d, du = random_bands(rng, n)
+    rhs = rng.normal(size=(n, 7))
+    want = np.linalg.solve(dense_tridiag(dl, d, du), rhs)
+    got = td.thomas_solve(jnp.asarray(dl), jnp.asarray(d),
+                          jnp.asarray(du), jnp.asarray(rhs))
+    np.testing.assert_allclose(np.asarray(got), want, atol=1e-12)
+
+
+def test_thomas_vjp_is_transpose_solve(rng):
+    """The custom_vjp's band/rhs cotangents against central finite
+    differences — the implicit-differentiation contract the adjoint
+    rides (the backward pass solves T^T, not an unrolled scan)."""
+    n = 11
+    dl, d, du = random_bands(rng, n)
+    rhs = rng.normal(size=(n, 3))
+
+    def loss(dl_, d_, du_, r_):
+        return jnp.sum(jnp.sin(td.thomas_solve(dl_, d_, du_, r_)))
+
+    args = (jnp.asarray(dl), jnp.asarray(d), jnp.asarray(du),
+            jnp.asarray(rhs))
+    grads = jax.grad(loss, argnums=(0, 1, 2, 3))(*args)
+    eps = 1e-6
+    for argi in range(4):
+        flat = np.asarray(args[argi], np.float64).copy()
+        idx = (2,) if flat.ndim == 1 else (2, 1)
+        for sign in (1,):
+            pert = [np.asarray(a, np.float64).copy() for a in args]
+            pert[argi][idx] += eps
+            lp = float(loss(*[jnp.asarray(a) for a in pert]))
+            pert[argi][idx] -= 2 * eps
+            lm = float(loss(*[jnp.asarray(a) for a in pert]))
+            fd = (lp - lm) / (2 * eps)
+        np.testing.assert_allclose(float(np.asarray(grads[argi])[idx]),
+                                   fd, rtol=1e-5, atol=1e-8)
+
+
+# --------------------------------------------------------------------- #
+# the ADI step: exactness, stability, kernels
+# --------------------------------------------------------------------- #
+
+def test_adi_step_exact_mode_factor():
+    """The separable mode is an exact eigenvector of the PR-ADI step:
+    one step must scale it by the analytic rational factor to f64
+    precision — the strongest single-step correctness check there is."""
+    nx, ny = 33, 41
+    v = jnp.asarray(analytic.separable_mode(nx, ny, np.float64))
+    for cx, cy in ((0.1, 0.2), (5.0, 7.0), (300.0, 100.0)):
+        got = np.asarray(td.adi_step(v, cx, cy))
+        fac = analytic.adi_mode_factor(nx, ny, cx, cy)
+        np.testing.assert_allclose(
+            got[1:-1, 1:-1] / np.asarray(v)[1:-1, 1:-1], fac,
+            rtol=1e-12)
+        assert abs(fac) < 1.0      # unconditional stability
+
+
+def test_adi_step_holds_edges_and_constants(rng):
+    u = rng.normal(size=(12, 15))
+    got = np.asarray(td.adi_step(jnp.asarray(u), 9.0, 4.0))
+    np.testing.assert_array_equal(got[0, :], u[0, :])
+    np.testing.assert_array_equal(got[-1, :], u[-1, :])
+    np.testing.assert_array_equal(got[:, 0], u[:, 0])
+    np.testing.assert_array_equal(got[:, -1], u[:, -1])
+    c = np.full((9, 9), 2.5)
+    out = np.asarray(td.adi_step(jnp.asarray(c), 50.0, 50.0))
+    np.testing.assert_allclose(out, c, rtol=1e-12)
+
+
+@pytest.mark.parametrize("variant", ["xpose", "strided"])
+def test_tridiag_kernel_matches_scan(rng, variant):
+    """Kernel TD (interpret mode on CPU) against the jnp scan route,
+    both transpose strategies, mixed panel widths."""
+    ub = rng.normal(size=(3, 16, 24)).astype(np.float32)
+    cxs = np.asarray([0.5, 2.0, 10.0], np.float32)
+    cys = np.asarray([1.0, 3.0, 0.3], np.float32)
+    want = np.asarray(td.batched_adi_scan(jnp.asarray(ub), cxs, cys,
+                                          steps=3))
+    for panel in (8, 24, None):
+        got = np.asarray(td.batched_adi_kernel(
+            jnp.asarray(ub), cxs, cys, steps=3, panel=panel,
+            variant=variant))
+        np.testing.assert_allclose(got, want, atol=5e-6)
+
+
+def test_plan_adi_panel_tiles_lanes():
+    assert td.plan_adi_panel(4096) == 512
+    assert 4096 % td.plan_adi_panel(4096) == 0
+    assert td.plan_adi_panel(100) <= 100
+    assert 100 % td.plan_adi_panel(100) == 0
+    assert td.plan_adi_panel(64) == 64
+
+
+# --------------------------------------------------------------------- #
+# method parity: both schemes converge to the analytic solution at
+# their expected orders (satellite: O(dt) vs O(dt^2), f32 and f64)
+# --------------------------------------------------------------------- #
+
+def _leg_error(method, nx, ny, steps, c, dtype):
+    u0 = jnp.asarray(analytic.separable_mode(nx, ny, dtype))
+    if method == "explicit":
+        from heat2d_tpu.models import engine
+        from heat2d_tpu.ops.stencil import stencil_step
+        u, _ = engine.run_fixed(
+            lambda v: stencil_step(v, c, c, accum_dtype=None), u0,
+            steps)
+    elif method == "adi":
+        u = td.adi_multi_step(u0, steps, c, c)
+    else:
+        u = mg.mg_multi_step(u0, steps, c, c)
+    ref = analytic.mode_solution(nx, ny, c * steps, c * steps,
+                                 np.float64)
+    return analytic.l2_error(u, ref)
+
+
+def test_convergence_orders_f64():
+    """Halving dt at fixed t_final: the explicit error halves (O(dt)),
+    the ADI error quarters (O(dt^2))."""
+    nx = ny = 65
+    that = 32.0           # t_hat = c * steps on both axes
+    e1 = _leg_error("explicit", nx, ny, 160, that / 160, np.float64)
+    e2 = _leg_error("explicit", nx, ny, 320, that / 320, np.float64)
+    assert 1.6 < e1 / e2 < 2.4, (e1, e2)
+    a1 = _leg_error("adi", nx, ny, 8, that / 8, np.float64)
+    a2 = _leg_error("adi", nx, ny, 16, that / 16, np.float64)
+    assert 3.2 < a1 / a2 < 4.8, (a1, a2)
+    # ...and the implicit leg beats the explicit one outright at a
+    # fraction of the steps.
+    assert a2 < e2
+
+
+def test_mg_matches_cn_order_f64():
+    nx = ny = 65
+    that = 32.0
+    m1 = _leg_error("mg", nx, ny, 8, that / 8, np.float64)
+    m2 = _leg_error("mg", nx, ny, 16, that / 16, np.float64)
+    assert 3.0 < m1 / m2 < 5.2, (m1, m2)
+
+
+def test_methods_converge_f32():
+    """f32 twin of the parity satellite: every scheme converges to the
+    analytic answer, and the implicit legs at 20x fewer steps stay at
+    matched accuracy (no worse than the explicit leg's O(dt)
+    truncation + its roundoff)."""
+    errs = {m: _leg_error(m, 65, 65, s, 32.0 / s, np.float32)
+            for m, s in (("explicit", 160), ("adi", 8), ("mg", 8))}
+    assert all(e < 2e-4 for e in errs.values()), errs
+    floor = 400 * np.finfo(np.float32).eps
+    for m in ("adi", "mg"):
+        assert errs[m] <= max(1.5 * errs["explicit"], floor), errs
+
+
+# --------------------------------------------------------------------- #
+# multigrid internals
+# --------------------------------------------------------------------- #
+
+def test_vcycle_contracts_residual():
+    nx = ny = 65
+    cx = cy = 8.0
+    u_true = jnp.asarray(analytic.separable_mode(nx, ny, np.float64))
+    rhs = mg.cn_apply(u_true, cx, cy)
+    u = jnp.zeros_like(u_true)
+    r_prev = float(jnp.linalg.norm(mg.residual(u, rhs, cx, cy)))
+    for _ in range(3):
+        u = mg.v_cycle(u, rhs, cx, cy)
+        r = float(jnp.linalg.norm(mg.residual(u, rhs, cx, cy)))
+        assert r < 0.1 * r_prev, (r, r_prev)   # >= 10x per cycle
+        r_prev = r
+
+
+def test_mg_step_matches_unsplit_cn_factor():
+    nx = ny = 33
+    cx, cy = 6.0, 9.0
+    v = jnp.asarray(analytic.separable_mode(nx, ny, np.float64))
+    lx, ly = analytic.mode_eigenvalues(nx, ny)
+    a = cx * lx / 2 + cy * ly / 2
+    want = (1 - a) / (1 + a)
+    got = np.asarray(mg.mg_step(v, cx, cy))
+    rat = got[1:-1, 1:-1] / np.asarray(v)[1:-1, 1:-1]
+    # two V-cycles land ~1e-4 relative of the exact CN factor — far
+    # below the CN truncation the step carries anyway
+    np.testing.assert_allclose(rat, want, rtol=5e-4)
+
+
+def test_mg_even_sizes_still_converge():
+    """A non-coarsenable (even) grid degrades to smoother-only
+    relaxation — slower, still correct for moderate dt."""
+    err = _leg_error("mg", 32, 48, 16, 0.5, np.float64)
+    assert err < 1e-4, err
+
+
+# --------------------------------------------------------------------- #
+# the routes: ensemble / solver / serve / mesh
+# --------------------------------------------------------------------- #
+
+def test_ensemble_adi_matches_per_member(rng):
+    from heat2d_tpu.models import ensemble
+
+    cxs = [4.0, 9.0, 1.5]
+    cys = [2.0, 3.0, 8.0]
+    out = ensemble.run_ensemble(17, 21, 5, cxs, cys, method="adi")
+    u0 = jnp.asarray(analytic.separable_mode(17, 21))
+    u0 = jnp.broadcast_to(
+        jnp.asarray(np.asarray(ensemble.inidat(17, 21))), (3, 17, 21))
+    for i, (cx, cy) in enumerate(zip(cxs, cys)):
+        want = td.adi_multi_step(u0[i], 5, jnp.float32(cx),
+                                 jnp.float32(cy))
+        np.testing.assert_array_equal(np.asarray(out[i]),
+                                      np.asarray(want))
+
+
+def test_ensemble_conv_adi_per_member_exit():
+    """The generic batched convergence loop drives the ADI runner:
+    a fast-decaying member freezes while a slow one runs on."""
+    from heat2d_tpu.models import ensemble
+
+    u, k = ensemble.run_ensemble_convergence(
+        17, 17, 50, 5, 1e-4, [8.0, 0.5], [8.0, 0.5], method="adi")
+    ks = [int(v) for v in np.asarray(k)]
+    assert ks[0] < ks[1], ks
+
+
+def test_solver_adi_and_mg_routes():
+    base = dict(nxprob=33, nyprob=33, steps=4, cx=16.0, cy=16.0)
+    from heat2d_tpu.models.solver import Heat2DSolver
+
+    for method in ("adi", "mg"):
+        r = Heat2DSolver(HeatConfig(method=method, **base)).run(
+            timed=False)
+        assert r.steps_done == 4
+        assert np.isfinite(r.u).all()
+    # convergence route: early exit on a violent decay
+    cfg = HeatConfig(nxprob=33, nyprob=33, steps=400, cx=40.0, cy=40.0,
+                     method="adi", convergence=True, interval=10,
+                     sensitivity=1e30)
+    r = Heat2DSolver(cfg).run(timed=False)
+    assert r.steps_done == 10
+
+
+def test_serve_engine_adi_bitwise_across_capacities():
+    from heat2d_tpu.serve.engine import EnsembleEngine
+    from heat2d_tpu.serve.schema import SolveRequest
+
+    req = SolveRequest(nx=16, ny=24, steps=3, cx=8.0, cy=6.0,
+                       method="adi")
+    twin = SolveRequest(nx=16, ny=24, steps=3, cx=3.0, cy=2.0,
+                        method="adi")
+    a = EnsembleEngine(max_batch=8).solve_batch([req])[0]
+    b = EnsembleEngine(max_batch=8).solve_batch([req, twin])[0]
+    assert np.asarray(a[0]).tobytes() == np.asarray(b[0]).tobytes()
+
+
+def test_serve_schema_accepts_implicit_methods():
+    from heat2d_tpu.serve.schema import Rejected, SolveRequest
+
+    for m in ("adi", "mg"):
+        SolveRequest(nx=8, ny=8, steps=2, method=m).validate()
+    with pytest.raises(Rejected):
+        SolveRequest(nx=8, ny=8, steps=2, method="nope").validate()
+
+
+def test_mesh_runner_adi_bitwise(rng):
+    """The PR 13 mesh machinery carries the new route unchanged:
+    mesh-sharded answers bitwise == single-chip at a padded batch."""
+    if len(jax.devices()) < 2:
+        pytest.skip("needs the multi-device sim mesh")
+    from heat2d_tpu.mesh.runner import mesh_batch_runner
+    from heat2d_tpu.models import ensemble
+
+    run = mesh_batch_runner(16, 24, 3, "adi")
+    b = run.n_devices
+    u0 = jnp.asarray(rng.normal(size=(b, 16, 24)).astype(np.float32))
+    cxs = jnp.asarray([2.0 + i for i in range(b)], jnp.float32)
+    got = np.asarray(run(u0, cxs, cxs))
+    want = np.asarray(ensemble.batch_runner(16, 24, 3, "adi")(
+        u0, cxs, cxs))
+    assert got.tobytes() == want.tobytes()
+
+
+# --------------------------------------------------------------------- #
+# jaxpr pins: implicit support costs nothing on the explicit hot path
+# --------------------------------------------------------------------- #
+
+def test_explicit_programs_untouched_by_implicit_routes():
+    before_solver = solver_jaxpr()
+    before_band = band_runner_jaxpr()
+    before_batch = batch_runner_jaxpr(method="jnp")
+    # Exercise the new routes end to end (trace + run), then re-trace.
+    from heat2d_tpu.models import ensemble
+
+    ensemble.run_ensemble(16, 16, 2, [8.0], [6.0], method="adi")
+    ensemble.run_ensemble(17, 17, 1, [8.0], [6.0], method="mg")
+    assert_jaxpr_equal(before_solver, solver_jaxpr(),
+                       "solver runner with implicit routes live")
+    assert_jaxpr_equal(before_band, band_runner_jaxpr(),
+                       "band runner with implicit routes live")
+    assert_jaxpr_equal(before_batch, batch_runner_jaxpr(method="jnp"),
+                       "jnp batch runner with implicit routes live")
+    # Non-vacuity: the adi program is genuinely a different program.
+    assert_jaxpr_differs(before_batch, batch_runner_jaxpr(method="adi"),
+                         "adi vs jnp batch runner")
+
+
+def test_diffing_adi_leaves_band_runner_pinned():
+    before = band_runner_jaxpr()
+    from heat2d_tpu.diff.adjoint import make_diff_solve
+
+    solve = make_diff_solve(9, 9, 3, method="adi")
+    jax.grad(lambda u, a, b: jnp.sum(solve(u, a, b)))(
+        jnp.ones((9, 9)), 4.0, 2.0)
+    assert_jaxpr_equal(before, band_runner_jaxpr(),
+                       "band runner after adi adjoint build")
+
+
+# --------------------------------------------------------------------- #
+# adjoint: FD parity + storage-route bitwise equality
+# --------------------------------------------------------------------- #
+
+def test_adi_adjoint_fd_parity(rng):
+    from heat2d_tpu.diff.adjoint import make_diff_solve
+
+    solve = make_diff_solve(9, 11, 4, method="adi")
+    u0 = jnp.asarray(rng.normal(size=(9, 11)))
+
+    def loss(u, a, b):
+        return jnp.sum(solve(u, a, b) ** 2)
+
+    g = jax.grad(loss, argnums=(0, 1, 2))(u0, 5.0, 3.0)
+    eps = 1e-6
+    fd_cx = (loss(u0, 5.0 + eps, 3.0) - loss(u0, 5.0 - eps, 3.0)) \
+        / (2 * eps)
+    np.testing.assert_allclose(float(g[1]), float(fd_cx), rtol=1e-5)
+    fd_u = (loss(u0.at[4, 5].add(eps), 5.0, 3.0)
+            - loss(u0.at[4, 5].add(-eps), 5.0, 3.0)) / (2 * eps)
+    np.testing.assert_allclose(float(g[0][4, 5]), float(fd_u),
+                               rtol=1e-4, atol=1e-9)
+
+
+def test_adi_adjoint_checkpoint_equals_full(rng):
+    from heat2d_tpu.diff.adjoint import make_diff_solve
+
+    u0 = jnp.asarray(rng.normal(size=(9, 9)))
+
+    def grads(adjoint, segment=None):
+        solve = make_diff_solve(9, 9, 6, method="adi",
+                                adjoint=adjoint, segment=segment)
+        return jax.grad(lambda u, a, b: jnp.sum(solve(u, a, b) ** 2),
+                        argnums=(0, 1, 2))(u0, 7.0, 2.0)
+
+    full = grads("full")
+    for seg in (None, 2, 3):
+        ck = grads("checkpoint", seg)
+        for a, b in zip(full, ck):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_adjoint_method_validation():
+    from heat2d_tpu.diff.adjoint import make_diff_solve
+
+    with pytest.raises(ValueError, match="coeff='const'"):
+        make_diff_solve(9, 9, 3, coeff="var", method="adi")
+    # full-storage + adi composes (per-step primal on both routes)
+    make_diff_solve(9, 9, 3, adjoint="full", method="adi")
+
+
+# --------------------------------------------------------------------- #
+# stability (satellite: the box factored into ops/stability.py)
+# --------------------------------------------------------------------- #
+
+def test_stability_limit_values():
+    assert stability.stability_limit() == pytest.approx(0.25)
+    assert stability.stability_limit(2.0, 2.0) == pytest.approx(1.0)
+    with pytest.raises(ConfigError):
+        stability.stability_limit(0.0, 1.0)
+
+
+def test_explicit_config_validates_against_box():
+    with pytest.raises(ConfigError, match=r"cx \+ cy <= 0.5"):
+        HeatConfig(cx=0.4, cy=0.2)
+    # Implicit methods skip the box by design.
+    HeatConfig(cx=40.0, cy=20.0, method="adi")
+    HeatConfig(cx=40.0, cy=20.0, method="mg")
+    with pytest.raises(ConfigError, match="single-device"):
+        HeatConfig(cx=4.0, cy=2.0, method="adi", mode="dist2d",
+                   nxprob=8, nyprob=8, gridx=2, gridy=2)
+
+
+def test_inverse_box_reexport_and_projection():
+    from heat2d_tpu.diff import inverse
+
+    assert inverse.KAPPA_MAX == stability.KAPPA_MAX
+    assert inverse.KAPPA_MIN == stability.KAPPA_MIN
+    out = np.asarray(stability.project_stable(
+        jnp.asarray([-1.0, 0.1, 9.0])))
+    assert out[0] == stability.KAPPA_MIN
+    assert out[1] == pytest.approx(0.1)
+    assert out[2] == stability.KAPPA_MAX
+    assert stability.is_implicit("adi") and stability.is_implicit("mg")
+    assert not stability.is_implicit("explicit")
+
+
+# --------------------------------------------------------------------- #
+# tune space: the adi routes under their own key namespace
+# --------------------------------------------------------------------- #
+
+def test_candidate_space_has_adi_routes():
+    from heat2d_tpu.tune.space import Problem, candidate_space
+
+    cands, pruned = candidate_space(Problem(4096, 4096),
+                                    assume_tpu=True)
+    adi = [c for c in cands if c.route.startswith("adi")]
+    assert {c.route for c in adi} == {"adi", "adi_s"}
+    assert all(4096 % c.bm == 0 for c in adi)
+    # Non-divisor panels are pruned with a reason, never measured.
+    cands2, pruned2 = candidate_space(Problem(4096, 4000),
+                                      assume_tpu=True)
+    dropped = [r for c, r in pruned2 if c.route.startswith("adi")]
+    assert any("tile" in r for r in dropped)
+
+
+def test_adi_key_namespace_is_invisible_to_band_lookup(tmp_path):
+    from heat2d_tpu.tune.db import TuningDB
+    from heat2d_tpu.tune.space import Problem
+
+    p = Problem(64, 128)
+    assert p.adi_key().startswith("adi:")
+    db = TuningDB(str(tmp_path / "db.json"))
+    db.record_point("cpu", p.adi_key(),
+                    {"route": "adi", "bm": 128, "tsteps": 0,
+                     "status": "ok", "step_time_s": 1e-3,
+                     "mcells_per_s": 10.0})
+    from heat2d_tpu.tune.db import current_salt
+    db.set_best("cpu", p.adi_key(),
+                {"route": "adi", "bm": 128, "tsteps": 0}, 10.0,
+                {"salt": current_salt()})
+    db.save()
+    # The band lookup ladder must not surface the adi entry even as a
+    # nearest-shape answer.
+    assert db.lookup("cpu", 64, 128, "float32") is None
+
+
+def test_simulated_backend_measures_adi_routes():
+    from heat2d_tpu.tune.measure import (SimulatedBackend,
+                                         measure_candidate)
+    from heat2d_tpu.tune.space import Candidate, Problem
+
+    b = SimulatedBackend()
+    p = Problem(4096, 4096)
+    ok = measure_candidate(p, Candidate("adi", 128, 0), backend=b)
+    assert ok.status == "ok"
+    assert ok.step_time_s == measure_candidate(
+        p, Candidate("adi", 128, 0), backend=b).step_time_s
+    # strided pays the lane-serialization tax in the model
+    s = measure_candidate(p, Candidate("adi_s", 128, 0), backend=b)
+    assert s.step_time_s > ok.step_time_s
+    bad = measure_candidate(p, Candidate("adi", 500, 0), backend=b)
+    assert bad.status == "compile_error"
+    # a panel past the working-set envelope is the oom class
+    oom = measure_candidate(p, Candidate("adi", 1024, 0), backend=b)
+    assert oom.status == "oom"
+
+
+def test_search_problem_stamps_adi_frontier(tmp_path):
+    from heat2d_tpu.tune.cli import search_problem
+    from heat2d_tpu.tune.db import TuningDB
+    from heat2d_tpu.tune.measure import SimulatedBackend
+    from heat2d_tpu.tune.space import Problem
+
+    db = TuningDB(str(tmp_path / "db.json"))
+    p = Problem(640, 512)
+    backend = SimulatedBackend()
+    s = search_problem(db, p, backend=backend)
+    assert s["measured"] > 0
+    e = db.entry(backend.device_kind, p.adi_key())
+    assert e is not None and e.get("best"), e
+    assert e["best"]["route"].startswith("adi")
+    # resume: a fresh search must re-measure nothing
+    db2 = TuningDB(str(tmp_path / "db.json"))
+    s2 = search_problem(db2, p, backend=backend)
+    assert s2["measured"] == 0 and s2["cached"] > 0
+
+
+# --------------------------------------------------------------------- #
+# wall-clock-to-solution harness (satellite: the bench block)
+# --------------------------------------------------------------------- #
+
+def test_time_to_solution_contract():
+    from heat2d_tpu.models import solution
+
+    out = solution.time_to_solution(
+        129, 129, steps_explicit=512, step_ratio=128,
+        methods=("explicit", "adi"))
+    s = out["summary"]
+    assert s["adi_steps_ratio"] >= 100.0
+    assert s["adi_modeled_speedup"] >= 10.0
+    assert s["adi_matched_accuracy"] is True
+    rows = {r["method"]: r for r in out["rows"]}
+    assert rows["adi"]["steps"] * s["adi_steps_ratio"] \
+        == rows["explicit"]["steps"]
+    # Both legs hit the same physical time: c * steps matches.
+    assert rows["adi"]["cx"] * rows["adi"]["steps"] == pytest.approx(
+        rows["explicit"]["cx"] * rows["explicit"]["steps"])
+
+
+def test_time_to_solution_explicit_leg_validates_stability():
+    from heat2d_tpu.models import solution
+
+    with pytest.raises(ConfigError, match="stability limit"):
+        solution.time_to_solution(33, 33, steps_explicit=8,
+                                  step_ratio=4, cx=0.4, cy=0.2)
+
+
+def test_time_to_solution_emits_metrics():
+    from heat2d_tpu.models import solution
+    from heat2d_tpu.obs.metrics import MetricsRegistry
+
+    reg = MetricsRegistry()
+    solution.time_to_solution(33, 33, steps_explicit=64, step_ratio=16,
+                              methods=("explicit", "adi", "mg"),
+                              registry=reg)
+    snap = reg.snapshot()
+    assert "adi_time_to_solution_s" in snap["gauges"]
+    assert "adi_wall_speedup" in snap["gauges"]
+    assert "mg_time_to_solution_s" in snap["gauges"]

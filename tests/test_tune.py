@@ -84,8 +84,12 @@ def test_candidate_space_prunes_over_envelope():
                                     assume_tpu=True)
     est_limit = ps.vmem_hard_limit_bytes()
     for c in cands:
-        if c.route != "vmem":
+        if c.route in ("C", "C2"):
+            # the band kernels' working-set expression; the adi
+            # routes carry their own panel estimate (3*nx*bn)
             assert 5 * (c.bm + 2 * c.tsteps) * 8192 * 4 <= est_limit
+        elif c.route.startswith("adi"):
+            assert 3 * 4096 * c.bm * 4 <= est_limit
     # probe_past_envelope keeps the rejects measurable.
     cands2, _ = candidate_space(Problem(4096, 8192), assume_tpu=True,
                                 probe_past_envelope=True)
@@ -500,14 +504,18 @@ def test_frontier_table_matches_entries(tmp_path):
     best = db.entry(backend.device_kind, "640x512:float32")["best"]
     tagged = [ln for ln in table.splitlines() if "<-- best" in ln]
     # One best per FRONTIER: the single-chip shape entry plus the
-    # fused-route namespace ("fused:640x512", its own frontier so
-    # global-mesh rates never contend with the single-chip best).
-    assert len(tagged) == 2
+    # fused-route and adi-route namespaces ("fused:640x512" /
+    # "adi:640x512" — their own frontiers so global-mesh rates and
+    # implicit per-step rates never contend with the single-chip
+    # best).
+    assert len(tagged) == 3
     plain = [ln for ln in tagged
              if ln.lstrip().startswith("640x512:")]
     assert len(plain) == 1 and best["route"] in plain[0]
     fused = [ln for ln in tagged if ln.lstrip().startswith("fused:")]
     assert len(fused) == 1 and "fused" in fused[0]
+    adi = [ln for ln in tagged if ln.lstrip().startswith("adi:")]
+    assert len(adi) == 1 and "adi" in adi[0]
 
 
 def test_selftest_cli_idempotent(tmp_path, capsys):

@@ -489,6 +489,70 @@ def main() -> int:
         print("SKIP mesh serve checks (1 device attached)",
               file=sys.stderr)
 
+    # Implicit routes (ISSUE 14, docs/ALGORITHMS.md) on real Mosaic:
+    # kernel TD (batched Thomas along lanes) in BOTH transpose
+    # variants vs the jnp scan route, the mg V-cycle step, and the
+    # real-hardware wall-clock-to-solution comparison recorded as a
+    # BENCH-style metric line — the first real-TPU validation point
+    # the mesh PR left open.
+    from heat2d_tpu.ops import tridiag as td
+
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(14)
+    ub = rng.normal(size=(2, 128, 256)).astype(np.float32)
+    cxs = np.asarray([8.0, 3.0], np.float32)
+    cys = np.asarray([6.0, 2.0], np.float32)
+    want = td.batched_adi_scan(jnp.asarray(ub), cxs, cys, steps=4)
+    assert td.adi_kernel_viable(128, 256), (
+        "kernel TD must be viable at 128x256 on a real chip")
+    for variant in ("xpose", "strided"):
+        got = td.batched_adi_kernel(jnp.asarray(ub), cxs, cys, steps=4,
+                                    variant=variant)
+        check(f"kernel TD ({variant}) vs jnp scan", got, want,
+              atol=1e-4)
+    # mg solver route vs the INDEPENDENT analytic oracle (the mg
+    # runner is mode-agnostic, so a serial-vs-pallas comparison would
+    # compare the program against itself — the oracle is the
+    # non-vacuous check on real hardware).
+    from heat2d_tpu.ops import analytic as an
+
+    mg_steps, mg_c = 8, 4.0
+    mcfg = HeatConfig(nxprob=65, nyprob=65, steps=mg_steps, cx=mg_c,
+                      cy=mg_c, method="mg", mode="pallas")
+    u_mg = Heat2DSolver(mcfg).run(
+        u0=an.separable_mode(65, 65), timed=False).u
+    ref = an.mode_solution(65, 65, mg_c * mg_steps, mg_c * mg_steps)
+    assert an.l2_error(u_mg, ref) < 1e-3, an.l2_error(u_mg, ref)
+    print("PASS mg CN step (solver route vs analytic mode)")
+
+    # Wall-clock-to-solution at the bench shape class: measured on
+    # REAL hardware (kernels engaged), printed as the BENCH-style
+    # metric line the driver-record tail collects.
+    from heat2d_tpu.models import solution
+
+    tts = solution.bench_tts(on_tpu=True)
+    s = tts["summary"]
+    assert s["adi_matched_accuracy"], tts
+    assert s["adi_steps_ratio"] >= 100.0, tts
+    import json
+
+    from heat2d_tpu.obs.record import attach_context
+    by = {r["method"]: r for r in tts["rows"]}
+    print("TTS_METRICS " + json.dumps(attach_context({
+        "metric": (f"wall-clock-to-solution {s['nx']}x{s['ny']} "
+                   f"that={s['that_x']:g} (explicit vs adi)"),
+        "value": round(s["adi_wall_speedup"], 2),
+        "unit": "x speedup",
+        "explicit_s": round(by["explicit"]["time_to_solution_s"], 4),
+        "adi_s": round(by["adi"]["time_to_solution_s"], 4),
+        "steps_ratio": s["adi_steps_ratio"],
+        "accuracy": {m: r["accuracy"] for m, r in by.items()},
+    }, "bench"), default=float))
+    print(f"PASS wall-clock-to-solution adi "
+          f"{s['adi_wall_speedup']:.1f}x at matched accuracy "
+          f"({s['adi_steps_ratio']:.0f}x fewer steps)")
+
     print("ALL TPU SMOKE PATHS PASS")
     return 0
 
