@@ -118,17 +118,21 @@ def band_runner_jaxpr(nx: int = 64, ny: int = 128, steps: int = 10,
 
 def mesh_runner_jaxpr(nx: int = 16, ny: int = 16, steps: int = 4,
                       method: str = "jnp", b: Optional[int] = None,
-                      n_devices: Optional[int] = None) -> str:
+                      n_devices: Optional[int] = None,
+                      abft: bool = False) -> str:
     """The mesh-sharded serve batch runner's program (heat2d_tpu/
-    mesh/runner.py) — pins that the scheduler/admission layers are
-    pure host-side math: the traced mesh program is identical with
-    and without them armed."""
+    mesh/runner.py) — pins that the scheduler/admission/fault layers
+    are pure host-side math: the traced mesh program is identical
+    with and without them armed (incl. an armed chaos device
+    campaign). ``abft=True`` traces the checksum-verify variant — a
+    DIFFERENT program by design (its non-vacuity twin), memoized under
+    its own cache key so the default stays byte-identical."""
     import jax.numpy as jnp
 
     from heat2d_tpu.mesh.runner import mesh_batch_runner
 
     run = mesh_batch_runner(nx, ny, steps, method,
-                            n_devices=n_devices)
+                            n_devices=n_devices, abft=abft)
     b = b if b is not None else run.n_devices
     u0 = jnp.zeros((b, nx, ny), jnp.float32)
     cxs = _cxys(b)

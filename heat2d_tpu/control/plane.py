@@ -46,8 +46,18 @@ class ControlPlane:
                  burn_threshold: float = 1.0, sustain: int = 3,
                  shed_watermark: float = 0.4,
                  retuner=None, capacity_fit: Optional[dict] = None,
-                 registry=None):
+                 registry=None, mesh_health=None):
+        """``mesh_health``: an optional ``mesh.HealthMonitor`` — the
+        device-quarantine book feeds capacity decisions: every
+        quarantine transition lands in the decision log, the
+        ``control_quarantined_devices`` gauge tracks the count, and
+        sizing advice discounts deployed units by the surviving
+        capacity fraction (7 of 8 chips alive = 7/8 of the modeled
+        capacity actually serving)."""
         self.fleet = fleet
+        self.mesh_health = mesh_health
+        self._last_quarantined: Optional[int] = None
+        self._last_quarantine_seq = 0
         self.policy = policy or slo.SLOPolicy(latency_p99_s=30.0)
         self.interval = interval
         self.shed_watermark = shed_watermark
@@ -140,6 +150,44 @@ class ControlPlane:
                                 len(sustained))
         rps = self._observed_rps()
 
+        capacity_fraction = 1.0
+        if self.mesh_health is not None:
+            # device quarantine -> capacity decisions: transitions are
+            # decision rows (deduped like shed/unshed — an hour of a
+            # quarantined chip is ONE row), the live count is a gauge,
+            # and the capacity fraction discounts the sizing advice.
+            # Per-tick reads use the cheap accessors; the full event
+            # book is copied only on a transition.
+            q = len(self.mesh_health.quarantined())
+            capacity_fraction = self.mesh_health.capacity_fraction()
+            if self.registry is not None:
+                self.registry.gauge("control_quarantined_devices",
+                                    float(q))
+            if self._last_quarantined is None:
+                # startup: a healthy mesh needs no "nothing is
+                # quarantined" decision row, but quarantines that
+                # PRE-DATE the plane (a restart mid-incident) are
+                # state the audit trail must carry — baseline at 0 so
+                # a nonzero first tick logs them like any transition
+                self._last_quarantined = 0
+            if q != self._last_quarantined:
+                snap = self.mesh_health.snapshot()
+                # only the events of THIS transition (seq past the
+                # last logged fence): a mesh losing chips one by one
+                # logs each conviction once, not a growing history
+                fresh = [e for e in snap["events"]
+                         if e["seq"] > self._last_quarantine_seq]
+                self._decide("device_quarantine",
+                             quarantined=snap["quarantined"],
+                             capacity_fraction=capacity_fraction,
+                             events=[{"device": e["device"],
+                                      "reason": e["reason"]}
+                                     for e in fresh])
+                if fresh:
+                    self._last_quarantine_seq = max(
+                        e["seq"] for e in fresh)
+                self._last_quarantined = q
+
         if sustained and not self._shed_active:
             # escalate BEFORE the breaker: shed the low-priority
             # tenants while priority-0 traffic and cache hits keep
@@ -169,13 +217,31 @@ class ControlPlane:
                 advice = capacity.advise(
                     self.capacity_fit, rps,
                     len(self.fleet.sup.alive_slots()))
+                if capacity_fraction < 1.0:
+                    # quarantined chips don't serve: the deployed
+                    # units' EFFECTIVE capacity shrinks by the
+                    # surviving fraction, so the add-units gap grows
+                    advice["capacity_fraction"] = capacity_fraction
+                    advice["effective_units"] = (
+                        advice["current_units"] * capacity_fraction)
+                    need = advice.get("needed_units")
+                    if need is not None:
+                        import math
+                        advice["add_units"] = max(
+                            0, math.ceil(
+                                need - advice["effective_units"]))
                 # advice rows dedupe on state transitions (like shed/
                 # unshed): an hour-long burn must not append thousands
-                # of identical rows to the decision log
-                if (not self._burning or advice.get("needed_units")
-                        != self._last_advice_units):
+                # of identical rows to the decision log. The key
+                # includes add_units so a mid-burn quarantine that
+                # shrinks effective capacity (same needed_units,
+                # bigger gap) emits the corrected advice.
+                advice_key = (advice.get("needed_units"),
+                              advice.get("add_units"))
+                if (not self._burning
+                        or advice_key != self._last_advice_units):
                     self._decide("capacity_advice", **advice)
-                    self._last_advice_units = advice.get("needed_units")
+                    self._last_advice_units = advice_key
                 if (self.registry is not None
                         and advice.get("needed_units")):
                     self.registry.gauge("control_capacity_needed_units",

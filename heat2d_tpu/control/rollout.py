@@ -49,6 +49,7 @@ from typing import Optional
 
 from heat2d_tpu.obs import slo
 from heat2d_tpu.resil import chaos
+from heat2d_tpu.resil.retry import wait_for
 from heat2d_tpu.serve.schema import Rejected, SolveRequest
 from heat2d_tpu.tune.db import TuningDB
 
@@ -139,16 +140,26 @@ class Rollout:
                     deadline_s: float) -> Optional[dict]:
         """Poll until ``slot`` is alive+ready (and, when ``want_path``
         is given, reporting that tune-db path). Returns the worker's
-        ready info, or None on timeout."""
-        deadline = time.monotonic() + deadline_s
-        while time.monotonic() < deadline:
-            if slot in self.fleet.sup.alive_slots():
-                info = self.fleet.sup.worker_info(slot)
-                if info is not None:
-                    path = (info.get("tune") or {}).get("path")
-                    if want_path is None or path == want_path:
-                        return info
-            time.sleep(0.05)
+        ready info, or None on timeout. Deadline semantics via
+        ``resil.retry.wait_for`` — the one injectable-clock dispatch-
+        guard convention (the supervisor's clock, when it has one)."""
+        found: list = []
+
+        def check() -> bool:
+            if slot not in self.fleet.sup.alive_slots():
+                return False
+            info = self.fleet.sup.worker_info(slot)
+            if info is None:
+                return False
+            path = (info.get("tune") or {}).get("path")
+            if want_path is None or path == want_path:
+                found.append(info)
+                return True
+            return False
+
+        if wait_for(check, deadline_s, clock=self.fleet.sup.clock,
+                    poll=0.05):
+            return found[-1]
         return None
 
     def _canary_still_candidate(self, slot: int) -> bool:

@@ -45,7 +45,7 @@ import time
 from typing import Callable, Dict, List, Optional
 
 from heat2d_tpu.analysis.locks import AuditedLock, guarded_by
-from heat2d_tpu.resil.retry import RetryPolicy
+from heat2d_tpu.resil.retry import RetryPolicy, wait_for
 
 log = logging.getLogger("heat2d_tpu.fleet")
 
@@ -100,7 +100,8 @@ class Supervisor:
                  on_response: Optional[Callable[[int, dict], None]] = None,
                  on_worker_lost: Optional[Callable[[int], None]] = None,
                  on_worker_ready: Optional[Callable[[int], None]] = None,
-                 on_tick: Optional[Callable[[], None]] = None):
+                 on_tick: Optional[Callable[[], None]] = None,
+                 clock: Optional[Callable[[], float]] = None):
         if workers < 1:
             raise ValueError(f"workers must be >= 1, got {workers}")
         self.n = workers
@@ -121,6 +122,10 @@ class Supervisor:
         self.on_worker_lost = on_worker_lost
         self.on_worker_ready = on_worker_ready
         self.on_tick = on_tick
+        #: the dispatch-guarding deadline clock (resil.retry.wait_for
+        #: convention): injectable so ready-wait scenarios are
+        #: deterministic on any host speed; None = wall monotonic
+        self.clock = clock
 
         self._lock = AuditedLock("fleet.supervisor")
         self._handles: List[Optional[WorkerHandle]] = [None] * workers
@@ -152,12 +157,13 @@ class Supervisor:
                                          daemon=True)
         self._monitor.start()
         if wait_ready:
-            deadline = time.monotonic() + self.ready_timeout
-            while time.monotonic() < deadline:
-                if all(h is not None and h.ready
-                       for h in self._handles):
-                    break
-                time.sleep(0.01)
+            # the ONE hand-rolled-timer-free deadline convention
+            # (resil.retry.wait_for on Watchdog(clock=)): a frozen
+            # injected clock waits forever, an advanced one times out
+            # deterministically — no wall-clock flakes on slow hosts
+            wait_for(lambda: all(h is not None and h.ready
+                                 for h in self._handles),
+                     self.ready_timeout, clock=self.clock)
         return self
 
     def stop(self, timeout: float = 30.0) -> bool:

@@ -33,15 +33,33 @@ is that engine, mesh-aware along BOTH axes:
                   ``parallel/scaling.py``'s ``kind="multichip"``
                   records, with mesh-vs-single-chip bitwise parity as
                   the correctness anchor (the CI ``mesh-serve-gate``).
+- ``health``    — the device-level failure domain's detection half:
+                  per-device probes, the quarantine book, and the
+                  hung-collective watchdog
+                  (``resil.retry.Watchdog(clock=)``) that bounds a
+                  stalled mesh launch.
+- ``degrade``   — quarantine-driven recovery: shrink-and-requeue over
+                  the surviving devices, the ABFT checksum verify
+                  tier's policy (``ops/abft.py`` holds the algebra),
+                  measured recovery rows, and the
+                  no-quarantined-serving invariant (the CI
+                  ``mesh-chaos-gate``).
+- ``chaos_gate``— the three measured device-fault scenarios (device
+                  loss, silent bit flip, hung collective), each
+                  recovering to a bitwise-correct answer on the
+                  8-device sim mesh.
 
 Everything is opt-in: a ``SolveServer`` built without a mesh engine is
 byte-identical to the PR-2 stack (the jaxpr pins hold with this
 package imported, scheduled, and admitted).
 """
 
+from heat2d_tpu.mesh.degrade import FaultPolicy, MeshDegrader
 from heat2d_tpu.mesh.engine import MeshEnsembleEngine
+from heat2d_tpu.mesh.health import HealthMonitor, MeshStallError
 from heat2d_tpu.mesh.runner import mesh_batch_runner, mesh_capacity
 from heat2d_tpu.mesh.scheduler import MeshAdmission, MeshScheduler
 
-__all__ = ["MeshAdmission", "MeshEnsembleEngine", "MeshScheduler",
-           "mesh_batch_runner", "mesh_capacity"]
+__all__ = ["FaultPolicy", "HealthMonitor", "MeshAdmission",
+           "MeshDegrader", "MeshEnsembleEngine", "MeshScheduler",
+           "MeshStallError", "mesh_batch_runner", "mesh_capacity"]

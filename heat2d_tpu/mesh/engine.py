@@ -23,14 +23,28 @@ independent of batch composition and of where the members sit (the
 correctness anchor the CI ``mesh-serve-gate`` asserts; the spatial
 route's fused-vs-collective bitwise equality is PR 7's proven
 contract).
+
+**Fault tolerance** (opt-in via ``fault=FaultPolicy(...)`` —
+docs/RESILIENCE.md failure model, CI ``mesh-chaos-gate``): batch
+launches run under the hung-collective watchdog (``mesh/health.py``),
+device losses / stalls / ABFT checksum mismatches quarantine the
+culprit and SHRINK-AND-REQUEUE the same batch over the surviving
+devices (capacities re-pad to the new device multiple, in-flight
+members ride their existing single-flight futures), spatial-route
+signatures degrade onto the survivor batch mesh byte-identically, and
+no result from a failed attempt — late, lost, or corrupt — is ever
+served (``mesh/degrade.serving_invariant``). Without a policy the
+engine is byte-identical to PR 13's.
 """
 
 from __future__ import annotations
 
 from typing import List, Optional, Tuple
 
+from heat2d_tpu.mesh.health import MeshStallError
 from heat2d_tpu.resil import chaos
 from heat2d_tpu.serve.engine import EnsembleEngine
+from heat2d_tpu.serve.schema import Rejected
 
 
 class MeshEnsembleEngine(EnsembleEngine):
@@ -49,11 +63,29 @@ class MeshEnsembleEngine(EnsembleEngine):
 
     def __init__(self, registry=None, max_batch: Optional[int] = None,
                  n_devices: Optional[int] = None, halo: str = "fused",
-                 scheduler=None, max_batch_per_chip: int = 8):
+                 scheduler=None, max_batch_per_chip: int = 8,
+                 fault=None, fault_clock=None):
+        """``fault``: a ``degrade.FaultPolicy`` arming quarantine /
+        stall-watchdog / ABFT (None = PR 13 behavior, byte-identical).
+        ``fault_clock``: the stall deadline's clock (injectable —
+        ``resil.retry.Watchdog`` convention; None = wall)."""
         from heat2d_tpu.mesh.runner import attached_devices
         from heat2d_tpu.mesh.scheduler import MeshScheduler
 
         nd = len(attached_devices(n_devices))
+        self.health = None
+        self.degrader = None
+        if fault is not None:
+            import time
+
+            from heat2d_tpu.mesh.degrade import MeshDegrader
+            from heat2d_tpu.mesh.health import HealthMonitor
+            self.health = HealthMonitor(
+                n_devices=nd, registry=registry,
+                clock=fault_clock or time.monotonic)
+            self.degrader = MeshDegrader(fault, self.health,
+                                         registry=registry,
+                                         clock=fault_clock)
         if max_batch is None:
             max_batch = max(1, max_batch_per_chip) * nd
         max_batch = -(-max_batch // nd) * nd
@@ -74,6 +106,14 @@ class MeshEnsembleEngine(EnsembleEngine):
         #: signature -> memoized spatial runner (built on first
         #: spatial launch; the build IS the mesh compile)
         self._spatial_runners: dict = {}
+        #: (signature, capacity, devices, abft) keys that have
+        #: launched successfully — the stall watchdog guards only
+        #: these WARM launches: a cold launch is dominated by its XLA
+        #: compile (host-side work a hung collective cannot stall, but
+        #: a deadline tuned for warm execution would spuriously trip),
+        #: and stays bounded by the server's launch_deadline watchdog
+        #: one layer up.
+        self._mesh_warm: set = set()
 
     # -- dispatch ------------------------------------------------------ #
 
@@ -81,6 +121,19 @@ class MeshEnsembleEngine(EnsembleEngine):
         req0 = requests[0]
         decision = self.scheduler.decide(req0)
         route = decision["route"]
+        if (self.health is not None and route == "spatial"
+                and self.health.quarantined()):
+            # Spatial degrade: the spatial program spans the WHOLE
+            # attached mesh, quarantined chips included — re-route the
+            # signature onto the survivor batch mesh (bitwise-identical
+            # results: the mesh-vs-single parity contract), counted
+            # like every other fallback (docs/SCALING.md reasons).
+            if self.registry is not None:
+                self.registry.counter("mesh_fallback_total",
+                                      reason="quarantined")
+            decision = dict(decision, route="batch",
+                            reason="quarantined")
+            route = "batch"
         if route == "batch":
             return self._solve_batch_mesh(requests, decision)
         if route == "spatial":
@@ -91,8 +144,36 @@ class MeshEnsembleEngine(EnsembleEngine):
             self.registry.counter("mesh_fallback_total",
                                   reason=decision.get("reason",
                                                       "unknown"))
-        out = super().solve_batch(requests)
+        return self._solve_single(requests, decision)
+
+    def _solve_single(self, requests,
+                      decision) -> List[Tuple["object", int]]:
+        """The inherited single-chip launch — quarantine-aware when a
+        fault policy is armed: the default device (where an unpinned
+        jit computes) may be exactly the convicted chip, so the launch
+        is PINNED to the first surviving device and the row stamps
+        devices + the health fence like every guarded batch launch —
+        ``serving_invariant`` covers this route too, instead of
+        skipping it for want of a device set."""
+        if self.health is None:
+            out = super().solve_batch(requests)
+            self._tag_launch(decision)
+            return out
+        seq = self.health.seq()
+        survivors = self.health.survivors()
+        if not survivors:
+            raise Rejected(
+                "mesh_degraded",
+                "every device in the mesh is quarantined",
+                quarantined=list(self.health.quarantined()))
+        import jax
+
+        with jax.default_device(jax.devices()[survivors[0]]):
+            out = super().solve_batch(requests)
         self._tag_launch(decision)
+        mesh_row = self.launch_log[-1]["mesh"]
+        mesh_row["devices"] = [survivors[0]]
+        mesh_row["health_seq"] = seq
         return out
 
     def _tag_launch(self, decision, capacity=None) -> None:
@@ -111,6 +192,24 @@ class MeshEnsembleEngine(EnsembleEngine):
     def _solve_batch_mesh(self, requests,
                           decision) -> List[Tuple["object", int]]:
         chaos.launch_point()
+        req0 = requests[0]
+        tuned = self._preresolve_tuned(req0)
+        n = len(requests)
+        if self.degrader is None:
+            u, steps_done, capacity, _ab = self._launch_batch(
+                requests, None, False)
+            self._account(req0, n, capacity, tuned, decision)
+            return [(u[i], steps_done[i]) for i in range(n)]
+        return self._solve_batch_guarded(requests, decision, tuned)
+
+    def _launch_batch(self, requests, device_indices,
+                      abft: bool):
+        """ONE mesh-sharded launch attempt over ``device_indices``
+        (None = the full attached mesh) — pure launch, no accounting.
+        Returns ``(u, steps_done, capacity, abft_block)`` with the
+        batch PADDED to capacity (the verify tier checks pads too —
+        they ran on the same devices)."""
+        chaos.mesh_launch_point()
         import contextlib
 
         import numpy as np
@@ -120,9 +219,10 @@ class MeshEnsembleEngine(EnsembleEngine):
         from heat2d_tpu.models import ensemble
 
         req0 = requests[0]
-        tuned = self._preresolve_tuned(req0)
         n = len(requests)
-        capacity = mesh_capacity(n, self.max_batch, self.n_devices)
+        nd = (self.n_devices if device_indices is None
+              else len(device_indices))
+        capacity = mesh_capacity(n, self.max_batch, nd)
         cxs = [r.cx for r in requests]
         cys = [r.cy for r in requests]
         # Pad members replicate the LAST real member (the single-chip
@@ -136,21 +236,186 @@ class MeshEnsembleEngine(EnsembleEngine):
         runner = mesh_batch_runner(
             req0.nx, req0.ny, req0.steps, req0.method,
             convergence=req0.convergence, interval=interval,
-            sensitivity=sensitivity, n_devices=self.n_devices)
+            sensitivity=sensitivity,
+            n_devices=(None if device_indices is not None
+                       else self.n_devices),
+            device_indices=device_indices, abft=abft)
         timer = (self.registry.timer("serve_launch_s")
                  if self.registry is not None
                  else contextlib.nullcontext())
+        ab = None
         with timer:
             out = runner(u0, cxs, cys)
-            if req0.convergence:
+            if abft:
+                u, k, s_obs, s_pred, scale = out
+                u = np.asarray(u)
+                steps_done = [int(x) for x in np.asarray(k)]
+                ab = {"s_obs": np.asarray(s_obs),
+                      "s_pred": np.asarray(s_pred),
+                      "scale": np.asarray(scale)}
+            elif req0.convergence:
                 u, steps_done = out
                 u = np.asarray(u)
                 steps_done = [int(k) for k in np.asarray(steps_done)]
             else:
                 u = np.asarray(out)
                 steps_done = [req0.steps] * capacity
-        self._account(req0, n, capacity, tuned, decision)
+        return u, steps_done, capacity, ab
+
+    # -- the guarded (fault-tolerant) batch route ---------------------- #
+
+    def _solve_batch_guarded(self, requests, decision,
+                             tuned) -> List[Tuple["object", int]]:
+        """Shrink-and-requeue launch loop (module docstring): each
+        attempt runs on the CURRENT survivors under the stall
+        watchdog; device losses / stalls / checksum mismatches
+        quarantine the culprit and relaunch the same batch over the
+        shrunken mesh, re-padded to its device multiple. The members'
+        single-flight futures upstream never see the churn — requeue
+        is invisible except in the measured recovery row."""
+        import numpy as np
+
+        from heat2d_tpu.mesh.degrade import CorruptionError
+        from heat2d_tpu.mesh.health import is_device_loss
+        from heat2d_tpu.models import ensemble
+        from heat2d_tpu.ops import abft as abft_lib
+
+        policy = self.degrader.policy
+        req0 = requests[0]
+        n = len(requests)
+        method = ensemble._pick_method(req0.method, req0.nx, req0.ny)
+        abft_armed = (policy.abft
+                      and abft_lib.supported_family(method) is not None)
+        if (policy.abft and not abft_armed
+                and self.registry is not None):
+            # opt-in tier, honestly reported: this method has no exact
+            # linear recurrence — served unverified, counted
+            self.registry.counter("mesh_abft_unsupported_total",
+                                  reason=method)
+        requeues = 0
+        first_cause: Optional[str] = None
+        casualties: List[int] = []
+        t_detect: Optional[float] = None
+        from heat2d_tpu.mesh.runner import mesh_capacity
+
+        while True:
+            seq = self.health.seq()
+            devices = self.health.survivors()
+            if not devices:
+                raise Rejected(
+                    "mesh_degraded",
+                    "every device in the mesh is quarantined",
+                    quarantined=list(self.health.quarantined()))
+            warm_key = (req0.signature(),
+                        mesh_capacity(n, self.max_batch, len(devices)),
+                        devices, abft_armed)
+            launch = (lambda d=devices: self._launch_batch(
+                requests, d, abft_armed))
+            try:
+                if warm_key in self._mesh_warm:
+                    u, steps_done, capacity, ab = \
+                        self.degrader.guarded(launch)
+                else:
+                    # cold: the compile dominates — run it unguarded
+                    # (see _mesh_warm) so a deadline tuned for warm
+                    # execution cannot spuriously convict a fresh mesh
+                    u, steps_done, capacity, ab = launch()
+                self._mesh_warm.add(warm_key)
+                bit = chaos.flip_bit_point()
+                if bit is not None:
+                    # injected readback corruption: one exponent bit
+                    # of member 0's center cell, host-side only (the
+                    # traced program is untouched — jaxpr-pinned)
+                    u = u.copy()
+                    u.view(np.uint32)[0, req0.nx // 2,
+                                      req0.ny // 2] ^= np.uint32(
+                                          1 << bit)
+                if abft_armed:
+                    self._abft_verify(req0, u, steps_done, ab,
+                                      devices, capacity, policy)
+                break
+            except BaseException as e:  # noqa: BLE001 — classified
+                if isinstance(e, MeshStallError):
+                    cause, newly = "mesh_stall", self.degrader.on_stall()
+                elif isinstance(e, CorruptionError):
+                    cause = "silent_corruption"
+                    newly = self.degrader.on_corruption(e)
+                elif is_device_loss(e):
+                    cause = "device_fail"
+                    newly = self.degrader.on_device_lost(e)
+                    if not newly:
+                        # a runtime error that names no device AND
+                        # whose probe sweep convicts nobody is not a
+                        # device fault (e.g. a deterministic OOM /
+                        # invalid-argument failure): requeueing would
+                        # relaunch the same failing program
+                        # max_requeues more times per request —
+                        # propagate and let the server's transient
+                        # classification decide instead
+                        raise
+                else:
+                    raise       # not a device-domain failure
+                if t_detect is None:
+                    t_detect = self.degrader.now()
+                first_cause = first_cause or cause
+                casualties.extend(d for d in newly
+                                  if d not in casualties)
+                if (requeues >= policy.max_requeues
+                        or not self.health.survivors()):
+                    if cause == "mesh_stall":
+                        raise Rejected(
+                            "mesh_stall",
+                            f"mesh launch stalled past the "
+                            f"{policy.stall_deadline_s}s deadline "
+                            f"({requeues} requeues spent)",
+                            quarantined=list(
+                                self.health.quarantined())) from e
+                    raise
+                requeues += 1
+                self.degrader.record_requeue(cause)
+        recovery = None
+        if first_cause is not None:
+            recovery = self.degrader.record_recovery(
+                first_cause, casualties, t_detect, devices, requeues)
+        self._account(req0, n, capacity, tuned, decision,
+                      devices=devices, health_seq=seq,
+                      recovery=recovery)
         return [(u[i], steps_done[i]) for i in range(n)]
+
+    def _abft_verify(self, req0, u, steps_done, ab, devices,
+                     capacity, policy) -> None:
+        """The verify tier's host half: re-derive the checksum from
+        the buffer that is ABOUT TO BE SERVED (catching readback /
+        host corruption) and cross-check the on-device observation —
+        both against the on-device closed-form prediction. A mismatch
+        convicts the owning devices and raises ``CorruptionError``
+        (the launch loop quarantines and recomputes from the
+        digest-verified inputs)."""
+        import numpy as np
+
+        from heat2d_tpu.mesh.degrade import CorruptionError, member_owner
+        from heat2d_tpu.ops import abft
+
+        s_pred = ab["s_pred"]
+        scale = ab["scale"]
+        k = np.asarray(steps_done, np.float64)
+        f = policy.abft_tol_factor
+        bad = (abft.classify(abft.host_checksum(u), s_pred, scale, k,
+                             factor=f)
+               | abft.classify(ab["s_obs"], s_pred, scale, k,
+                               factor=f))
+        if self.registry is not None:
+            self.registry.counter("mesh_abft_checked_total",
+                                  value=float(capacity))
+        members = [int(m) for m in np.nonzero(bad)[0]]
+        if not members:
+            return
+        owners = sorted({member_owner(m, capacity, devices)
+                         for m in members})
+        if self.registry is not None:
+            self.registry.counter("mesh_abft_mismatch_total",
+                                  value=float(len(members)))
+        raise CorruptionError(members, owners)
 
     # -- spatial route ------------------------------------------------- #
 
@@ -204,23 +469,98 @@ class MeshEnsembleEngine(EnsembleEngine):
         cys += [cys[-1]] * (capacity - n)
         cxs, cys, u0 = ensemble._validated_batch(
             req0.nx, req0.ny, cxs, cys, None)
+
+        def launch():
+            chaos.mesh_launch_point()
+            u, k = runner(u0, cxs, cys)
+            return (np.asarray(u),
+                    [int(s) for s in np.asarray(k)])
+
         timer = (self.registry.timer("serve_launch_s")
                  if self.registry is not None
                  else contextlib.nullcontext())
-        with timer:
-            u, k = runner(u0, cxs, cys)
-            u = np.asarray(u)
-            steps_done = [int(s) for s in np.asarray(k)]
+        if self.degrader is None:
+            with timer:
+                u, steps_done = launch()
+            self._account(req0, n, capacity, tuned, decision)
+            return [(u[i], steps_done[i]) for i in range(n)]
+        return self._spatial_guarded(requests, decision, tuned,
+                                     capacity, launch, timer)
+
+    def _spatial_guarded(self, requests, decision, tuned, capacity,
+                         launch, timer) -> List[Tuple["object", int]]:
+        """The spatial route's fault tier: the launch runs under the
+        stall watchdog (warm launches only — same rationale as the
+        batch route) and a device-domain failure is CLASSIFIED, not
+        propagated raw: the conviction quarantines the culprit and
+        the SAME batch re-dispatches through ``solve_batch``, where
+        the quarantine check reroutes it onto the survivor batch mesh
+        (bitwise-identical results — the mesh-vs-single parity
+        contract). Without this, a chip dying mid-spatial-launch
+        fails forever: the server's retry relaunches the identical
+        full-mesh program that still includes the dead device."""
+        from heat2d_tpu.mesh.health import is_device_loss
+
+        req0 = requests[0]
+        n = len(requests)
+        warm_key = (req0.signature(), capacity, "spatial")
+        try:
+            if warm_key in self._mesh_warm:
+                with timer:
+                    u, steps_done = self.degrader.guarded(launch)
+            else:
+                # cold: the compile dominates — unguarded (_mesh_warm)
+                with timer:
+                    u, steps_done = launch()
+            self._mesh_warm.add(warm_key)
+        except BaseException as e:  # noqa: BLE001 — classified
+            t_detect = self.degrader.now()
+            if isinstance(e, MeshStallError):
+                cause, newly = "mesh_stall", self.degrader.on_stall()
+                if not newly:
+                    # nobody convicted: re-dispatch would rebuild the
+                    # same full-mesh program and hang again —
+                    # structural rejection, the server's plumbing
+                    # takes over
+                    raise Rejected(
+                        "mesh_stall",
+                        "spatial mesh launch stalled past the "
+                        f"{self.degrader.policy.stall_deadline_s}s "
+                        "deadline and the probe sweep convicted no "
+                        "device") from e
+            elif is_device_loss(e):
+                cause = "device_fail"
+                newly = self.degrader.on_device_lost(e)
+                if not newly:
+                    raise   # not a device fault (see the batch twin)
+            else:
+                raise       # not a device-domain failure
+            self.degrader.record_requeue(cause)
+            # quarantine is non-empty now, so dispatch reroutes this
+            # signature onto the survivor batch mesh
+            out = self.solve_batch(requests)
+            self.degrader.record_recovery(
+                cause, newly, t_detect,
+                tuple(self.health.survivors()), 1)
+            return out
         self._account(req0, n, capacity, tuned, decision)
         return [(u[i], steps_done[i]) for i in range(n)]
 
     # -- shared accounting --------------------------------------------- #
 
-    def _account(self, req0, n, capacity, tuned, decision) -> None:
+    def _account(self, req0, n, capacity, tuned, decision,
+                 devices=None, health_seq=None,
+                 recovery=None) -> None:
         """The inherited launch bookkeeping (launch_log / first_launch
-        / serve metrics), shared by both mesh routes."""
+        / serve metrics), shared by both mesh routes. Fault-tolerant
+        launches additionally stamp the device set they ACTUALLY ran
+        on, the health-event fence captured when that set was chosen
+        (``degrade.serving_invariant`` checks served-launch devices
+        against quarantines ordered before the fence), and the
+        measured recovery row when the launch survived a requeue."""
         self.launches += 1
-        compile_key = (req0.signature(), capacity, decision["route"])
+        compile_key = (req0.signature(), capacity, decision["route"],
+                       devices)
         first_launch = compile_key not in self._launched
         self._launched.add(compile_key)
         row = {"signature": req0.signature(), "occupancy": n,
@@ -232,3 +572,22 @@ class MeshEnsembleEngine(EnsembleEngine):
         if self.registry is not None:
             self.registry.counter("serve_launches_total")
         self._tag_launch(decision, capacity=capacity)
+        if devices is not None:
+            mesh_row = self.launch_log[-1]["mesh"]
+            mesh_row["devices"] = list(devices)
+            mesh_row["health_seq"] = health_seq
+            mesh_row["degraded"] = len(devices) < self.n_devices
+            if recovery is not None:
+                mesh_row["recovery"] = dict(recovery)
+
+    def fault_snapshot(self) -> Optional[dict]:
+        """Run-record ``mesh_fault`` block: policy, measured recovery
+        episodes, quarantine book, and the serving invariant verdict
+        over this engine's launch log (None without a fault policy)."""
+        if self.degrader is None:
+            return None
+        from heat2d_tpu.mesh.degrade import serving_invariant
+        snap = self.degrader.snapshot()
+        snap["invariant"] = serving_invariant(self.health,
+                                              self.launch_log)
+        return snap

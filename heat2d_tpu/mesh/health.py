@@ -1,0 +1,269 @@
+"""Per-device health — probes, quarantine state, and the
+hung-collective watchdog.
+
+The fleet subsystem (PR 5) answers PROCESS death and the checkpoint
+manager (PR 3) answers STATE loss; neither helps when one *device* in
+a live mesh goes bad: a hung ICI collective stalls every in-flight
+batch forever (no exception, no exit code — the gray failure), and a
+core that "doesn't count" (Hochschild et al., HotOS'21 — PAPERS.md)
+corrupts results silently. This module is the device-level failure
+domain's detection half (docs/RESILIENCE.md failure-model table):
+
+- ``HealthMonitor`` — the quarantine book: per-device status, reason
+  and ordering of every quarantine decision, the surviving-device
+  set the mesh engine re-forms its mesh over, and the capacity
+  fraction the control plane's sizing advice consumes.
+- ``probe_device`` / ``HealthMonitor.probe`` — a tiny place-compute-
+  readback round trip per device, verified against its known answer
+  (a wrong answer IS a failure — probes cover corrupt cores, not
+  just dead ones). The chaos hook ``device_probe_point`` lets a
+  campaign kill a specific device deterministically.
+- ``guarded_call`` — the hung-collective watchdog: runs a launch on a
+  helper thread under ``resil.retry.Watchdog`` (the ONE injectable-
+  clock deadline convention) and raises ``MeshStallError`` when the
+  deadline passes. The abandoned launch keeps running — Python
+  cannot preempt it — but its eventual result is DISCARDED and
+  counted (``mesh_discarded_results_total``): no result computed by
+  a launch that stalled is ever served, however late it arrives.
+
+Recovery (shrink-and-requeue, ABFT verification) lives in
+``mesh/degrade.py``; this module only detects and remembers.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Optional, Tuple
+
+from heat2d_tpu.analysis.locks import AuditedLock
+from heat2d_tpu.resil import chaos
+from heat2d_tpu.resil.retry import wait_for
+
+#: probe payload length — big enough to cross the device boundary,
+#: small enough to be free (one cacheline-ish)
+PROBE_N = 16
+
+#: per-device probe deadline: a gray-failing device can HANG the
+#: place-compute-readback round trip, not just fail it — an unbounded
+#: probe would wedge the very sweep the stall watchdog hands off to
+PROBE_DEADLINE_S = 5.0
+
+#: quarantine reasons (the ``mesh_quarantine_total{reason}`` label
+#: vocabulary — docs/SCALING.md)
+QUARANTINE_REASONS = ("probe_failure", "device_fail", "mesh_stall",
+                     "silent_corruption")
+
+
+class MeshStallError(RuntimeError):
+    """A mesh launch outlived its stall deadline — the structured form
+    of the eternal hang. The engine converts it into quarantine +
+    requeue, or ``Rejected("mesh_stall")`` once the requeue budget is
+    spent."""
+
+
+def is_device_loss(exc: BaseException) -> bool:
+    """Failures that name a DEVICE as the casualty: the injected
+    ``DeviceLostError`` and the accelerator-runtime errors a real
+    dead chip raises mid-collective (name-matched like
+    ``resil.retry.default_transient`` — the classes move between
+    modules across jax versions)."""
+    if isinstance(exc, chaos.DeviceLostError):
+        return True
+    return type(exc).__name__ in ("XlaRuntimeError", "JaxRuntimeError")
+
+
+def probe_device(index: int) -> bool:
+    """One device health probe: place a small iota on the device,
+    compute on it, read it back, verify the ANSWER (not just
+    liveness). Any exception or wrong answer is a failure."""
+    if not chaos.device_probe_point(index):
+        return False
+    try:
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+
+        dev = jax.devices()[index]
+        x = jax.device_put(jnp.arange(PROBE_N, dtype=jnp.float32), dev)
+        got = np.asarray(x + 1.0)
+        want = np.arange(1, PROBE_N + 1, dtype=np.float32)
+        return bool(np.array_equal(got, want))
+    except Exception:
+        return False
+
+
+class HealthMonitor:
+    """The per-mesh quarantine book (module docstring). Thread-safe:
+    quarantine decisions arrive from launch paths, watchdog watcher
+    threads, and probe sweeps. ``clock`` stamps event rows (injectable
+    for deterministic tests; wall monotonic by default)."""
+
+    def __init__(self, n_devices: Optional[int] = None, registry=None,
+                 clock: Callable[[], float] = time.monotonic):
+        from heat2d_tpu.mesh.runner import attached_devices
+
+        self.n_devices = len(attached_devices(n_devices))
+        self.registry = registry
+        self.clock = clock
+        self._lock = AuditedLock("mesh.health")
+        self._quarantined: dict = {}     # device -> event row
+        #: every quarantine decision, in order — the audit trail the
+        #: serving invariant (mesh/degrade.py) is checked against
+        self.events: list = []
+        self._seq = 0
+
+    # -- state --------------------------------------------------------- #
+
+    def seq(self) -> int:
+        """Event ordinal fence: launches capture it BEFORE choosing
+        their device set, so 'quarantined before this launch' is a
+        pure integer comparison — no clock races."""
+        with self._lock:
+            return self._seq
+
+    def is_quarantined(self, index: int) -> bool:
+        with self._lock:
+            return index in self._quarantined
+
+    def quarantined(self) -> Tuple[int, ...]:
+        with self._lock:
+            return tuple(sorted(self._quarantined))
+
+    def survivors(self) -> Tuple[int, ...]:
+        """Device indices the next mesh forms over (may be empty)."""
+        with self._lock:
+            return tuple(i for i in range(self.n_devices)
+                         if i not in self._quarantined)
+
+    def capacity_fraction(self) -> float:
+        """Surviving share of the attached mesh — the control plane's
+        sizing input (docs/CONTROL.md)."""
+        with self._lock:
+            live = self.n_devices - len(self._quarantined)
+        return live / self.n_devices if self.n_devices else 0.0
+
+    def snapshot(self) -> dict:
+        """Run-record block: quarantine set + events + capacity."""
+        with self._lock:
+            return {"n_devices": self.n_devices,
+                    "quarantined": sorted(self._quarantined),
+                    "capacity_fraction":
+                        (self.n_devices - len(self._quarantined))
+                        / self.n_devices if self.n_devices else 0.0,
+                    "events": [dict(e) for e in self.events]}
+
+    # -- transitions --------------------------------------------------- #
+
+    def quarantine(self, index: int, reason: str) -> bool:
+        """Quarantine ``index`` (idempotent; False = already out).
+        Quarantine is one-way for the life of the process: a device
+        that failed once does not get re-trusted by the layer that
+        caught it — re-admission is an operator decision, not a
+        retry."""
+        if reason not in QUARANTINE_REASONS:
+            raise ValueError(
+                f"reason must be one of {QUARANTINE_REASONS}, got "
+                f"{reason!r}")
+        if not 0 <= index < self.n_devices:
+            raise ValueError(
+                f"device index {index} outside the "
+                f"{self.n_devices}-device mesh")
+        with self._lock:
+            if index in self._quarantined:
+                return False
+            self._seq += 1
+            row = {"seq": self._seq, "t": self.clock(),
+                   "device": index, "reason": reason}
+            self._quarantined[index] = row
+            self.events.append(row)
+            live = self.n_devices - len(self._quarantined)
+        if self.registry is not None:
+            self.registry.counter("mesh_quarantine_total",
+                                  reason=reason)
+            self.registry.gauge("mesh_quarantined_devices",
+                                float(self.n_devices - live))
+        return True
+
+    def probe(self, devices: Optional[Tuple[int, ...]] = None,
+              reason: str = "probe_failure") -> dict:
+        """Probe ``devices`` (default: current survivors); quarantine
+        every failure. ``reason`` labels the conviction — a sweep run
+        to attribute a stall convicts as ``mesh_stall``, a routine
+        sweep as ``probe_failure`` — so the documented
+        ``mesh_quarantine_total{reason}`` vocabulary is reachable
+        end to end. Returns {index: ok}."""
+        out = {}
+        for i in (self.survivors() if devices is None else devices):
+            try:
+                # bounded: a hung probe convicts like a wrong answer
+                # (wall clock deliberately — this bounds a host-side
+                # hang; the monitor's clock may be frozen by a test)
+                ok = guarded_call(lambda d=i: probe_device(d),
+                                  PROBE_DEADLINE_S)
+            except MeshStallError:
+                ok = False
+            out[i] = ok
+            if not ok:
+                if self.registry is not None:
+                    self.registry.counter("mesh_probe_failures_total")
+                self.quarantine(i, reason)
+        return out
+
+
+def guarded_call(fn: Callable[[], object],
+                 deadline_s: Optional[float], *,
+                 clock: Optional[Callable[[], float]] = None,
+                 on_discard: Optional[Callable[[], None]] = None,
+                 poll: float = 0.005):
+    """Run ``fn()`` under the hung-collective watchdog: returns its
+    result (or re-raises its exception) when it finishes inside
+    ``deadline_s``; raises ``MeshStallError`` when it does not.
+
+    The stalled call keeps running on its (daemon) helper thread —
+    the host cannot preempt a wedged collective — but the moment the
+    stall verdict lands, its eventual result is marked DISCARDED:
+    ``on_discard`` fires when (if) the abandoned call completes, so
+    the never-serve-a-stalled-result invariant is observable, not
+    just intended. ``deadline_s=None`` degrades to a plain call."""
+    if deadline_s is None:
+        return fn()
+
+    lock = AuditedLock("mesh.health.guard")
+    done = threading.Event()
+    box: dict = {}
+    state = {"done": False, "discarded": False}
+
+    def run() -> None:
+        try:
+            value = fn()
+            err = None
+        except BaseException as e:     # noqa: BLE001 — re-raised below
+            value, err = None, e
+        with lock:
+            box["value"], box["error"] = value, err
+            state["done"] = True
+            discarded = state["discarded"]
+        done.set()
+        if discarded and on_discard is not None:
+            on_discard()
+
+    t = threading.Thread(target=run, name="heat2d-mesh-launch",
+                         daemon=True)
+    t.start()
+    # the ONE bounded-poll deadline convention (resil.retry.wait_for
+    # on Watchdog(clock=)); done.wait doubles as the poll sleep
+    wait_for(done.is_set, deadline_s, clock=clock, poll=poll,
+             sleep=lambda s: done.wait(s))
+    with lock:
+        if state["done"]:
+            err = box["error"]
+            if err is not None:
+                raise err
+            return box["value"]
+        # stall: from here on the launch's result is tainted — flag it
+        # BEFORE releasing the lock so the finishing thread cannot race
+        # past the verdict
+        state["discarded"] = True
+    raise MeshStallError(
+        f"mesh launch outlived its {deadline_s}s stall deadline")

@@ -61,7 +61,9 @@ def mesh_capacity(n: int, max_batch: int, n_devices: int) -> int:
 def mesh_batch_runner(nx: int, ny: int, steps: int, method: str = "auto",
                       convergence: bool = False, interval: int = 20,
                       sensitivity: float = 0.1,
-                      n_devices: Optional[int] = None):
+                      n_devices: Optional[int] = None,
+                      device_indices: Optional[tuple] = None,
+                      abft: bool = False):
     """The per-(signature, mesh) COMPILE-CACHED mesh-sharded runner: a
     ``(u0, cxs, cys) -> batch`` (fixed-step) or ``-> (batch,
     steps_done)`` (convergence) callable whose batch axis is sharded
@@ -70,8 +72,23 @@ def mesh_batch_runner(nx: int, ny: int, steps: int, method: str = "auto",
     traffic on a warm signature never retraces; callers pad the batch
     to a ``mesh_capacity`` (a device multiple) before launching.
 
-    The returned callable exposes ``n_devices`` / ``method`` for
-    launch-record provenance.
+    ``device_indices`` (a sorted tuple of attached-device ordinals)
+    builds the mesh over an ARBITRARY device subset instead — the
+    quarantine path's shrunken mesh (``mesh/degrade.py``): after a
+    device is quarantined the survivors are generally not a prefix, so
+    counting alone cannot name them. Wins over ``n_devices`` when
+    given; each subset is its own cache entry (its own compile ladder
+    per mesh shape).
+
+    ``abft=True`` arms the checksum verify tier (ops/abft.py): the
+    runner additionally returns per-member ``(steps_done, s_obs,
+    s_pred, scale)`` — the on-device observation, closed-form
+    prediction, and tolerance scale, all member-local (the batch axis
+    shards whole members, so no extra collective). A separate cache
+    entry: the default program stays byte-identical (jaxpr-pinned).
+
+    The returned callable exposes ``n_devices`` / ``method`` /
+    ``device_indices`` / ``abft`` for launch-record provenance.
     """
     import jax
     import numpy as np
@@ -81,7 +98,11 @@ def mesh_batch_runner(nx: int, ny: int, steps: int, method: str = "auto",
     from heat2d_tpu.parallel.mesh import shard_map_compat
 
     method = ensemble._pick_method(method, nx, ny)
-    devices = attached_devices(n_devices)
+    if device_indices is not None:
+        pool = attached_devices(None)
+        devices = [pool[i] for i in device_indices]
+    else:
+        devices = attached_devices(n_devices)
     nd = len(devices)
     mesh = Mesh(np.asarray(devices), ("batch",))
     if convergence:
@@ -90,6 +111,8 @@ def mesh_batch_runner(nx: int, ny: int, steps: int, method: str = "auto",
     else:
         local = functools.partial(ensemble._BATCH_RUNNERS[method],
                                   steps=steps)
+    if abft:
+        local = _abft_wrap(local, nx, ny, steps, method, convergence)
     mapped = shard_map_compat(local, mesh, in_specs=P("batch"),
                               out_specs=P("batch"), check_vma=False)
     # A stable name, like batch_runner's: compile logs / the recompile
@@ -114,5 +137,40 @@ def mesh_batch_runner(nx: int, ny: int, steps: int, method: str = "auto",
 
     run.n_devices = nd
     run.method = method
+    run.device_indices = device_indices
+    run.abft = abft
     run.jitted = jitted      # the traced program (jaxpr pins)
     return run
+
+
+def _abft_wrap(local, nx: int, ny: int, steps: int, method: str,
+               convergence: bool):
+    """Wrap a per-shard batch runner with the ABFT verify tier's
+    on-device half (ops/abft.py): one weighted reduction over the
+    inputs (prediction + scale) and one over the outputs (observation)
+    per member — the ~1%-overhead checksum the engine's host half
+    re-checks against the buffer it actually serves."""
+    import jax.numpy as jnp
+
+    from heat2d_tpu.ops import abft
+
+    family = abft.supported_family(method)
+    if family is None:
+        raise ValueError(
+            f"method {method!r} has no ABFT recurrence — gate with "
+            f"abft.supported_family before arming the runner")
+    w = jnp.asarray(abft.mode_weights(nx, ny), jnp.float32)
+
+    def run_verified(u0, cxs, cys):
+        out = local(u0, cxs, cys)
+        if convergence:
+            u, k = out
+        else:
+            u = out
+            k = jnp.full((u.shape[0],), steps, jnp.int32)
+        s_pred, scale = abft.predict_batch(u0, cxs, cys, k, w,
+                                           family=family)
+        s_obs = abft.observe_batch(u, w)
+        return u, k, s_obs, s_pred, scale
+
+    return run_verified

@@ -57,9 +57,16 @@ RECORD_SCHEMA = "heat2d-tpu/run-record/v1"
 #: "control" (the fleet control plane: decision log, rollout outcomes
 #: with parity/revert verdicts, worker config generations and the
 #: no-unvalidated-serving invariant, staged retune candidates —
-#: heat2d_tpu/control/, docs/CONTROL.md).
+#: heat2d_tpu/control/, docs/CONTROL.md), "mesh_chaos" (the mesh
+#: fault-tolerance gate — heat2d_tpu/mesh/chaos_gate.py: one row per
+#: injected device-fault scenario (device loss / silent bit flip /
+#: hung collective) with the MEASURED detection + recovery seconds,
+#: bitwise-parity verdict vs the single-chip oracle, quarantine set,
+#: and the no-quarantined-serving invariant —
+#: docs/RESILIENCE.md failure model).
 RECORD_KINDS = ("run", "ensemble", "bench", "sweep", "serve", "tune",
-                "fleet", "inverse", "multichip", "load", "control")
+                "fleet", "inverse", "multichip", "load", "control",
+                "mesh_chaos")
 
 
 def run_context() -> dict:

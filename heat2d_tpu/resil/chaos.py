@@ -36,6 +36,27 @@ failure path executes. This module injects those failures on purpose:
   the full storm). This is how the control gate proves a tuning
   rollout interrupted at its worst moment never leaves a worker
   serving a non-validated config (docs/CONTROL.md).
+- **kill a device in a live mesh** —
+  ``HEAT2D_CHAOS_DEVICE_FAIL_AT=N`` raises ``DeviceLostError`` at the
+  Nth mesh-batch launch attempt (1-based, counted across requeues)
+  and marks device ``HEAT2D_CHAOS_DEVICE_FAIL_INDEX`` (default 0)
+  DEAD for every later health probe — the device-level failure domain
+  (docs/RESILIENCE.md) the mesh engine must answer with quarantine +
+  shrink-and-requeue, not a crash.
+- **hang a collective** — ``HEAT2D_CHAOS_HANG_COLLECTIVE=N`` stalls
+  the Nth mesh-batch launch attempt on the host side for
+  ``HEAT2D_CHAOS_HANG_COLLECTIVE_S`` seconds (default 2.0 — bounded,
+  so the abandoned launch thread always frees itself) and marks the
+  ``DEVICE_FAIL_INDEX`` device dead for probes: the wedged-ICI
+  gray failure only the hung-collective watchdog (mesh/health.py)
+  can bound.
+- **flip a bit** — ``HEAT2D_CHAOS_FLIP_BIT=N`` tells the mesh engine
+  to XOR one high exponent bit into the Nth launch attempt's HOST
+  result buffer (member 0, grid center) before it is verified or
+  served: silent data corruption on the readback path, the fault the
+  ABFT checksum tier (ops/abft.py) exists to catch. The flip itself
+  is applied by the engine — this module stays numpy- and jax-free
+  (rule R004) and only answers "which launch".
 
 Config comes from the environment (so CI can chaos a whole CLI
 subprocess without code changes) or programmatically via ``install()``
@@ -70,6 +91,17 @@ class ChaosError(RuntimeError):
     retryable, like the real launch transients it stands in for)."""
 
 
+class DeviceLostError(ChaosError):
+    """An injected DEVICE failure inside a mesh launch — the stand-in
+    for the ``XlaRuntimeError`` a real dead chip raises mid-collective.
+    Carries the index of the device that died so the mesh engine's
+    quarantine path can attribute blame without a probe sweep."""
+
+    def __init__(self, device_index: int, message: str):
+        super().__init__(message)
+        self.device_index = device_index
+
+
 def _flight_flush(reason: str) -> None:
     """Flush the crash flight recorder, if one is installed, before a
     hard kill. Cold path only (runs once, just before ``os._exit``);
@@ -98,6 +130,11 @@ class ChaosConfig:
     slow_worker_s: float = 0.0
     rollout_kill_phase: Optional[str] = None  # rollout window to storm
     rollout_kills: int = 0                    # workers to kill (0=all)
+    device_fail_at: Optional[int] = None      # 1-based mesh launch
+    device_fail_index: int = 0                # which device dies/hangs
+    hang_collective: Optional[int] = None     # 1-based mesh launch
+    hang_collective_s: float = 2.0            # bounded hang duration
+    flip_bit: Optional[int] = None            # 1-based mesh launch
 
     def __post_init__(self):
         if self.kill_ckpt_phase not in CKPT_PHASES:
@@ -112,7 +149,8 @@ class ChaosConfig:
         # 0 ordinals can never fire (counters are 1-based): canonicalize
         # to disarmed so any_active()/from_env treat them as unset.
         for f in ("kill_ckpt_at", "worker_kill_after",
-                  "heartbeat_drop_after"):
+                  "heartbeat_drop_after", "device_fail_at",
+                  "hang_collective", "flip_bit"):
             if getattr(self, f) == 0:
                 setattr(self, f, None)
 
@@ -148,7 +186,12 @@ class ChaosConfig:
             heartbeat_drop_after=get("HEARTBEAT_DROP_AFTER", int, None),
             slow_worker_s=get("SLOW_WORKER_S", float, 0.0),
             rollout_kill_phase=get("ROLLOUT_KILL_PHASE", str, None),
-            rollout_kills=get("ROLLOUT_KILLS", int, 0))
+            rollout_kills=get("ROLLOUT_KILLS", int, 0),
+            device_fail_at=get("DEVICE_FAIL_AT", int, None),
+            device_fail_index=get("DEVICE_FAIL_INDEX", int, 0),
+            hang_collective=get("HANG_COLLECTIVE", int, None),
+            hang_collective_s=get("HANG_COLLECTIVE_S", float, 2.0),
+            flip_bit=get("FLIP_BIT", int, None))
         return cfg if cfg.any_active() else None
 
     def any_active(self) -> bool:
@@ -157,7 +200,10 @@ class ChaosConfig:
                     or self.worker_kill_after is not None
                     or self.heartbeat_drop_after is not None
                     or self.slow_worker_s
-                    or self.rollout_kill_phase is not None)
+                    or self.rollout_kill_phase is not None
+                    or self.device_fail_at is not None
+                    or self.hang_collective is not None
+                    or self.flip_bit is not None)
 
 
 class _Controller:
@@ -175,6 +221,8 @@ class _Controller:
         self.worker_requests = 0     # fleet-worker request pickups
         self.heartbeats = 0          # heartbeats attempted
         self.rollout_fired = False   # the storm fires exactly once
+        self.mesh_launches = 0       # mesh-batch launch attempts
+        self.dead_devices: set = set()   # failed/hung device indices
 
     def _count(self, point: str) -> None:
         if self.registry is not None:
@@ -271,6 +319,53 @@ class _Controller:
             return False
         return True
 
+    def mesh_launch_point(self) -> None:
+        """Called by the mesh engine at each batch-launch ATTEMPT
+        (requeues count — ordinals address attempts). A hang blocks
+        here for ``hang_collective_s`` (the wedged collective the
+        watchdog must bound; the abandoned thread frees itself when
+        the bounded sleep ends); a device failure raises
+        ``DeviceLostError`` and leaves the device dead for probes."""
+        cfg = self.config
+        with self._lock:
+            self.mesh_launches += 1
+            n = self.mesh_launches
+        if cfg.hang_collective is not None and n == cfg.hang_collective:
+            with self._lock:
+                self.dead_devices.add(cfg.device_fail_index)
+            self._count("hang_collective")
+            time.sleep(cfg.hang_collective_s)
+        if cfg.device_fail_at is not None and n == cfg.device_fail_at:
+            with self._lock:
+                self.dead_devices.add(cfg.device_fail_index)
+            self._count("device_fail")
+            raise DeviceLostError(
+                cfg.device_fail_index,
+                f"injected device {cfg.device_fail_index} failure at "
+                f"mesh launch {n}")
+
+    def device_probe_point(self, index: int) -> bool:
+        """True = the device answers its health probe; False = it is
+        (chaos-)dead. Devices die via ``device_fail_at`` or
+        ``hang_collective`` and STAY dead — quarantine must hold."""
+        with self._lock:
+            return index not in self.dead_devices
+
+    def flip_bit_point(self) -> Optional[int]:
+        """The exponent bit the mesh engine must XOR into this launch
+        attempt's host result buffer (None = healthy). Consults the
+        ATTEMPT ordinal counted by ``mesh_launch_point`` — call order
+        within a launch is launch-point first, flip second."""
+        cfg = self.config
+        if cfg.flip_bit is None:
+            return None
+        with self._lock:
+            armed = self.mesh_launches == cfg.flip_bit
+        if not armed:
+            return None
+        self._count("flip_bit")
+        return 30    # a high exponent bit: O(|u|)-or-worse corruption
+
 
 _lock = AuditedLock("resil.chaos")
 _controller: Optional[_Controller] = None
@@ -365,3 +460,34 @@ def rollout_point(phase: str, kill_cb=None) -> None:
     c = controller()
     if c is not None:
         c.rollout_point(phase, kill_cb)
+
+
+def mesh_launch_point() -> None:
+    """Called by the mesh engine at each batch-launch attempt (may
+    hang or raise ``DeviceLostError`` under an armed campaign)."""
+    if not _enabled and _env_checked:
+        return
+    c = controller()
+    if c is not None:
+        c.mesh_launch_point()
+
+
+def device_probe_point(index: int) -> bool:
+    """Called by mesh health probes; False = the device is chaos-dead."""
+    if not _enabled and _env_checked:
+        return True
+    c = controller()
+    if c is None:
+        return True
+    return c.device_probe_point(index)
+
+
+def flip_bit_point() -> Optional[int]:
+    """Bit to XOR into the current mesh launch's host result buffer
+    (None = healthy). The engine applies the flip; see module doc."""
+    if not _enabled and _env_checked:
+        return None
+    c = controller()
+    if c is None:
+        return None
+    return c.flip_bit_point()

@@ -195,6 +195,34 @@ class Watchdog:
             self._stop.set()
 
 
+def wait_for(predicate: Callable[[], bool],
+             deadline_s: Optional[float], *,
+             clock: Optional[Callable[[], float]] = None,
+             poll: float = 0.01,
+             sleep: Callable[[float], None] = time.sleep) -> bool:
+    """THE bounded-poll deadline convention: poll ``predicate`` until
+    it is truthy (True) or ``deadline_s`` expires on ``clock`` (False).
+
+    Every hand-rolled ``deadline = monotonic() + t; while ...`` loop
+    that guards a dispatch (supervisor ready-waits, rollout
+    ready-waits, the mesh stall guard) routes through here so ONE
+    ``Watchdog(clock=)`` owns deadline semantics — with an injected
+    clock a test can freeze time (no wall-clock flakes on slow hosts)
+    and advance it exactly when the scenario calls for the timeout.
+    ``deadline_s=None`` waits forever (the predicate must win)."""
+    if predicate():
+        return True
+    wd = Watchdog(deadline_s, lambda: None, clock=clock)
+    with wd:
+        while not wd.fired:
+            if predicate():
+                return True
+            sleep(poll)
+    # one last look: the predicate may have turned true in the same
+    # poll window the deadline expired in — completion wins the race
+    return bool(predicate())
+
+
 class DegradedMode:
     """Consecutive-failure circuit breaker (closed -> open -> half-open).
 

@@ -70,6 +70,17 @@ def build_parser() -> argparse.ArgumentParser:
                         "control at R Mcells/s per chip (default: "
                         "admission off; the tune db's measured rate "
                         "is consulted when armed without a rate)")
+    m.add_argument("--mesh-stall-deadline", type=float, default=None,
+                   metavar="S",
+                   help="with --mesh: arm the hung-collective "
+                        "watchdog — a WARM mesh launch stalling past "
+                        "S seconds is quarantined + shrunk-and-"
+                        "requeued instead of hanging forever "
+                        "(docs/RESILIENCE.md failure model)")
+    m.add_argument("--mesh-abft", action="store_true",
+                   help="with --mesh: arm the ABFT checksum verify "
+                        "tier (ops/abft.py) — silent data corruption "
+                        "quarantines the device and recomputes")
     p.add_argument("--metrics-out", default=None, metavar="PATH",
                    help="write telemetry JSONL (events + snapshot + the "
                         "kind='serve' run record)")
@@ -169,10 +180,18 @@ def _mesh_kwargs(args, registry) -> dict:
     if not args.mesh:
         return {}
     from heat2d_tpu.mesh import MeshAdmission, MeshEnsembleEngine
+    fault = None
+    if (getattr(args, "mesh_stall_deadline", None) is not None
+            or getattr(args, "mesh_abft", False)):
+        from heat2d_tpu.mesh import FaultPolicy
+        fault = FaultPolicy(
+            stall_deadline_s=args.mesh_stall_deadline,
+            abft=bool(args.mesh_abft))
     # --max-batch becomes the PER-CHIP bound: the engine's launch
     # bound scales with the mesh instead of discarding the flag.
     out = {"engine": MeshEnsembleEngine(
-        registry=registry, max_batch_per_chip=args.max_batch)}
+        registry=registry, max_batch_per_chip=args.max_batch,
+        fault=fault)}
     if args.mesh_admission_mcells is not None:
         out["admission"] = MeshAdmission(
             registry=registry,
@@ -304,6 +323,12 @@ def _write_metrics(args, registry, server, extra=None) -> None:
             "halo_plans": {str(sig): plan for sig, plan
                            in server.engine.halo_plans.items()},
         }
+        fault = server.engine.fault_snapshot()
+        if fault is not None:
+            # Fault provenance (docs/RESILIENCE.md): the quarantine
+            # book, measured recovery episodes, and the
+            # no-quarantined-serving invariant verdict.
+            extra["mesh"]["fault"] = fault
     if not args.metrics_out:
         return
     from heat2d_tpu.obs.record import build_record
@@ -327,7 +352,21 @@ def _write_metrics(args, registry, server, extra=None) -> None:
 
 
 def main(argv=None) -> int:
-    args = build_parser().parse_args(argv)
+    parser = build_parser()
+    args = parser.parse_args(argv)
+    if not args.mesh:
+        # mesh-dependent flags without --mesh would silently serve on
+        # the plain single-chip engine while LOOKING fault-armed /
+        # admission-priced — a usage error (rc 2), same contract as
+        # the fleet CLI's rollout-dependent flags.
+        for flag, armed in (
+                ("--mesh-stall-deadline",
+                 args.mesh_stall_deadline is not None),
+                ("--mesh-abft", args.mesh_abft),
+                ("--mesh-admission-mcells",
+                 args.mesh_admission_mcells is not None)):
+            if armed:
+                parser.error(f"{flag} requires --mesh")
     if args.log_level:
         import logging
         logging.basicConfig(

@@ -231,8 +231,7 @@ class SolveServer:
             return out
 
         def fail(exc: BaseException) -> None:
-            self._count("rejected_" + exc.code
-                        if isinstance(exc, Rejected) else "error")
+            self._count(_outcome_label(exc))
             self.flight.fail(key, exc)
 
         try:
@@ -341,10 +340,14 @@ class SolveServer:
                 self.breaker.record_failure()
             self._emit_launch_spans(batch, t_launch0, time.monotonic(),
                                     kind, error=repr(e))
+            # a structured rejection from the engine (e.g. the mesh
+            # fault path's Rejected("mesh_stall")) keeps its code in
+            # the outcome labels
+            outcome = _outcome_label(e)
             for p in batch:
                 self.flight.fail(p.key, e)
-                self._count("error")
-                self._sig_count(sig_str, "error")
+                self._count(outcome)
+                self._sig_count(sig_str, outcome)
             return
         t_launch1 = time.monotonic()
         self._emit_launch_spans(batch, t_launch0, t_launch1, kind)
@@ -446,13 +449,20 @@ class Client:
         return self.server.submit(req, timeout=timeout)
 
 
+def _outcome_label(exc: BaseException) -> str:
+    """ONE copy of the failure->outcome-label mapping (submit path,
+    launch path, span emission): a structured ``Rejected`` keeps its
+    code — it is an answer, not an error."""
+    return ("rejected_" + exc.code if isinstance(exc, Rejected)
+            else "error")
+
+
 def _outcome_of(f: Future) -> str:
     """The span/metric outcome label of a resolved future."""
     exc = f.exception()
     if exc is None:
         return "completed"
-    return ("rejected_" + exc.code if isinstance(exc, Rejected)
-            else "error")
+    return _outcome_label(exc)
 
 
 def _failed(exc: BaseException) -> Future:

@@ -263,6 +263,45 @@ def test_plane_sheds_on_sustained_burn_and_lifts_on_recovery():
         "control_shed_active"] == 0.0
 
 
+def test_capacity_advice_reemits_when_quarantine_shrinks_capacity():
+    """A mid-burn quarantine keeps needed_units (a function of the
+    observed rate and the fit alone) but grows the add-units gap —
+    the corrected advice must land as a new decision row, not dedupe
+    away behind an unchanged needed_units."""
+    from heat2d_tpu.mesh.health import HealthMonitor
+
+    fleet = FakePlaneFleet()
+    monitor = HealthMonitor(n_devices=8)
+    fit = {"model": "m", "per_unit_rps": 50.0, "saturated": True}
+    plane = ControlPlane(fleet, policy=_policy(), sustain=2,
+                         shed_watermark=0.4, capacity_fit=fit,
+                         mesh_health=monitor)
+    plane._observed_rps = lambda: 120.0    # 3 units needed, 2 deployed
+    _traffic(fleet.registry, n_ok=100)
+    plane.tick()
+    for _ in range(2):
+        _traffic(fleet.registry, n_fail=10)
+        plane.tick()
+    rows = [d for d in plane.decisions
+            if d["action"] == "capacity_advice"]
+    assert len(rows) == 1
+    assert rows[0]["needed_units"] == 3 and rows[0]["add_units"] == 1
+    monitor.quarantine(3, "device_fail")   # 8 -> 7 chips mid-burn
+    _traffic(fleet.registry, n_fail=10)
+    plane.tick()
+    rows = [d for d in plane.decisions
+            if d["action"] == "capacity_advice"]
+    assert len(rows) == 2
+    assert rows[1]["needed_units"] == 3    # unchanged: rate-driven
+    assert rows[1]["capacity_fraction"] == 0.875
+    assert rows[1]["add_units"] == 2       # ceil(3 - 2 * 0.875)
+    # and the corrected row still dedupes while the state holds
+    _traffic(fleet.registry, n_fail=10)
+    plane.tick()
+    assert len([d for d in plane.decisions
+                if d["action"] == "capacity_advice"]) == 2
+
+
 def test_plane_stages_retune_off_peak(tmp_path):
     fleet = FakePlaneFleet()
     ret = Retuner(fleet,

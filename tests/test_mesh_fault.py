@@ -1,0 +1,905 @@
+"""Mesh fault tolerance (ISSUE 15): ABFT checksum recurrences
+(ops/abft.py), the device chaos campaigns' strict env contract, the
+quarantine book + hung-collective watchdog (mesh/health.py), shrink-
+and-requeue recovery with bitwise parity (mesh/degrade.py + the
+guarded engine), the no-quarantined-serving invariant, and the
+control plane's quarantine feed. Device-shrink scenarios need the
+8-device sim mesh (CI mesh-chaos-gate); everything else runs at any
+device count."""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from heat2d_tpu.mesh import (FaultPolicy, HealthMonitor,
+                             MeshEnsembleEngine, MeshStallError,
+                             mesh_batch_runner, mesh_capacity)
+from heat2d_tpu.mesh import degrade, health
+from heat2d_tpu.obs.metrics import MetricsRegistry
+from heat2d_tpu.ops import abft
+from heat2d_tpu.ops.init import inidat
+from heat2d_tpu.ops.stencil import stencil_step
+from heat2d_tpu.resil import chaos
+from heat2d_tpu.resil.retry import wait_for
+from heat2d_tpu.serve.engine import EnsembleEngine
+from heat2d_tpu.serve.schema import Rejected, SolveRequest
+from tests._pin import assert_jaxpr_differs, assert_jaxpr_equal, \
+    mesh_runner_jaxpr
+
+ND = len(jax.devices())
+NX, NY, STEPS = 16, 20, 6
+
+multichip = pytest.mark.skipif(ND < 8, reason="needs 8 devices")
+
+
+@pytest.fixture(autouse=True)
+def _no_chaos():
+    chaos.uninstall()
+    yield
+    chaos.uninstall()
+
+
+def req(cx=0.1, cy=0.1, **kw):
+    kw.setdefault("nx", NX)
+    kw.setdefault("ny", NY)
+    kw.setdefault("steps", STEPS)
+    kw.setdefault("method", "jnp")
+    return SolveRequest(cx=cx, cy=cy, **kw)
+
+
+def reqs(n, base=0.1, **kw):
+    return [req(cx=base + 0.01 * i, **kw) for i in range(n)]
+
+
+def grids(pairs):
+    return [np.asarray(u).tobytes() for u, _ in pairs]
+
+
+def counters(reg):
+    return reg.snapshot()["counters"]
+
+
+# --------------------------------------------------------------------- #
+# ABFT — the checksum recurrence (ops/abft.py)
+# --------------------------------------------------------------------- #
+
+def _run_explicit(u0, cx, cy, steps):
+    u = jnp.asarray(u0)
+    for _ in range(steps):
+        u = stencil_step(u, cx, cy)
+    return np.asarray(u)
+
+
+def test_explicit_recurrence_with_boundary_flux():
+    """Nonzero edges: the closed-form prediction (mode factor +
+    constant flux) tracks the real f32 run to roundoff."""
+    rng = np.random.default_rng(7)
+    u0 = rng.uniform(0.0, 2.0, (NX, NY)).astype(np.float32)
+    cx, cy = 0.22, 0.15
+    T = 40
+    uT = _run_explicit(u0, cx, cy, T)
+    s_obs = float(abft.host_checksum(uT))
+    s_pred = abft.host_predict(u0, cx, cy, T, method="jnp")
+    w = abft.mode_weights(NX, NY)
+    scale = float(np.einsum("ij,ij->", np.abs(u0), w)) + abs(
+        float(abft.host_checksum(u0)))
+    assert not abft.classify(s_obs, s_pred, scale, T)
+    # and the flux term is LOAD-BEARING: dropping it must miss
+    beta = float(abft.boundary_flux(
+        np.asarray(u0, np.float64), w, cx, cy))
+    assert beta != 0.0
+    alpha = abft.step_factor("explicit", NX, NY, cx, cy)
+    no_flux = (alpha ** T) * float(abft.host_checksum(u0))
+    assert abft.classify(s_obs, no_flux, scale, T)
+
+
+def test_explicit_flux_zero_for_zero_edges():
+    u0 = np.asarray(inidat(NX, NY))
+    w = abft.mode_weights(NX, NY)
+    assert float(abft.boundary_flux(u0, w, 0.2, 0.2)) == 0.0
+
+
+def test_adi_recurrence_zero_edges():
+    from heat2d_tpu.ops.tridiag import adi_multi_step
+
+    u0 = np.asarray(inidat(NX, NY))
+    T = 30
+    cx, cy = 0.4, 0.3         # implicit: outside the explicit box
+    uT = np.asarray(adi_multi_step(jnp.asarray(u0), T, cx, cy))
+    s_pred = abft.host_predict(u0, cx, cy, T, method="adi")
+    w = abft.mode_weights(NX, NY)
+    scale = float(np.einsum("ij,ij->", np.abs(u0), w)) + abs(
+        float(abft.host_checksum(u0)))
+    assert not abft.classify(abft.host_checksum(uT), s_pred, scale, T)
+
+
+def test_flip_detected_healthy_passes():
+    u0 = np.asarray(inidat(NX, NY))
+    T = 25
+    uT = _run_explicit(u0, 0.2, 0.18, T)
+    s_pred = abft.host_predict(u0, 0.2, 0.18, T, method="jnp")
+    w = abft.mode_weights(NX, NY)
+    scale = float(np.einsum("ij,ij->", np.abs(u0), w)) + abs(
+        float(abft.host_checksum(u0)))
+    assert not abft.classify(abft.host_checksum(uT), s_pred, scale, T)
+    bad = uT.copy()
+    bad.view(np.uint32)[NX // 2, NY // 2] ^= np.uint32(1 << 30)
+    assert abft.classify(abft.host_checksum(bad), s_pred, scale, T)
+
+
+def test_power_negative_base_traced():
+    alphas = jnp.asarray([-0.5, 0.5, -1.0, 0.0, 1.0], jnp.float32)
+    ks = jnp.asarray([3, 4, 5, 2, 0], jnp.int32)
+    got = np.asarray(jax.jit(abft._power)(alphas, ks))
+    want = np.asarray([(-0.5) ** 3, 0.5 ** 4, -1.0, 0.0, 1.0],
+                      np.float32)
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-7)
+    # k == 0 is 1 even at alpha == 0
+    assert float(jax.jit(abft._power)(
+        jnp.float32(0.0), jnp.int32(0))) == 1.0
+
+
+def test_supported_family_vocabulary():
+    assert abft.supported_family("jnp") == "explicit"
+    assert abft.supported_family("pallas") == "explicit"
+    assert abft.supported_family("band") == "explicit"
+    assert abft.supported_family("adi") == "adi"
+    assert abft.supported_family("mg") is None
+    with pytest.raises(ValueError):
+        abft.host_predict(np.zeros((4, 4)), 0.1, 0.1, 2, method="mg")
+
+
+def test_predict_batch_traced_matches_host_oracle():
+    B = 3
+    u0 = np.stack([np.asarray(inidat(NX, NY))] * B)
+    cxs = jnp.asarray([0.1, 0.2, 0.24], jnp.float32)
+    cys = jnp.asarray([0.12, 0.15, 0.2], jnp.float32)
+    k = jnp.asarray([STEPS] * B, jnp.int32)
+    w = jnp.asarray(abft.mode_weights(NX, NY), jnp.float32)
+    s_pred, scale = jax.jit(
+        lambda a, b, c, d: abft.predict_batch(a, b, c, d, w,
+                                              family="explicit"))(
+        jnp.asarray(u0), cxs, cys, k)
+    for i in range(B):
+        want = abft.host_predict(u0[i], float(cxs[i]), float(cys[i]),
+                                 STEPS, method="jnp")
+        got = float(np.asarray(s_pred)[i])
+        tol = float(abft.tolerance(float(np.asarray(scale)[i]), STEPS))
+        assert abs(got - want) <= tol
+
+
+# --------------------------------------------------------------------- #
+# chaos — strict env contract for the three device campaigns
+# --------------------------------------------------------------------- #
+
+@pytest.mark.parametrize("var", [
+    "HEAT2D_CHAOS_DEVICE_FAIL_AT", "HEAT2D_CHAOS_DEVICE_FAIL_INDEX",
+    "HEAT2D_CHAOS_HANG_COLLECTIVE", "HEAT2D_CHAOS_FLIP_BIT"])
+def test_chaos_env_garbage_raises_naming_the_var(var):
+    with pytest.raises(ValueError, match=var):
+        chaos.ChaosConfig.from_env({var: "lots"})
+
+
+def test_chaos_env_hang_seconds_garbage_raises():
+    with pytest.raises(ValueError,
+                       match="HEAT2D_CHAOS_HANG_COLLECTIVE_S"):
+        chaos.ChaosConfig.from_env(
+            {"HEAT2D_CHAOS_HANG_COLLECTIVE": "1",
+             "HEAT2D_CHAOS_HANG_COLLECTIVE_S": "soon"})
+
+
+def test_chaos_env_unset_empty_zero_are_off():
+    assert chaos.ChaosConfig.from_env({}) is None
+    assert chaos.ChaosConfig.from_env(
+        {"HEAT2D_CHAOS_DEVICE_FAIL_AT": "",
+         "HEAT2D_CHAOS_HANG_COLLECTIVE": "0",
+         "HEAT2D_CHAOS_FLIP_BIT": "0"}) is None
+    cfg = chaos.ChaosConfig(device_fail_at=0, hang_collective=0,
+                            flip_bit=0)
+    assert not cfg.any_active()
+
+
+def test_chaos_env_armed_parses():
+    cfg = chaos.ChaosConfig.from_env(
+        {"HEAT2D_CHAOS_DEVICE_FAIL_AT": "2",
+         "HEAT2D_CHAOS_DEVICE_FAIL_INDEX": "3",
+         "HEAT2D_CHAOS_HANG_COLLECTIVE": "4",
+         "HEAT2D_CHAOS_HANG_COLLECTIVE_S": "0.5",
+         "HEAT2D_CHAOS_FLIP_BIT": "1"})
+    assert cfg is not None and cfg.any_active()
+    assert (cfg.device_fail_at, cfg.device_fail_index) == (2, 3)
+    assert (cfg.hang_collective, cfg.hang_collective_s) == (4, 0.5)
+    assert cfg.flip_bit == 1
+
+
+def test_device_fail_fires_at_ordinal_and_kills_probes():
+    chaos.install(chaos.ChaosConfig(device_fail_at=2,
+                                    device_fail_index=1))
+    chaos.mesh_launch_point()             # attempt 1: healthy
+    assert chaos.device_probe_point(1)
+    with pytest.raises(chaos.DeviceLostError) as ei:
+        chaos.mesh_launch_point()         # attempt 2: the kill
+    assert ei.value.device_index == 1
+    assert not chaos.device_probe_point(1)    # dead stays dead
+    assert chaos.device_probe_point(0)
+    chaos.mesh_launch_point()             # attempt 3: no re-fire
+
+
+def test_hang_collective_blocks_and_marks_dead():
+    chaos.install(chaos.ChaosConfig(hang_collective=1,
+                                    hang_collective_s=0.2,
+                                    device_fail_index=2))
+    t0 = time.monotonic()
+    chaos.mesh_launch_point()
+    assert time.monotonic() - t0 >= 0.2
+    assert not chaos.device_probe_point(2)
+
+
+def test_flip_bit_point_only_at_armed_ordinal():
+    chaos.install(chaos.ChaosConfig(flip_bit=2))
+    chaos.mesh_launch_point()
+    assert chaos.flip_bit_point() is None
+    chaos.mesh_launch_point()
+    assert chaos.flip_bit_point() == 30
+    chaos.mesh_launch_point()
+    assert chaos.flip_bit_point() is None
+
+
+def test_chaos_idle_hooks_are_noops():
+    assert chaos.flip_bit_point() is None
+    assert chaos.device_probe_point(0)
+    chaos.mesh_launch_point()     # must not raise
+
+
+# --------------------------------------------------------------------- #
+# jaxpr pins — chaos-armed == disarmed; ABFT is a separate program
+# --------------------------------------------------------------------- #
+
+def test_mesh_runner_jaxpr_chaos_armed_equals_disarmed():
+    """Arming every device campaign changes NOTHING in the traced
+    mesh program — chaos lives on the host orchestration only."""
+    base = mesh_runner_jaxpr()
+    chaos.install(chaos.ChaosConfig(device_fail_at=5,
+                                    hang_collective=6, flip_bit=7))
+    armed = mesh_runner_jaxpr()
+    assert_jaxpr_equal(armed, base, "chaos-armed mesh runner")
+
+
+def test_abft_runner_is_its_own_program():
+    plain = mesh_batch_runner(NX, NY, STEPS, "jnp")
+    armed = mesh_batch_runner(NX, NY, STEPS, "jnp", abft=True)
+    assert plain is not armed and armed.abft
+    assert_jaxpr_differs(
+        mesh_runner_jaxpr(NX, NY, STEPS, abft=True),
+        mesh_runner_jaxpr(NX, NY, STEPS),
+        "abft runner vs plain")
+
+
+def test_abft_runner_results_bitwise_equal_plain():
+    plain = mesh_batch_runner(NX, NY, STEPS, "jnp")
+    armed = mesh_batch_runner(NX, NY, STEPS, "jnp", abft=True)
+    b = ND
+    u0 = jnp.broadcast_to(inidat(NX, NY), (b, NX, NY))
+    cs = jnp.linspace(0.1, 0.2, b, dtype=jnp.float32)
+    u_armed, k, s_obs, s_pred, scale = armed(u0, cs, cs)
+    u_plain = plain(u0, cs, cs)
+    assert np.asarray(u_armed).tobytes() == np.asarray(u_plain).tobytes()
+    assert not np.any(abft.classify(np.asarray(s_obs),
+                                    np.asarray(s_pred),
+                                    np.asarray(scale), STEPS))
+
+
+def test_mesh_runner_device_subset():
+    sub = tuple(range(max(1, ND - 1)))
+    run = mesh_batch_runner(NX, NY, STEPS, "jnp", device_indices=sub)
+    assert run.n_devices == len(sub)
+    b = len(sub)
+    u0 = jnp.broadcast_to(inidat(NX, NY), (b, NX, NY))
+    cs = jnp.linspace(0.1, 0.2, b, dtype=jnp.float32)
+    full = mesh_batch_runner(NX, NY, STEPS, "jnp")(
+        jnp.broadcast_to(inidat(NX, NY), (ND, NX, NY)),
+        jnp.pad(cs, (0, ND - b), mode="edge"),
+        jnp.pad(cs, (0, ND - b), mode="edge"))
+    got = run(u0, cs, cs)
+    assert (np.asarray(got).tobytes()
+            == np.asarray(full)[:b].tobytes())
+
+
+# --------------------------------------------------------------------- #
+# health — quarantine book, probes, the stall guard
+# --------------------------------------------------------------------- #
+
+def test_health_monitor_book():
+    reg = MetricsRegistry()
+    m = HealthMonitor(n_devices=4, registry=reg)
+    assert m.survivors() == (0, 1, 2, 3)
+    assert m.capacity_fraction() == 1.0
+    assert m.quarantine(2, "device_fail")
+    assert not m.quarantine(2, "device_fail")     # idempotent
+    assert m.is_quarantined(2)
+    assert m.survivors() == (0, 1, 3)
+    assert m.capacity_fraction() == 0.75
+    snap = m.snapshot()
+    assert snap["quarantined"] == [2]
+    assert snap["events"][0]["reason"] == "device_fail"
+    c = counters(reg)
+    assert c["mesh_quarantine_total{reason=device_fail}"] == 1.0
+    assert reg.snapshot()["gauges"]["mesh_quarantined_devices"] == 1.0
+    with pytest.raises(ValueError):
+        m.quarantine(9, "device_fail")
+    with pytest.raises(ValueError):
+        m.quarantine(0, "bored")
+
+
+def test_health_seq_orders_events():
+    m = HealthMonitor(n_devices=3)
+    fence = m.seq()
+    m.quarantine(0, "probe_failure")
+    assert m.seq() == fence + 1
+    assert m.snapshot()["events"][0]["seq"] == fence + 1
+
+
+def test_probe_sweep_quarantines_chaos_dead_device():
+    chaos.install(chaos.ChaosConfig(device_fail_at=1,
+                                    device_fail_index=0))
+    with pytest.raises(chaos.DeviceLostError):
+        chaos.mesh_launch_point()
+    reg = MetricsRegistry()
+    m = HealthMonitor(n_devices=min(ND, 2), registry=reg)
+    out = m.probe()
+    assert out[0] is False
+    assert m.is_quarantined(0)
+    assert counters(reg)["mesh_probe_failures_total"] >= 1.0
+
+
+def test_probe_device_real_roundtrip():
+    assert health.probe_device(0)
+
+
+def test_guarded_call_passthrough_and_errors():
+    assert health.guarded_call(lambda: 7, None) == 7
+    assert health.guarded_call(lambda: 7, 5.0) == 7
+    with pytest.raises(KeyError):
+        health.guarded_call(lambda: {}["x"], 5.0)
+
+
+def test_guarded_call_stall_discards_late_result():
+    release = threading.Event()
+    discards = []
+    t = [0.0]
+
+    def clock():
+        return t[0]
+
+    def slow():
+        release.wait(5.0)
+        return "late"
+
+    def run():
+        with pytest.raises(MeshStallError):
+            health.guarded_call(slow, 1.0, clock=clock,
+                                on_discard=lambda: discards.append(1))
+
+    th = threading.Thread(target=run)
+    th.start()
+    time.sleep(0.05)          # the guard is polling a frozen clock
+    assert th.is_alive()
+    t[0] = 2.0                # NOW the deadline has passed
+    th.join(5.0)
+    assert not th.is_alive()
+    assert discards == []     # the slow call hasn't finished yet
+    release.set()
+    deadline = time.monotonic() + 5.0
+    while not discards and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert len(discards) == 1     # late result observed as DISCARDED
+
+
+def test_fault_policy_validation():
+    with pytest.raises(ValueError):
+        FaultPolicy(max_requeues=-1)
+    with pytest.raises(ValueError):
+        FaultPolicy(stall_deadline_s=0.0)
+    p = FaultPolicy()
+    assert p.stall_deadline_s is None and not p.abft
+
+
+def test_member_owner_contiguous():
+    devs = (0, 2, 3, 5)
+    assert [degrade.member_owner(m, 8, devs) for m in range(8)] \
+        == [0, 0, 2, 2, 3, 3, 5, 5]
+
+
+def test_serving_invariant_detects_violation():
+    m = HealthMonitor(n_devices=4)
+    m.quarantine(1, "device_fail")
+    good = {"signature": "s", "mesh": {"devices": [0, 2, 3],
+                                       "health_seq": m.seq()}}
+    # a launch claiming to have chosen device 1 AFTER its quarantine
+    bad = {"signature": "s", "mesh": {"devices": [0, 1],
+                                      "health_seq": m.seq()}}
+    ok = degrade.serving_invariant(m, [good])
+    assert ok["ok"] and ok["checked"] == 1
+    res = degrade.serving_invariant(m, [good, bad])
+    assert not res["ok"] and res["violations"][0]["device"] == 1
+
+
+def test_wait_for_deadline_and_injected_clock():
+    assert wait_for(lambda: True, None)
+    assert wait_for(lambda: True, 0.001)
+    t0 = time.monotonic()
+    assert not wait_for(lambda: False, 0.05)
+    assert time.monotonic() - t0 < 2.0
+    # injected clock: each poll advances it far past the deadline,
+    # so the watchdog fires on modeled time, not wall time
+    ticks = iter(range(0, 10_000, 100))
+    assert not wait_for(lambda: False, 50.0,
+                        clock=lambda: float(next(ticks)), poll=0.001)
+
+
+# --------------------------------------------------------------------- #
+# engine — guarded behavior at ANY device count
+# --------------------------------------------------------------------- #
+
+def test_engine_without_fault_has_no_fault_state():
+    eng = MeshEnsembleEngine(registry=MetricsRegistry())
+    assert eng.health is None and eng.degrader is None
+    assert eng.fault_snapshot() is None
+    out = eng.solve_batch(reqs(min(3, ND) or 1))
+    assert len(out) == min(3, ND) or 1
+    row = eng.launch_log[-1]
+    assert "devices" not in row.get("mesh", {})
+
+
+def _batch_decision(eng, r0):
+    """A batch-route decision row (the scheduler routes 'single' on
+    1-device processes; the guarded path itself is device-count
+    agnostic)."""
+    return {"route": "batch", "reason": "fits_chip",
+            "signature": str(r0.signature()),
+            "n_devices": eng.n_devices}
+
+
+def test_device_loss_with_no_survivors_propagates_and_quarantines():
+    chaos.install(chaos.ChaosConfig(device_fail_at=1,
+                                    device_fail_index=0))
+    reg = MetricsRegistry()
+    eng = MeshEnsembleEngine(registry=reg, n_devices=1,
+                             fault=FaultPolicy())
+    rs = reqs(1)
+    with pytest.raises(chaos.DeviceLostError):
+        eng._solve_batch_mesh(rs, _batch_decision(eng, rs[0]))
+    assert eng.health.quarantined() == (0,)
+    # nothing served: the launch log has no served mesh row
+    assert all("devices" not in (r.get("mesh") or {})
+               for r in eng.launch_log)
+    # and the NEXT request is a structured rejection, not a crash
+    with pytest.raises(Rejected) as ei:
+        eng._solve_batch_mesh(rs, _batch_decision(eng, rs[0]))
+    assert ei.value.code == "mesh_degraded"
+
+
+def test_stall_budget_exhausted_is_rejected_mesh_stall():
+    chaos.install(chaos.ChaosConfig(hang_collective=2,
+                                    hang_collective_s=0.4,
+                                    device_fail_index=0))
+    reg = MetricsRegistry()
+    eng = MeshEnsembleEngine(
+        registry=reg, n_devices=1,
+        fault=FaultPolicy(stall_deadline_s=0.05))
+    rs = reqs(1)
+    eng._solve_batch_mesh(rs, _batch_decision(eng, rs[0]))  # warm
+    with pytest.raises(Rejected) as ei:
+        eng._solve_batch_mesh(rs, _batch_decision(eng, rs[0]))
+    assert ei.value.code == "mesh_stall"
+    assert eng.health.quarantined() == (0,)
+    assert counters(reg)["mesh_stall_total"] >= 1.0
+
+
+def test_runtime_error_without_conviction_propagates_unrequeued():
+    """An accelerator runtime error that names no device and whose
+    probe sweep convicts nobody is NOT a device fault: the guarded
+    loop must propagate it (the server's transient classification
+    owns it), not relaunch the same failing program through the
+    requeue budget."""
+    XlaRuntimeError = type("XlaRuntimeError", (RuntimeError,), {})
+    reg = MetricsRegistry()
+    eng = MeshEnsembleEngine(registry=reg, n_devices=1,
+                             fault=FaultPolicy())
+    calls = []
+
+    def boom(requests, device_indices, abft):
+        calls.append(1)
+        raise XlaRuntimeError("deterministic launch failure")
+
+    eng._launch_batch = boom
+    rs = reqs(1)
+    with pytest.raises(XlaRuntimeError):
+        eng._solve_batch_mesh(rs, _batch_decision(eng, rs[0]))
+    assert len(calls) == 1                    # no requeue
+    assert eng.health.quarantined() == ()     # no conviction
+    assert "mesh_requeue_total{cause=device_fail}" not in counters(reg)
+
+
+def test_abft_unsupported_method_served_and_counted():
+    reg = MetricsRegistry()
+    eng = MeshEnsembleEngine(registry=reg, n_devices=1,
+                             fault=FaultPolicy(abft=True))
+    rs = reqs(1, method="mg", steps=4)
+    out = eng._solve_batch_mesh(rs, _batch_decision(eng, rs[0]))
+    assert len(out) == 1
+    assert counters(reg)["mesh_abft_unsupported_total{reason=mg}"] \
+        == 1.0
+
+
+# --------------------------------------------------------------------- #
+# engine — shrink-and-requeue on the 8-device mesh (the CI gate's
+# in-suite twins)
+# --------------------------------------------------------------------- #
+
+@multichip
+def test_device_loss_shrinks_and_recovers_bitwise():
+    oracle = grids(EnsembleEngine(max_batch=8).solve_batch(reqs(5)))
+    chaos.install(chaos.ChaosConfig(device_fail_at=1,
+                                    device_fail_index=3))
+    reg = MetricsRegistry()
+    eng = MeshEnsembleEngine(registry=reg, fault=FaultPolicy())
+    out = eng.solve_batch(reqs(5))
+    assert grids(out) == oracle
+    assert eng.health.quarantined() == (3,)
+    row = eng.launch_log[-1]["mesh"]
+    assert row["devices"] == [0, 1, 2, 4, 5, 6, 7]
+    assert row["degraded"] is True
+    rec = row["recovery"]
+    assert rec["cause"] == "device_fail" and rec["recovery_s"] > 0
+    snap = eng.fault_snapshot()
+    assert snap["invariant"]["ok"]
+    assert counters(reg)["mesh_requeue_total{cause=device_fail}"] \
+        == 1.0
+
+
+@multichip
+def test_flip_bit_abft_detects_quarantines_recovers_bitwise():
+    oracle = grids(EnsembleEngine(max_batch=8).solve_batch(reqs(5)))
+    chaos.install(chaos.ChaosConfig(flip_bit=1))
+    reg = MetricsRegistry()
+    eng = MeshEnsembleEngine(registry=reg,
+                             fault=FaultPolicy(abft=True))
+    out = eng.solve_batch(reqs(5))
+    assert grids(out) == oracle
+    # member 0's owner (device 0) was convicted of silent corruption
+    assert eng.health.quarantined() == (0,)
+    assert eng.health.snapshot()["events"][0]["reason"] \
+        == "silent_corruption"
+    c = counters(reg)
+    assert c["mesh_abft_mismatch_total"] >= 1.0
+    assert c["mesh_requeue_total{cause=silent_corruption}"] == 1.0
+    assert eng.fault_snapshot()["invariant"]["ok"]
+
+
+@multichip
+def test_flip_bit_without_abft_is_served_corrupt():
+    """The vulnerability the verify tier exists for: without ABFT the
+    flipped result IS served (and differs from the oracle)."""
+    oracle = grids(EnsembleEngine(max_batch=8).solve_batch(reqs(5)))
+    chaos.install(chaos.ChaosConfig(flip_bit=1))
+    eng = MeshEnsembleEngine(registry=MetricsRegistry(),
+                             fault=FaultPolicy(abft=False))
+    out = eng.solve_batch(reqs(5))
+    assert grids(out) != oracle
+
+
+@multichip
+def test_hang_stall_detected_shrinks_recovers_bitwise():
+    # the recovery pays a cold compile on the 7-survivor mesh; the
+    # hang must comfortably exceed deadline + compile or the
+    # beat-the-hang assertion races the XLA compiler, not the watchdog
+    hang_s = 3.0
+    base = 0.3
+    victims = reqs(5, base=base)
+    oracle = grids(EnsembleEngine(max_batch=8).solve_batch(victims))
+    chaos.install(chaos.ChaosConfig(hang_collective=2,
+                                    hang_collective_s=hang_s,
+                                    device_fail_index=2))
+    reg = MetricsRegistry()
+    eng = MeshEnsembleEngine(
+        registry=reg, fault=FaultPolicy(stall_deadline_s=0.25,
+                                        max_requeues=3))
+    eng.solve_batch(reqs(5))                  # warm (attempt 1)
+    t0 = time.monotonic()
+    out = eng.solve_batch(victims)
+    recovered = time.monotonic() - t0
+    assert grids(out) == oracle
+    assert recovered < hang_s                # the watchdog BEAT the hang
+    assert 2 in eng.health.quarantined()
+    # a stall-sweep conviction carries the stall's own reason label —
+    # the documented mesh_quarantine_total{reason} vocabulary is
+    # reachable end to end
+    assert [e["reason"] for e in eng.health.snapshot()["events"]
+            if e["device"] == 2] == ["mesh_stall"]
+    assert eng.fault_snapshot()["invariant"]["ok"]
+    # the abandoned launch's late result is discarded, observably
+    deadline = time.monotonic() + hang_s + 3.0
+    while time.monotonic() < deadline:
+        c = counters(reg)
+        if c.get("mesh_discarded_results_total{cause=mesh_stall}"):
+            break
+        time.sleep(0.05)
+    assert c["mesh_discarded_results_total{cause=mesh_stall}"] >= 1.0
+    assert c["mesh_stall_total"] >= 1.0
+
+
+@multichip
+def test_spatial_signature_degrades_to_survivor_batch_bitwise():
+    from heat2d_tpu.mesh.scheduler import MeshScheduler
+
+    reg = MetricsRegistry()
+    sched = MeshScheduler(registry=reg, spatial_bytes_threshold=1)
+    eng = MeshEnsembleEngine(registry=reg, scheduler=sched,
+                             fault=FaultPolicy())
+    rs = reqs(3)
+    assert sched.decide(rs[0])["route"] == "spatial"
+    eng.health.quarantine(4, "device_fail")
+    out = eng.solve_batch(rs)
+    oracle = grids(EnsembleEngine(max_batch=8).solve_batch(rs))
+    assert grids(out) == oracle
+    row = eng.launch_log[-1]["mesh"]
+    assert row["route"] == "batch" and row["reason"] == "quarantined"
+    assert 4 not in row["devices"]
+    assert counters(reg)["mesh_fallback_total{reason=quarantined}"] \
+        == 1.0
+
+
+@multichip
+def test_spatial_route_device_loss_reroutes_to_survivors_bitwise():
+    """A chip dying MID-SPATIAL-LAUNCH is classified like the batch
+    route's failures — quarantine, then the same batch re-dispatches
+    onto the survivor batch mesh bitwise — instead of propagating raw
+    and failing forever on retries of the identical full-mesh
+    program."""
+    from heat2d_tpu.mesh.scheduler import MeshScheduler
+
+    rs = reqs(3)
+    oracle = grids(EnsembleEngine(max_batch=8).solve_batch(rs))
+    chaos.install(chaos.ChaosConfig(device_fail_at=1,
+                                    device_fail_index=2))
+    reg = MetricsRegistry()
+    sched = MeshScheduler(registry=reg, spatial_bytes_threshold=1)
+    eng = MeshEnsembleEngine(registry=reg, scheduler=sched,
+                             fault=FaultPolicy())
+    assert sched.decide(rs[0])["route"] == "spatial"
+    out = eng.solve_batch(rs)
+    assert grids(out) == oracle
+    assert eng.health.quarantined() == (2,)
+    row = eng.launch_log[-1]["mesh"]
+    assert row["route"] == "batch" and row["reason"] == "quarantined"
+    assert 2 not in row["devices"]
+    assert counters(reg)["mesh_requeue_total{cause=device_fail}"] \
+        == 1.0
+    assert eng.degrader.events[-1]["cause"] == "device_fail"
+    assert eng.degrader.events[-1]["recovery_s"] > 0
+    assert eng.fault_snapshot()["invariant"]["ok"]
+
+
+def test_hung_probe_convicts_within_deadline(monkeypatch):
+    """A gray-failing device can HANG its probe, not just fail it —
+    the sweep bounds each round trip so a wedged chip cannot wedge
+    the very recovery path the stall watchdog hands off to."""
+    m = HealthMonitor(n_devices=1)
+    monkeypatch.setattr(health, "PROBE_DEADLINE_S", 0.1)
+    release = threading.Event()
+
+    def hang(_index):
+        release.wait(10.0)
+        return True
+
+    monkeypatch.setattr(health, "probe_device", hang)
+    t0 = time.monotonic()
+    out = m.probe()
+    took = time.monotonic() - t0
+    release.set()
+    assert out[0] is False and m.is_quarantined(0)
+    assert took < 5.0            # bounded, not the 10s hang
+
+
+def test_fault_clock_threads_into_health_monitor():
+    """One clock domain for the whole fault stack: quarantine event
+    stamps, detection, and recovery rows all read the injected
+    fault_clock."""
+    eng = MeshEnsembleEngine(registry=MetricsRegistry(), n_devices=1,
+                             fault=FaultPolicy(),
+                             fault_clock=lambda: 42.0)
+    eng.health.quarantine(0, "device_fail")
+    assert eng.health.snapshot()["events"][0]["t"] == 42.0
+    assert eng.degrader.now() == 42.0
+
+
+def test_serve_cli_mesh_flags_require_mesh():
+    """Mesh-dependent serve flags without --mesh are a usage error
+    (rc 2), never a silently-unarmed run."""
+    from heat2d_tpu.serve import cli
+
+    for argv in (["--mesh-abft"], ["--mesh-stall-deadline", "5"],
+                 ["--mesh-admission-mcells", "100"]):
+        with pytest.raises(SystemExit) as ei:
+            cli.main(argv + ["--selftest"])
+        assert ei.value.code == 2
+
+
+@multichip
+def test_single_route_pins_to_survivor_and_stamps_invariant():
+    """The single-chip fallback may not serve from a convicted chip:
+    an unpinned jit computes on the DEFAULT device — exactly the
+    quarantined one after a device-0 conviction — so the guarded
+    engine pins the launch to the first survivor and stamps devices +
+    the health fence, bringing this route under the
+    no-quarantined-serving invariant instead of past it."""
+    from heat2d_tpu.mesh.scheduler import MeshScheduler
+
+    reg = MetricsRegistry()
+    sched = MeshScheduler(registry=reg, spatial_bytes_threshold=1)
+    eng = MeshEnsembleEngine(registry=reg, scheduler=sched,
+                             fault=FaultPolicy())
+    eng.health.quarantine(0, "silent_corruption")
+    rs = reqs(2, nx=15, ny=18)          # unplannable -> single route
+    assert sched.decide(rs[0])["route"] == "single"
+    oracle = grids(EnsembleEngine(max_batch=8).solve_batch(rs))
+    assert grids(eng.solve_batch(rs)) == oracle
+    row = eng.launch_log[-1]["mesh"]
+    assert row["route"] == "single"
+    assert row["devices"] == [1]        # OFF the convicted device 0
+    assert row["health_seq"] == 1
+    assert eng.fault_snapshot()["invariant"]["ok"]
+    # the fence is load-bearing: the same launch attributed to the
+    # convicted device would be flagged
+    row["devices"] = [0]
+    assert not eng.fault_snapshot()["invariant"]["ok"]
+
+
+def test_single_route_all_quarantined_is_rejected():
+    reg = MetricsRegistry()
+    eng = MeshEnsembleEngine(registry=reg, n_devices=1,
+                             fault=FaultPolicy())
+    eng.health.quarantine(0, "device_fail")
+    with pytest.raises(Rejected) as ei:
+        eng.solve_batch(reqs(1))
+    assert ei.value.code == "mesh_degraded"
+
+
+@multichip
+def test_requeue_capacity_repads_to_survivor_multiple():
+    """After a shrink to 7 devices the padded capacity is a 7-multiple
+    (the compile ladder per mesh shape), not the old 8-multiple."""
+    chaos.install(chaos.ChaosConfig(device_fail_at=1,
+                                    device_fail_index=6))
+    eng = MeshEnsembleEngine(registry=MetricsRegistry(),
+                             fault=FaultPolicy())
+    eng.solve_batch(reqs(5))
+    row = eng.launch_log[-1]
+    assert len(row["mesh"]["devices"]) == 7
+    assert row["capacity"] % 7 == 0
+    assert row["capacity"] == mesh_capacity(5, eng.max_batch, 7)
+
+
+@multichip
+def test_recovery_through_solve_server_single_flight():
+    """The requeue is invisible to the serving machinery: leader and
+    coalesced follower both get the recovered, bitwise-correct
+    answer."""
+    from heat2d_tpu.serve.server import SolveServer
+
+    victims = reqs(3, base=0.31)
+    oracle = grids(EnsembleEngine(max_batch=8).solve_batch(victims))
+    chaos.install(chaos.ChaosConfig(device_fail_at=2,
+                                    device_fail_index=5))
+    reg = MetricsRegistry()
+    eng = MeshEnsembleEngine(registry=reg, fault=FaultPolicy())
+    server = SolveServer(registry=reg, engine=eng,
+                         max_batch=eng.max_batch,
+                         default_timeout=120.0)
+    with server:
+        for f in [server.submit(r) for r in reqs(8, base=0.05)]:
+            f.result(120)                       # warm = attempt 1
+        futs = [server.submit(r) for r in victims]
+        dup = server.submit(victims[0])         # coalesced follower
+        got = [np.asarray(f.result(120).u).tobytes() for f in futs]
+        dup_res = dup.result(120)
+    assert got == oracle
+    assert np.asarray(dup_res.u).tobytes() == oracle[0]
+    assert dup_res.coalesced
+    assert eng.health.quarantined() == (5,)
+    assert eng.fault_snapshot()["invariant"]["ok"]
+
+
+@multichip
+def test_chaos_gate_record_shape():
+    from heat2d_tpu.mesh import chaos_gate
+
+    payload = chaos_gate.run_gate()
+    assert payload["passed"] is True
+    names = [s["scenario"] for s in payload["scenarios"]]
+    assert names == ["device_loss", "bit_flip", "hung_collective"]
+    for s in payload["scenarios"]:
+        assert s["bitwise"] and s["recovered"]
+        assert s["recovery_s"] > 0 and s["invariant"]["ok"]
+
+
+# --------------------------------------------------------------------- #
+# control plane — quarantine feeds capacity decisions
+# --------------------------------------------------------------------- #
+
+class _FakeSup:
+    def __init__(self, alive=(0, 1)):
+        self._alive = list(alive)
+        self.clock = None
+
+    def alive_slots(self):
+        return list(self._alive)
+
+
+class _FakeFleet:
+    def __init__(self):
+        self.registry = MetricsRegistry()
+        self.sup = _FakeSup()
+        self.shed_calls = []
+
+    def set_preemptive_shed(self, wm):
+        self.shed_calls.append(wm)
+
+
+def test_control_plane_quarantine_feed():
+    from heat2d_tpu.control.plane import ControlPlane
+
+    fleet = _FakeFleet()
+    monitor = HealthMonitor(n_devices=4)
+    plane = ControlPlane(fleet, registry=fleet.registry,
+                         mesh_health=monitor)
+    plane.tick()      # healthy startup: baseline, no decision row
+    assert not [d for d in plane.decisions
+                if d["action"] == "device_quarantine"]
+    monitor.quarantine(2, "silent_corruption")
+    plane.tick()
+    plane.tick()      # no transition -> no duplicate row
+    rows = [d for d in plane.decisions
+            if d["action"] == "device_quarantine"]
+    assert len(rows) == 1
+    assert rows[0]["quarantined"] == [2]
+    assert rows[0]["capacity_fraction"] == 0.75
+    assert rows[0]["events"] == [{"device": 2,
+                                  "reason": "silent_corruption"}]
+    g = fleet.registry.snapshot()["gauges"]
+    assert g["control_quarantined_devices"] == 1.0
+    # a later conviction logs ONLY its own transition's events, not a
+    # growing copy of the whole history
+    monitor.quarantine(0, "device_fail")
+    plane.tick()
+    rows = [d for d in plane.decisions
+            if d["action"] == "device_quarantine"]
+    assert len(rows) == 2
+    assert rows[1]["quarantined"] == [0, 2]
+    assert rows[1]["events"] == [{"device": 0,
+                                  "reason": "device_fail"}]
+
+
+def test_control_plane_logs_preexisting_quarantine_on_first_tick():
+    """Quarantines that PRE-DATE the plane (a restart mid-incident)
+    are state the audit trail must carry: the startup baseline only
+    suppresses the healthy 'nothing is quarantined' row."""
+    from heat2d_tpu.control.plane import ControlPlane
+
+    fleet = _FakeFleet()
+    monitor = HealthMonitor(n_devices=4)
+    monitor.quarantine(1, "device_fail")     # before the plane exists
+    plane = ControlPlane(fleet, registry=fleet.registry,
+                         mesh_health=monitor)
+    plane.tick()
+    plane.tick()      # still one transition -> still one row
+    rows = [d for d in plane.decisions
+            if d["action"] == "device_quarantine"]
+    assert len(rows) == 1
+    assert rows[0]["quarantined"] == [1]
