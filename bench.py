@@ -26,33 +26,12 @@ NX = NY = 1024 if QUICK else 4096
 STEPS = 100 if QUICK else 24000
 BASELINE_MCELLS = 669.0  # reference CUDA, 2560x2048 (BASELINE.md Table 10)
 
-#: Resident-kernel VPU calibration by row width (tune_bands.md round 4):
-#: pure-VPU Mcells/s of the FMA step form with no HBM streaming or
-#: strips — the numerator of the structural ceiling.
-VPU_CALIB_MCELLS = {512: 257_000.0, 1024: 254_000.0, 2048: 252_000.0,
-                    4096: 248_000.0}
-
-
-def calibrated_bound_mcells(nx: int, ny: int):
-    """Structural ceiling for the streaming window route at this shape:
-    VPU calibration at the route's row width x bm/(bm+2T) (the band
-    halo-recompute factor — the tune_bands.md methodology). None when
-    the shape is VMEM-resident (no streaming structure) or the width is
-    uncalibrated. Uses the same planners the solver routes through, so
-    the bound tracks the actual kernel configuration."""
-    import heat2d_tpu.ops.pallas_stencil as ps
-
-    if ps.fits_vmem((nx, ny)):
-        return None
-    t = ps.DEFAULT_TSTEPS
-    p, bm = ps.plan_panels(nx, ny, t)
-    nyp = ny // p
-    if p == 1:
-        bm, _ = ps.plan_window_band(nx, ny, t)
-    calib = VPU_CALIB_MCELLS.get(nyp)
-    if calib is None:
-        return None
-    return calib * bm / (bm + 2 * t)
+# The calibrated bound now lives in the package (obs/roofline.py) so
+# the serving stack can reach it; imported back here so bench.py's
+# public surface is unchanged (tests and BENCH_r* tooling keep their
+# import path).
+from heat2d_tpu.obs.roofline import (VPU_CALIB_MCELLS,       # noqa: F401,E402
+                                     calibrated_bound_mcells)
 
 
 def build_record(value: float, method: str, elapsed: float,
@@ -91,6 +70,20 @@ def build_record(value: float, method: str, elapsed: float,
             quick=QUICK, on_tpu=on_tpu)
     except Exception as e:  # noqa: BLE001 — record, don't lose bench
         rec["time_to_solution"] = {"error": f"{type(e).__name__}: {e}"}
+    # ROADMAP item 2's headline efficiency rows (obs/roofline.py):
+    # analytic HBM bytes one cell-update moves on this route, and its
+    # reciprocal — the metric any bf16/temporal-blocking claim must
+    # move. Structural (throughput-independent) by design; guarded
+    # like the tts block so a model gap never loses the headline.
+    try:
+        from heat2d_tpu.obs import roofline
+        m = roofline.analytic_bytes_per_cell_step(nx, ny, method=mode)
+        rec["bytes_per_cell_step"] = round(m["bytes_per_cell_step"], 4)
+        rec["mcells_per_hbm_byte"] = round(
+            1.0 / (1e6 * m["bytes_per_cell_step"]), 9)
+    except Exception as e:  # noqa: BLE001 — record, don't lose bench
+        rec["bytes_per_cell_step"] = {"error":
+                                      f"{type(e).__name__}: {e}"}
     bound = calibrated_bound_mcells(nx, ny)
     if bound is not None and method == "two-point" and mode == "pallas":
         # Only the pallas route's two-point marginal is comparable to

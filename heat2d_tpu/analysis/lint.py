@@ -72,8 +72,8 @@ METRIC_METHODS = {"counter", "gauge", "observe", "series", "timer"}
 #: metric families the drift rule covers (names outside these prefixes
 #: are not part of the documented contract)
 METRIC_RE = re.compile(
-    r"^(serve|fleet|resil|tune|inverse|slo|load|control|mesh|adi|mg)"
-    r"_[a-z0-9_]+$")
+    r"^(serve|fleet|resil|tune|inverse|slo|load|control|mesh|adi|mg"
+    r"|perf)_[a-z0-9_]+$")
 
 #: keyword names whose literal string values name a metric family
 #: (e.g. ``SingleFlight(counter="fleet_coalesced_total")``)
@@ -557,8 +557,8 @@ def _code_metric_names(trees: Dict[str, ast.Module]) -> Tuple[
 
 
 _DOC_METRIC_RE = re.compile(
-    r"`((?:serve|fleet|resil|tune|inverse|slo|load|control|mesh|adi|mg)_"
-    r"[a-z0-9_*]+)"
+    r"`((?:serve|fleet|resil|tune|inverse|slo|load|control|mesh|adi|mg"
+    r"|perf)_[a-z0-9_*]+)"
     r"(?:\{[^`]*\})?`")
 
 

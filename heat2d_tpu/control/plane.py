@@ -46,8 +46,15 @@ class ControlPlane:
                  burn_threshold: float = 1.0, sustain: int = 3,
                  shed_watermark: float = 0.4,
                  retuner=None, capacity_fit: Optional[dict] = None,
-                 registry=None, mesh_health=None):
-        """``mesh_health``: an optional ``mesh.HealthMonitor`` — the
+                 registry=None, mesh_health=None, sentinel=None):
+        """``sentinel``: an optional ``obs.perf.AnomalySentinel`` —
+        each tick evaluates one sentinel window and its findings land
+        in the decision log as ``perf_anomaly`` rows beside burn,
+        closing telemetry->detection for performance regressions the
+        SLO burn machinery can't see (a rate that quietly halved, a
+        tail that grew inside its SLO, a roofline fraction that sagged).
+
+        ``mesh_health``: an optional ``mesh.HealthMonitor`` — the
         device-quarantine book feeds capacity decisions: every
         quarantine transition lands in the decision log, the
         ``control_quarantined_devices`` gauge tracks the count, and
@@ -56,6 +63,7 @@ class ControlPlane:
         capacity actually serving)."""
         self.fleet = fleet
         self.mesh_health = mesh_health
+        self.sentinel = sentinel
         self._last_quarantined: Optional[int] = None
         self._last_quarantine_seq = 0
         self.policy = policy or slo.SLOPolicy(latency_p99_s=30.0)
@@ -187,6 +195,13 @@ class ControlPlane:
                     self._last_quarantine_seq = max(
                         e["seq"] for e in fresh)
                 self._last_quarantined = q
+
+        if self.sentinel is not None and self.registry is not None:
+            # one sentinel window per tick: EWMA+MAD findings are
+            # decision rows beside burn (obs/perf.AnomalySentinel) —
+            # detection only; actuation stays with the burn machinery
+            for f in self.sentinel.tick(self.registry):
+                self._decide("perf_anomaly", **f)
 
         if sustained and not self._shed_active:
             # escalate BEFORE the breaker: shed the low-priority

@@ -39,6 +39,7 @@ engine is byte-identical to PR 13's.
 
 from __future__ import annotations
 
+import time
 from typing import List, Optional, Tuple
 
 from heat2d_tpu.mesh.health import MeshStallError
@@ -114,6 +115,12 @@ class MeshEnsembleEngine(EnsembleEngine):
         #: and stays bounded by the server's launch_deadline watchdog
         #: one layer up.
         self._mesh_warm: set = set()
+        #: (elapsed, effective steps, cost card) of the launch attempt
+        #: that is about to be accounted — set by the launch paths,
+        #: consumed (popped) by ``_account``'s roofline stamp. Engine
+        #: calls are serialized by the dispatcher (the same assumption
+        #: ``_tag_launch``'s launch_log[-1] already makes).
+        self._launch_perf: Optional[dict] = None
 
     # -- dispatch ------------------------------------------------------ #
 
@@ -244,6 +251,7 @@ class MeshEnsembleEngine(EnsembleEngine):
                  if self.registry is not None
                  else contextlib.nullcontext())
         ab = None
+        t0 = time.monotonic()
         with timer:
             out = runner(u0, cxs, cys)
             if abft:
@@ -260,6 +268,23 @@ class MeshEnsembleEngine(EnsembleEngine):
             else:
                 u = np.asarray(out)
                 steps_done = [req0.steps] * capacity
+        elapsed = time.monotonic() - t0
+        from heat2d_tpu.obs import perf
+        card = None
+        if perf.enabled():
+            card = perf.observe_launch(
+                runner, (u0, cxs, cys),
+                meta={"signature": str(req0.signature()),
+                      "nx": req0.nx, "ny": req0.ny,
+                      "steps": req0.steps, "method": req0.method,
+                      "convergence": req0.convergence,
+                      "capacity": capacity, "dtype": "float32",
+                      "route": "mesh_batch"})
+        self._launch_perf = {
+            "elapsed_s": elapsed,
+            "steps": (sum(steps_done) / len(steps_done)
+                      if req0.convergence else req0.steps),
+            "card": card}
         return u, steps_done, capacity, ab
 
     # -- the guarded (fault-tolerant) batch route ---------------------- #
@@ -472,9 +497,27 @@ class MeshEnsembleEngine(EnsembleEngine):
 
         def launch():
             chaos.mesh_launch_point()
+            t0 = time.monotonic()
             u, k = runner(u0, cxs, cys)
-            return (np.asarray(u),
-                    [int(s) for s in np.asarray(k)])
+            u = np.asarray(u)
+            steps_done = [int(s) for s in np.asarray(k)]
+            elapsed = time.monotonic() - t0
+            from heat2d_tpu.obs import perf
+            card = None
+            if perf.enabled():
+                card = perf.observe_launch(
+                    runner, (u0, cxs, cys),
+                    meta={"signature": str(req0.signature()),
+                          "nx": req0.nx, "ny": req0.ny,
+                          "steps": req0.steps, "method": req0.method,
+                          "convergence": req0.convergence,
+                          "capacity": capacity, "dtype": "float32",
+                          "route": "mesh_spatial"})
+            self._launch_perf = {
+                "elapsed_s": elapsed,
+                "steps": sum(steps_done) / len(steps_done),
+                "card": card}
+            return (u, steps_done)
 
         timer = (self.registry.timer("serve_launch_s")
                  if self.registry is not None
@@ -579,6 +622,16 @@ class MeshEnsembleEngine(EnsembleEngine):
             mesh_row["degraded"] = len(devices) < self.n_devices
             if recovery is not None:
                 mesh_row["recovery"] = dict(recovery)
+        # roofline stamp for the mesh routes (the single-chip fallback
+        # is stamped by the inherited solve_batch)
+        lp, self._launch_perf = self._launch_perf, None
+        if lp is not None:
+            from heat2d_tpu.obs import roofline
+            roofline.stamp_launch_row(
+                row, self.registry, nx=req0.nx, ny=req0.ny,
+                steps=lp["steps"], members=capacity,
+                elapsed_s=lp["elapsed_s"], method=req0.method,
+                signature=str(req0.signature()), card=lp["card"])
 
     def fault_snapshot(self) -> Optional[dict]:
         """Run-record ``mesh_fault`` block: policy, measured recovery

@@ -324,6 +324,12 @@ class MetricsRegistry:
             return {k[1]: v for k, v in self._counters.items()
                     if k[0] == name}
 
+    def find_gauges(self, name: str) -> dict:
+        """{label-pairs tuple: value} for every series of ``name``."""
+        with self._lock:
+            return {k[1]: v for k, v in self._gauges.items()
+                    if k[0] == name}
+
     # -- multihost aggregation ----------------------------------------- #
 
     def aggregate_multihost(self) -> dict:

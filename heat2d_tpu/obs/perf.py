@@ -1,0 +1,562 @@
+"""The performance observatory: cost cards, duty-cycle sampling, and
+the online anomaly sentinel.
+
+Three instruments, one discipline (free when off, jaxpr-pinned like
+every obs hook — tests/test_perf.py):
+
+- **Cost cards** (``extract_cost_card`` / ``PerfObserver``): at a
+  runner's first launch per (signature, capacity, route), re-lower the
+  ALREADY-COMPILED jit callable and read XLA's own cost/memory
+  analysis — FLOPs, op-level bytes accessed, argument/output/temp
+  sizes, generated-code size — cross-checked against the analytic
+  models in ``obs.roofline``. Program-boundary bytes (argument +
+  output) agree near-exactly with the boundary model on every backend;
+  op-level 'bytes accessed' is recorded with its agreement ratio but
+  only asserted where the kernel is an opaque custom call (TPU), since
+  CPU lowering counts unfused intermediates. XLA counts a while/fori
+  body ONCE regardless of trip count, so per-step byte figures here
+  are per body application, never multiplied by steps.
+- **Duty-cycle sampler** (``DutyCycleSampler``): a background thread
+  fed by the distributed tracer's span stream (``tracing.add_span_
+  tap``) integrating closed launch-span intervals over a sliding
+  window per (service, pid) lane — the live "how busy is each lane"
+  gauge. Launch spans are emitted retroactively after a launch
+  completes (serve/server.py), so the sampler merges closed intervals
+  rather than counting open spans. Free when off: the tap list is
+  empty unless a sampler is started, and the tracer's write path
+  checks it with one truthiness test.
+- **Anomaly sentinel** (``AnomalySentinel``): EWMA + MAD per
+  (signature, metric) over windowed request rate, windowed mean
+  latency, cumulative p99, and roofline fraction. Robust scale
+  (1.4826 x MAD, floored at ``rel_floor`` x baseline) keeps the score
+  dimensionless; the baseline is frozen while a window scores
+  anomalous so an outburst cannot poison its own reference; a finding
+  needs ``sustain`` consecutive anomalous windows; zero-traffic
+  windows are no evidence (the BurnWindow convention). Findings land
+  in the ControlPlane decision log beside burn (control/plane.py
+  ``sentinel=``).
+
+Everything here is host-side Python over the metrics registry and the
+span feed; nothing touches a traced value.
+"""
+
+from __future__ import annotations
+
+import collections
+import json
+import logging
+import os
+import threading
+import time
+from typing import Optional
+
+log = logging.getLogger("heat2d_tpu.obs")
+
+PERF_SCHEMA = "heat2d-tpu/cost-card/v1"
+
+#: extraction failure placeholder cached in the card book so a runner
+#: that cannot be lowered is probed once, not per launch
+_FAILED = object()
+
+
+def extract_cost_card(runner, args, *, meta: dict,
+                      registry=None) -> Optional[dict]:
+    """One cost card from XLA's compile-time analyses.
+
+    ``runner`` is a jit callable (or an object carrying one as
+    ``.jitted`` — the mesh/spatial runners); ``args`` the launch
+    operands (concrete arrays or ShapeDtypeStructs — only avals
+    matter). Lowering retraces the SAME function the launch calls, so
+    the traced program is byte-identical whether extraction runs or
+    not (the jaxpr pin), and jax's compile cache absorbs most of the
+    cost. Returns None (never raises) when the backend/runner offers
+    no analysis — counted as ``perf_card_failures_total{stage}``.
+    """
+    def _fail(stage: str, err) -> None:
+        if registry is not None:
+            registry.counter("perf_card_failures_total", stage=stage)
+        log.debug("cost-card extraction failed at %s: %s", stage, err)
+
+    import jax
+
+    target = getattr(runner, "jitted", runner)
+    if not hasattr(target, "lower"):
+        _fail("no-lower", type(target).__name__)
+        return None
+    try:
+        compiled = target.lower(*args).compile()
+    except Exception as e:  # noqa: BLE001 — observability must not throw
+        _fail("compile", e)
+        return None
+    try:
+        ca = compiled.cost_analysis()
+        if isinstance(ca, (list, tuple)):
+            ca = ca[0] if ca else {}
+        ca = ca or {}
+    except Exception as e:  # noqa: BLE001
+        _fail("cost-analysis", e)
+        ca = {}
+    try:
+        ma = compiled.memory_analysis()
+    except Exception as e:  # noqa: BLE001
+        _fail("memory-analysis", e)
+        ma = None
+
+    def _mem(field: str) -> int:
+        return int(getattr(ma, field, 0) or 0)
+
+    flops = float(ca.get("flops", 0.0) or 0.0)
+    bytes_accessed = float(ca.get("bytes accessed", 0.0) or 0.0)
+    arg_b = _mem("argument_size_in_bytes")
+    out_b = _mem("output_size_in_bytes")
+    tmp_b = _mem("temp_size_in_bytes")
+    card = {
+        "schema": PERF_SCHEMA,
+        **meta,
+        "backend": jax.default_backend(),
+        "flops": flops,
+        "bytes_accessed": bytes_accessed,
+        "argument_bytes": arg_b,
+        "output_bytes": out_b,
+        "temp_bytes": tmp_b,
+        "peak_bytes": arg_b + out_b + tmp_b,
+        "generated_code_bytes": _mem("generated_code_size_in_bytes"),
+        "arithmetic_intensity": (round(flops / bytes_accessed, 4)
+                                 if bytes_accessed > 0 else None),
+    }
+    try:
+        card["device_kind"] = jax.devices()[0].device_kind
+    except Exception:  # noqa: BLE001
+        card["device_kind"] = None
+    nx, ny = meta.get("nx"), meta.get("ny")
+    batch = int(meta.get("capacity") or meta.get("batch") or 1)
+    if nx and ny:
+        from heat2d_tpu.obs import roofline
+        bb = roofline.boundary_bytes(
+            nx, ny, batch=batch,
+            dtype=meta.get("dtype", "float32"),
+            convergence=bool(meta.get("convergence", False)))
+        measured = arg_b + out_b
+        m = roofline.analytic_bytes_per_cell_step(
+            nx, ny, method=meta.get("method", "auto"),
+            dtype=meta.get("dtype", "float32"))
+        card["model"] = {
+            "boundary_bytes": bb["total_bytes"],
+            "measured_boundary_bytes": measured,
+            "boundary_agreement_pct": (
+                round(100.0 * measured / bb["total_bytes"], 2)
+                if bb["total_bytes"] else None),
+            "bytes_per_cell_step": round(m["bytes_per_cell_step"], 4),
+            "route": m["route"],
+            "coarse": m["coarse"],
+            # loop bodies are counted once by XLA, so this is op-level
+            # bytes per cell per BODY application (2b = perfectly fused
+            # stream; CPU lowering sits well above it)
+            "hlo_bytes_per_cell": (
+                round(bytes_accessed / (batch * nx * ny), 3)
+                if bytes_accessed > 0 else None),
+        }
+    return card
+
+
+class PerfObserver:
+    """The card book: dedup-by-key cost-card extraction at first
+    launch, optional JSONL persistence beside the trace spans
+    (``cost-cards-<service>-<pid>.jsonl``, the file heat2d-tpu-trace
+    joins on), ``perf_cost_cards_total`` accounting."""
+
+    def __init__(self, registry=None, dir: Optional[str] = None,
+                 service: str = "perf"):
+        self.registry = registry
+        self.dir = dir
+        self.service = service
+        self._lock = threading.Lock()
+        self._cards: dict = {}          # key -> card dict | _FAILED
+        self._file = None
+        if dir:
+            os.makedirs(dir, exist_ok=True)
+            self._path = os.path.join(
+                dir, f"cost-cards-{service}-{os.getpid()}.jsonl")
+        else:
+            self._path = None
+
+    @staticmethod
+    def _key(meta: dict) -> tuple:
+        return (meta.get("signature"), meta.get("capacity"),
+                meta.get("route"))
+
+    def observe(self, runner, args, meta: dict) -> Optional[dict]:
+        """Card for (signature, capacity, route): cached after the
+        first extraction, including cached failure — a launch path
+        never pays the probe twice."""
+        key = self._key(meta)
+        with self._lock:
+            hit = self._cards.get(key)
+        if hit is not None:
+            return None if hit is _FAILED else hit
+        card = extract_cost_card(runner, args, meta=meta,
+                                 registry=self.registry)
+        with self._lock:
+            # double-checked: a racing launch may have filled the slot
+            hit = self._cards.get(key)
+            if hit is not None:
+                return None if hit is _FAILED else hit
+            self._cards[key] = card if card is not None else _FAILED
+        if card is None:
+            return None
+        if self.registry is not None:
+            self.registry.counter("perf_cost_cards_total",
+                                  route=str(card.get("route")
+                                            or meta.get("route")
+                                            or "batch"))
+        self._persist(card)
+        return card
+
+    def card_for(self, signature, capacity=None,
+                 route=None) -> Optional[dict]:
+        with self._lock:
+            hit = self._cards.get((signature, capacity, route))
+        return None if hit is None or hit is _FAILED else hit
+
+    def cards(self) -> list:
+        with self._lock:
+            return [c for c in self._cards.values()
+                    if c is not _FAILED]
+
+    def snapshot(self) -> dict:
+        return {"schema": PERF_SCHEMA, "cards": self.cards()}
+
+    def _persist(self, card: dict) -> None:
+        if self._path is None:
+            return
+        line = json.dumps(card) + "\n"
+        with self._lock:
+            if self._file is None:
+                self._file = open(self._path, "a", encoding="utf-8")
+            self._file.write(line)
+            self._file.flush()
+
+    def close(self) -> None:
+        with self._lock:
+            if self._file is not None:
+                self._file.close()
+                self._file = None
+
+
+# -- module-level arming (the tracing.install pattern) ----------------- #
+
+_lock = threading.Lock()
+_observer: Optional[PerfObserver] = None
+_env_checked = False
+
+
+def install(obs: PerfObserver) -> None:
+    global _observer
+    with _lock:
+        _observer = obs
+
+
+def uninstall() -> None:
+    global _observer, _env_checked
+    with _lock:
+        if _observer is not None:
+            _observer.close()
+        _observer = None
+        _env_checked = True     # an explicit uninstall wins over env
+
+
+def activate_from_env() -> None:
+    """Arm from ``HEAT2D_PERF_DIR`` (cards persisted there) or
+    ``HEAT2D_PERF=1`` (in-memory book only) — once per process, like
+    ``tracing.activate_from_env``."""
+    global _env_checked, _observer
+    with _lock:
+        if _env_checked or _observer is not None:
+            return
+        _env_checked = True
+        d = os.environ.get("HEAT2D_PERF_DIR")
+        if not d and os.environ.get("HEAT2D_PERF") != "1":
+            return
+        from heat2d_tpu.obs.metrics import get_registry
+        _observer = PerfObserver(registry=get_registry(),
+                                 dir=d or None, service="env")
+
+
+def enabled() -> bool:
+    activate_from_env()
+    return _observer is not None
+
+
+def observer() -> Optional[PerfObserver]:
+    activate_from_env()
+    return _observer
+
+
+def observe_launch(runner, args, *, meta: dict) -> Optional[dict]:
+    """The launch-path hook: no-op (None) when no observer is armed."""
+    obs = observer()
+    if obs is None:
+        return None
+    return obs.observe(runner, args, meta)
+
+
+def card_for(signature, capacity=None, route=None) -> Optional[dict]:
+    obs = observer()
+    if obs is None:
+        return None
+    return obs.card_for(signature, capacity, route)
+
+
+# -- duty-cycle sampler ------------------------------------------------ #
+
+class DutyCycleSampler:
+    """Launch-occupancy duty cycle per (service, pid) lane from the
+    tracer's span feed.
+
+    Wire it with ``tracing.add_span_tap(sampler.feed)`` and
+    ``sampler.start()``. ``feed`` runs on whatever thread emits a span
+    — it does ONE kind check and a deque append under the lock.
+    Serve launch spans carry epoch t0/t1 and are emitted after the
+    launch completes, so each ``_sample`` merges the closed intervals
+    that overlap the trailing window (plus any still-open
+    ``span_start``) into per-lane busy time / window. Exported as
+    ``perf_duty_cycle{lane=...}`` + ``perf_duty_samples_total``."""
+
+    def __init__(self, registry=None, *, window_s: float = 2.0,
+                 interval_s: float = 0.25,
+                 span_kinds: tuple = ("launch",)):
+        self.registry = registry
+        self.window_s = float(window_s)
+        self.interval_s = float(interval_s)
+        self._kinds = frozenset(span_kinds)
+        self._lock = threading.Lock()
+        self._closed: collections.deque = collections.deque()
+        self._open: dict = {}           # span_id -> (t0, lane)
+        self._duty: dict = {}           # lane -> last sampled duty
+        self.samples = 0
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # the tracer tap — hot-ish path, keep tiny
+    def feed(self, rec: dict) -> None:
+        if rec.get("kind") not in self._kinds:
+            return
+        lane = f"{rec.get('service', '?')}:{rec.get('pid', 0)}"
+        ev = rec.get("event")
+        with self._lock:
+            if ev == "span":
+                self._open.pop(rec.get("span_id"), None)
+                self._closed.append(
+                    (float(rec["t0"]), float(rec["t1"]), lane))
+            elif ev == "span_start":
+                self._open[rec.get("span_id")] = (
+                    float(rec["t0"]), lane)
+
+    def _sample(self, now: Optional[float] = None) -> dict:
+        # spans carry epoch timestamps (tracing.Tracer.epoch_of)
+        now = time.time() if now is None else now
+        lo = now - self.window_s
+        with self._lock:
+            while self._closed and self._closed[0][1] < lo:
+                self._closed.popleft()
+            spans = list(self._closed)
+            spans.extend((t0, now, lane)
+                         for t0, lane in self._open.values())
+        by_lane: dict = {}
+        for t0, t1, lane in spans:
+            a, b = max(t0, lo), min(t1, now)
+            if b > a:
+                by_lane.setdefault(lane, []).append((a, b))
+        duty = {}
+        for lane, ivals in by_lane.items():
+            ivals.sort()
+            busy, cur0, cur1 = 0.0, ivals[0][0], ivals[0][1]
+            for a, b in ivals[1:]:
+                if a > cur1:
+                    busy += cur1 - cur0
+                    cur0, cur1 = a, b
+                else:
+                    cur1 = max(cur1, b)
+            busy += cur1 - cur0
+            duty[lane] = min(1.0, busy / self.window_s)
+        # lanes that went idle decay to 0 instead of holding stale duty
+        for lane in self._duty:
+            duty.setdefault(lane, 0.0)
+        self._duty = duty
+        self.samples += 1
+        if self.registry is not None:
+            self.registry.counter("perf_duty_samples_total")
+            for lane, d in duty.items():
+                self.registry.gauge("perf_duty_cycle", d, lane=lane)
+        return duty
+
+    def duty(self, lane: Optional[str] = None):
+        if lane is None:
+            return dict(self._duty)
+        return self._duty.get(lane, 0.0)
+
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self._stop.clear()
+
+        def _loop() -> None:
+            while not self._stop.wait(self.interval_s):
+                self._sample()
+
+        self._thread = threading.Thread(
+            target=_loop, name="heat2d-perf-duty", daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        t, self._thread = self._thread, None
+        if t is not None:
+            t.join(timeout=2.0)
+
+    def snapshot(self) -> dict:
+        return {"duty": dict(self._duty), "samples": self.samples,
+                "window_s": self.window_s}
+
+
+# -- anomaly sentinel -------------------------------------------------- #
+
+class AnomalySentinel:
+    """EWMA + MAD change detection per (signature, metric).
+
+    Metrics per tick (each skipped when unobservable, and a
+    zero-traffic window contributes NO evidence — the BurnWindow
+    convention, so a drained queue never reads as a regression):
+
+    - ``rate_rps``       windowed request rate (CounterDeltas over
+                         ``serve_signature_requests_total``); DOWN bad.
+    - ``latency_mean_s`` windowed mean latency (sum/count deltas of
+                         ``serve_signature_latency_s`` — exact, and
+                         immune to the cumulative reservoir's
+                         first-compile spike); UP bad.
+    - ``p99_s``          the cumulative tail of the same histogram
+                         (Dean & Barroso's number); UP bad.
+    - ``roofline_pct``   latest ``perf_pct_of_bound`` gauge (absent
+                         off-accelerator); DOWN bad.
+
+    Score = bad-direction deviation / robust scale, with scale =
+    max(1.4826 x MAD over recent history, ``rel_floor`` x |EWMA|).
+    The baseline is NOT updated by a window that scores anomalous
+    (outbursts must not become their own reference); a finding fires
+    after ``sustain`` consecutive anomalous windows, once per episode.
+    Defaults (k=5, rel_floor=0.5, sustain=2, warmup=3) flag a
+    sustained >250% deviation — conservative enough for a zero-false-
+    positive healthy soak, and a seeded ``--chaos-slow`` 25x latency
+    regression scores ~48 (docs/OBSERVABILITY.md)."""
+
+    METRIC_DIRECTION = {"rate_rps": -1, "latency_mean_s": +1,
+                        "p99_s": +1, "roofline_pct": -1}
+
+    def __init__(self, *, alpha: float = 0.3, k: float = 5.0,
+                 rel_floor: float = 0.5, sustain: int = 2,
+                 warmup: int = 3, history: int = 64,
+                 clock=time.monotonic):
+        from heat2d_tpu.obs.metrics import CounterDeltas
+        self.alpha, self.k = alpha, k
+        self.rel_floor, self.sustain = rel_floor, sustain
+        self.warmup, self.history = warmup, history
+        self._clock = clock
+        self._deltas = CounterDeltas()
+        self._hist_last: dict = {}      # sig -> (sum, count)
+        self._state: dict = {}          # (sig, metric) -> state dict
+        self._last_t: Optional[float] = None
+        self.findings: list = []
+
+    @staticmethod
+    def _sig(label_pairs: tuple) -> Optional[str]:
+        return dict(label_pairs).get("signature")
+
+    def tick(self, registry) -> list:
+        """Evaluate one window; returns NEW findings (also appended to
+        ``self.findings``). Call at a steady cadence (the ControlPlane
+        tick)."""
+        now = self._clock()
+        dt = (now - self._last_t) if self._last_t is not None else None
+        self._last_t = now
+
+        per_sig: dict = {}
+        for labels, d in self._deltas.tick(
+                registry, "serve_signature_requests_total").items():
+            sig = self._sig(labels)
+            if sig is not None:
+                per_sig[sig] = per_sig.get(sig, 0.0) + d
+        lat = {self._sig(k): v for k, v in registry.find_histograms(
+            "serve_signature_latency_s").items()}
+        frac = {self._sig(k): v for k, v in registry.find_gauges(
+            "perf_pct_of_bound").items()}
+
+        out = []
+        for sig, d in per_sig.items():
+            if d <= 0 or dt is None or dt <= 0:
+                continue            # zero traffic / first tick: no window
+            obs = {"rate_rps": d / dt}
+            summ = lat.get(sig)
+            if summ is not None:
+                s, c = float(summ["sum"]), float(summ["count"])
+                ps, pc = self._hist_last.get(sig, (0.0, 0.0))
+                self._hist_last[sig] = (s, c)
+                if c > pc:
+                    obs["latency_mean_s"] = (s - ps) / (c - pc)
+                p99 = summ.get("p99")
+                if p99 == p99:      # not NaN
+                    obs["p99_s"] = float(p99)
+            f = frac.get(sig)
+            if f is not None:
+                obs["roofline_pct"] = float(f)
+            for metric, x in obs.items():
+                finding = self._observe(sig, metric, x, registry)
+                if finding is not None:
+                    out.append(finding)
+        self.findings.extend(out)
+        return out
+
+    def _observe(self, sig: str, metric: str, x: float,
+                 registry) -> Optional[dict]:
+        st = self._state.setdefault((sig, metric), {
+            "ewma": None, "hist": collections.deque(
+                maxlen=self.history), "n": 0, "streak": 0,
+            "flagged": False})
+        finding = None
+        anomalous = False
+        if st["n"] >= self.warmup and st["ewma"] is not None:
+            hist = sorted(st["hist"])
+            med = hist[len(hist) // 2]
+            mad = sorted(abs(v - med) for v in hist)[len(hist) // 2]
+            scale = max(1.4826 * mad,
+                        self.rel_floor * max(abs(st["ewma"]), 1e-9))
+            score = (self.METRIC_DIRECTION[metric] * (x - st["ewma"])
+                     / scale)
+            if registry is not None:
+                registry.gauge("perf_anomaly_score", score,
+                               signature=sig, metric=metric)
+            anomalous = score >= self.k
+            if anomalous:
+                st["streak"] += 1
+                if st["streak"] >= self.sustain and not st["flagged"]:
+                    st["flagged"] = True
+                    finding = {
+                        "signature": sig, "metric": metric,
+                        "value": round(x, 6),
+                        "baseline": round(st["ewma"], 6),
+                        "score": round(score, 2),
+                        "windows": st["streak"],
+                    }
+                    if registry is not None:
+                        registry.counter("perf_anomalies_total",
+                                         metric=metric)
+            else:
+                st["streak"] = 0
+                st["flagged"] = False
+        if not anomalous:
+            # baseline adapts only on windows it would accept
+            st["ewma"] = (x if st["ewma"] is None else
+                          self.alpha * x + (1 - self.alpha)
+                          * st["ewma"])
+            st["hist"].append(x)
+            st["n"] += 1
+        return finding

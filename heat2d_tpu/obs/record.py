@@ -63,10 +63,16 @@ RECORD_SCHEMA = "heat2d-tpu/run-record/v1"
 #: hung collective) with the MEASURED detection + recovery seconds,
 #: bitwise-parity verdict vs the single-chip oracle, quarantine set,
 #: and the no-quarantined-serving invariant —
-#: docs/RESILIENCE.md failure model).
+#: docs/RESILIENCE.md failure model), "perf" (heat2d-tpu-perf: the
+#: performance observatory — per-program cost cards (XLA compile-time
+#: FLOPs / bytes-accessed / argument+output+temp sizes cross-checked
+#: against the analytic roofline models), roofline rows per signature
+#: (achieved vs bound Mcells/s, bytes/cell-step, Mcells-per-HBM-byte),
+#: duty-cycle summary, and the anomaly sentinel's findings beside the
+#: soak verdict — heat2d_tpu/obs/perf.py, docs/OBSERVABILITY.md).
 RECORD_KINDS = ("run", "ensemble", "bench", "sweep", "serve", "tune",
                 "fleet", "inverse", "multichip", "load", "control",
-                "mesh_chaos")
+                "mesh_chaos", "perf")
 
 
 def run_context() -> dict:

@@ -1,0 +1,254 @@
+"""Roofline ledger — the calibrated bandwidth bound as a package API.
+
+``calibrated_bound_mcells`` used to live in ``bench.py``: computed once,
+offline, for the headline record, and unreachable from the serving
+stack. This module promotes it (bench.py imports it back) and
+generalizes the accounting per (shape, route, dtype, device kind) in the
+Williams-et-al roofline frame (PAPERS.md):
+
+- **analytic bytes/cell-step** — what the route's memory structure says
+  one cell-update *must* move through HBM (VMEM-resident amortization,
+  band halo re-reads, per-step jnp streaming). This is the denominator
+  of ROADMAP item 2's headline metric: bf16 storage or deeper temporal
+  blocking is honest exactly when it shrinks this number.
+- **mcells per HBM byte** — the reciprocal efficiency (structural, not
+  measured: independent of clock speed, so a dtype/k knob can be judged
+  before any wall-clock run).
+- **roofline bound** — the tune_bands.md structural ceiling
+  (VPU calibration x band halo-recompute factor), now honest about its
+  validity domain: calibrations are keyed per device kind and dtype,
+  and uncovered combinations return None instead of a guess.
+- **launch stamping** — ``stamp_launch_row`` turns (cells, steps,
+  elapsed) into achieved-vs-bound Mcells/s on every serve/mesh launch
+  row and exports the ``perf_*`` gauge families.
+
+Pure host-side arithmetic: nothing here touches a traced value, and the
+planner calls go through the same ``ops.pallas_stencil`` entry points
+the solver routes through, so the models track the actual kernel
+configuration (docs/OBSERVABILITY.md "Performance observatory").
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+#: dtype name -> element bytes for the storage models. Keyed by the
+#: canonical names the request schema uses (serve/schema.py).
+ITEMSIZE = {"float32": 4, "f32": 4, "bfloat16": 2, "bf16": 2,
+            "float16": 2, "float64": 8}
+
+#: Resident-kernel VPU calibration by row width (tune_bands.md round 4):
+#: pure-VPU Mcells/s of the FMA step form with no HBM streaming or
+#: strips — the numerator of the structural ceiling. Measured at
+#: float32 on the tuned chip; ``_CALIB_TABLES`` keys the validity
+#: domain explicitly.
+VPU_CALIB_MCELLS = {512: 257_000.0, 1024: 254_000.0, 2048: 252_000.0,
+                    4096: 248_000.0}
+
+#: (device_kind, dtype) -> row-width calibration table. ``None`` device
+#: kind means "the chip class tune_bands.md calibrated" (the default
+#: accelerator this tree is tuned on); a deployment on new silicon adds
+#: a row here after re-running the calibration, it does NOT inherit
+#: another chip's numbers. float32-only today: bf16 compute changes the
+#: VPU issue rate, so a bf16 bound requires its own calibration pass
+#: (ROADMAP item 2) — until then the bound is honestly absent.
+_CALIB_TABLES: dict = {(None, "float32"): VPU_CALIB_MCELLS}
+
+
+def _itemsize(dtype: str) -> int:
+    try:
+        return ITEMSIZE[str(dtype)]
+    except KeyError:
+        raise ValueError(f"no itemsize for dtype {dtype!r}") from None
+
+
+def resolve_route(nx: int, ny: int, method: str = "auto") -> str:
+    """The memory-structure route a (shape, method) actually executes:
+    ``jnp`` | ``pallas`` (VMEM-resident) | ``band`` (HBM-streamed
+    bands/window) | ``adi`` | ``mg``. Resolved through the SAME
+    dispatch the runners use (``ensemble._pick_method`` +
+    ``ps.fits_vmem``), so the analytic model below describes the
+    program that compiles, not the method string the caller typed."""
+    if method in ("adi", "mg", "jnp"):
+        return method
+    from heat2d_tpu.models import ensemble
+    from heat2d_tpu.ops import pallas_stencil as ps
+    m = ensemble._pick_method(method, nx, ny)
+    if m == "pallas" and not ps.fits_vmem((nx, ny)):
+        m = "band"
+    return m
+
+
+def analytic_bytes_per_cell_step(nx: int, ny: int, *,
+                                 method: str = "auto",
+                                 dtype: str = "float32") -> dict:
+    """HBM bytes one cell-update must move, per route.
+
+    Returns ``{"bytes_per_cell_step", "route", "model", "coarse"}``.
+    ``coarse`` marks the implicit routes whose constant is a pass-count
+    estimate (documented wide tolerance) rather than a streaming plan:
+
+    - ``jnp``:    read u + write u each step -> ``2b`` (XLA fuses the
+                  5-point stencil; coefficient rows are O(1/nx)).
+    - ``pallas``: grid VMEM-resident across a ``DEFAULT_TSTEPS`` block
+                  -> ``2b/T`` (load once, store once, T steps free).
+    - ``band``:   per T-step block each band of ``bm`` rows is read
+                  with its 2T halo rows and written back ->
+                  ``b*(1 + (bm+2T)/bm)/T`` with bm from the same
+                  panel/window planner the kernel uses.
+    - ``adi``:    two directional sweeps per step, each building a RHS
+                  and running the Thomas forward+back passes -> ~``8b``
+                  (coarse).
+    - ``mg``:     smoothing + residual + transfer over the level
+                  hierarchy (4/3 geometric factor) -> ~``16b``
+                  (coarse).
+    """
+    b = _itemsize(dtype)
+    route = resolve_route(nx, ny, method)
+    if route == "jnp":
+        return {"bytes_per_cell_step": 2.0 * b, "route": route,
+                "model": "2b stream", "coarse": False}
+    if route == "adi":
+        return {"bytes_per_cell_step": 8.0 * b, "route": route,
+                "model": "~8b (2 sweeps x rhs+thomas)", "coarse": True}
+    if route == "mg":
+        return {"bytes_per_cell_step": 16.0 * b, "route": route,
+                "model": "~16b (V-cycle passes x 4/3)", "coarse": True}
+    from heat2d_tpu.ops import pallas_stencil as ps
+    t = ps.DEFAULT_TSTEPS
+    if route == "pallas":
+        return {"bytes_per_cell_step": 2.0 * b / t, "route": route,
+                "model": f"2b/T resident, T={t}", "coarse": False}
+    # band / streaming window: same planners as calibrated_bound_mcells
+    p, bm = ps.plan_panels(nx, ny, t)
+    if p == 1:
+        bm, _ = ps.plan_window_band(nx, ny, t)
+    bpcs = b * (1.0 + (bm + 2 * t) / bm) / t
+    return {"bytes_per_cell_step": bpcs, "route": "band",
+            "model": f"band bm={bm}, T={t}", "coarse": False}
+
+
+def mcells_per_hbm_byte(nx: int, ny: int, *, method: str = "auto",
+                        dtype: str = "float32") -> float:
+    """ROADMAP item 2's headline efficiency: cell-updates (in Mcells)
+    bought per HBM byte moved. Structural — the reciprocal of the
+    analytic bytes/cell-step, so bf16 storage doubling it (or temporal
+    blocking k-folding it) shows up before any wall-clock run."""
+    m = analytic_bytes_per_cell_step(nx, ny, method=method, dtype=dtype)
+    return 1.0 / (1e6 * m["bytes_per_cell_step"])
+
+
+def boundary_bytes(nx: int, ny: int, *, batch: int = 1,
+                   dtype: str = "float32",
+                   convergence: bool = False) -> dict:
+    """Program-boundary traffic model: bytes a runner's arguments and
+    results occupy (u0 + per-member cx/cy in; u out, + steps counters
+    for convergence). This is what XLA's ``memory_analysis`` reports
+    as argument/output sizes — the cross-check anchor for cost cards
+    (exact on every backend, unlike op-level 'bytes accessed', which
+    CPU lowering inflates with unfused intermediates)."""
+    b = _itemsize(dtype)
+    arg = batch * nx * ny * b + 2 * batch * b        # u0, cxs, cys
+    out = batch * nx * ny * b + (4 * batch if convergence else 0)
+    return {"argument_bytes": arg, "output_bytes": out,
+            "total_bytes": arg + out}
+
+
+def calibrated_bound_mcells(nx: int, ny: int, dtype: str = "float32",
+                            device_kind: Optional[str] = None):
+    """Structural ceiling for the streaming window route at this shape:
+    VPU calibration at the route's row width x bm/(bm+2T) (the band
+    halo-recompute factor — the tune_bands.md methodology). None when
+    the shape is VMEM-resident (no streaming structure), the width is
+    uncalibrated, or the (device kind, dtype) combination has no
+    calibration table — an absent bound, never a guessed one. Uses the
+    same planners the solver routes through, so the bound tracks the
+    actual kernel configuration."""
+    table = _CALIB_TABLES.get((device_kind, str(dtype)))
+    if table is None:
+        return None
+    import heat2d_tpu.ops.pallas_stencil as ps
+
+    if ps.fits_vmem((nx, ny)):
+        return None
+    t = ps.DEFAULT_TSTEPS
+    p, bm = ps.plan_panels(nx, ny, t)
+    nyp = ny // p
+    if p == 1:
+        bm, _ = ps.plan_window_band(nx, ny, t)
+    calib = table.get(nyp)
+    if calib is None:
+        return None
+    return calib * bm / (bm + 2 * t)
+
+
+def roofline_bound(nx: int, ny: int, *, method: str = "auto",
+                   dtype: str = "float32",
+                   device_kind: Optional[str] = None):
+    """The bound generalized per (shape, route, dtype, device kind):
+    ``{"bound_mcells_per_s", "route", "source"}`` or None where no
+    honest ceiling exists (non-streaming routes, uncalibrated widths,
+    uncalibrated device/dtype). Today only the band/window route on
+    the calibrated chip class at float32 has a number — exactly the
+    domain tune_bands.md measured."""
+    route = resolve_route(nx, ny, method)
+    if route != "band":
+        return None
+    bound = calibrated_bound_mcells(nx, ny, dtype, device_kind)
+    if bound is None:
+        return None
+    return {"bound_mcells_per_s": bound, "route": route,
+            "source": "vpu-calib x bm/(bm+2T)"}
+
+
+def stamp_launch_row(row: dict, registry=None, *, nx: int, ny: int,
+                     steps: float, members: int, elapsed_s: float,
+                     method: str = "auto", dtype: str = "float32",
+                     signature: Optional[str] = None,
+                     card: Optional[dict] = None) -> dict:
+    """Stamp one launch's roofline accounting into its launch-log row
+    (``row["perf"]``) and the ``perf_*`` gauge families.
+
+    ``steps`` may be fractional (convergence launches pass the mean
+    steps-done across members). ``elapsed_s`` is host wall time around
+    the launch — it includes dispatch + fence, so achieved Mcells/s is
+    a floor, and a first launch's compile shows up as a collapsed
+    figure (the row's ``first_launch`` flag disambiguates). Cheap host
+    math on every launch; ``card`` (a cost card, when the perf
+    observer is armed) contributes measured arithmetic intensity."""
+    cells = float(members) * nx * ny
+    achieved = (cells * steps / elapsed_s / 1e6
+                if elapsed_s > 0 else 0.0)
+    m = analytic_bytes_per_cell_step(nx, ny, method=method, dtype=dtype)
+    bound = roofline_bound(nx, ny, method=method, dtype=dtype)
+    perf = {
+        "achieved_mcells_per_s": round(achieved, 3),
+        "bound_mcells_per_s": (round(bound["bound_mcells_per_s"], 1)
+                               if bound else None),
+        "pct_of_bound": (round(100.0 * achieved
+                               / bound["bound_mcells_per_s"], 2)
+                         if bound else None),
+        "bytes_per_cell_step": round(m["bytes_per_cell_step"], 4),
+        "mcells_per_hbm_byte": round(
+            1.0 / (1e6 * m["bytes_per_cell_step"]), 9),
+        "route": m["route"],
+        "elapsed_s": round(float(elapsed_s), 6),
+    }
+    if card is not None and card.get("arithmetic_intensity") is not None:
+        perf["arithmetic_intensity"] = card["arithmetic_intensity"]
+    row["perf"] = perf
+    if registry is not None:
+        sig = signature if signature is not None else str(
+            row.get("signature"))
+        registry.counter("perf_launches_stamped_total")
+        registry.gauge("perf_achieved_mcells_per_s", achieved,
+                       signature=sig)
+        registry.gauge("perf_bytes_per_cell_step",
+                       m["bytes_per_cell_step"], signature=sig)
+        if bound is not None:
+            registry.gauge("perf_pct_of_bound", perf["pct_of_bound"],
+                           signature=sig)
+        if perf.get("arithmetic_intensity") is not None:
+            registry.gauge("perf_arithmetic_intensity",
+                           perf["arithmetic_intensity"], signature=sig)
+    return perf

@@ -247,13 +247,45 @@ def _seg_quantile(sorted_vals: list, q: float) -> float:
     return quantile(sorted_vals, q)    # the registry's one convention
 
 
-def segment_stats(report: dict) -> dict:
+def load_cost_cards(trace_dir: str) -> dict:
+    """{signature string: cost card} from the ``cost-cards-*.jsonl``
+    sidecars a ``--perf`` serve run leaves beside its span files
+    (obs/perf.PerfObserver). First card per signature wins — capacity
+    rungs of one signature share the per-program shape figures the
+    stats table renders. Torn-line tolerant like the span reader."""
+    cards: dict = {}
+    for path in sorted(glob.glob(os.path.join(trace_dir,
+                                              "cost-cards-*.jsonl"))):
+        with open(path, errors="replace") as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    continue
+                sig = rec.get("signature")
+                if sig:
+                    cards.setdefault(sig, rec)
+    return cards
+
+
+def segment_stats(report: dict, cards: dict = None) -> dict:
     """Per-segment distribution over every trace in a merged report:
     {segment: {count, mean, p50, p99, max, total}} across the
     per-trace critical-path breakdowns. The aggregate view of where
     requests spend time — what the load subsystem's replay rides on
     (load/replay.py consumes the same ``load_dir``/``assemble``
-    parser) and what ``--stats`` renders."""
+    parser) and what ``--stats`` renders.
+
+    With ``cards`` (``load_cost_cards``), the program-executing
+    segments (compile/launch) additionally carry ``hbm_bytes`` and
+    ``arith_intensity`` — the XLA cost-card figures of the programs
+    those spans ran, joined per trace through the root span's
+    signature (mean over the traces a card matched). Program-level
+    properties, not span sums: one launch's bytes, not bytes x spans.
+    """
     out = {}
     rows = report.get("traces", [])
     for seg in SEGMENTS + ("total",):
@@ -267,22 +299,44 @@ def segment_stats(report: dict) -> dict:
             "max": round(vals[-1], 6) if n else 0.0,
             "total": round(sum(vals), 6),
         }
+    if cards:
+        matched = [cards[r["signature"]] for r in rows
+                   if r.get("signature") in cards]
+        byt = [c["bytes_accessed"] for c in matched
+               if c.get("bytes_accessed")]
+        ai = [c["arithmetic_intensity"] for c in matched
+              if c.get("arithmetic_intensity") is not None]
+        for seg in ("compile", "launch"):
+            if byt:
+                out[seg]["hbm_bytes"] = round(sum(byt) / len(byt), 1)
+            if ai:
+                out[seg]["arith_intensity"] = round(
+                    sum(ai) / len(ai), 4)
     return out
 
 
-def stats_markdown(report: dict) -> str:
-    stats = segment_stats(report)
+def stats_markdown(report: dict, cards: dict = None) -> str:
+    stats = segment_stats(report, cards=cards)
+    has_cards = any("hbm_bytes" in stats[seg] for seg in SEGMENTS)
     n = len(report.get("traces", []))
     lines = [
         f"# Segment statistics — {report['dir']} ({n} trace(s))", "",
-        "| segment | mean | p50 | p99 | max | total (s) |",
-        "|---|---|---|---|---|---|",
+        "| segment | mean | p50 | p99 | max | total (s) |"
+        + (" hbm bytes | arith int |" if has_cards else ""),
+        "|---|---|---|---|---|---|"
+        + ("---|---|" if has_cards else ""),
     ]
     for seg in SEGMENTS + ("total",):
         s = stats[seg]
-        lines.append(
+        line = (
             f"| {seg} | {s['mean']:.4g} | {s['p50']:.4g} "
             f"| {s['p99']:.4g} | {s['max']:.4g} | {s['total']:.4g} |")
+        if has_cards:
+            line += (f" {s['hbm_bytes']:.4g} |"
+                     if "hbm_bytes" in s else " — |")
+            line += (f" {s['arith_intensity']:.4g} |"
+                     if "arith_intensity" in s else " — |")
+        lines.append(line)
     return "\n".join(lines) + "\n"
 
 
@@ -416,13 +470,19 @@ def main(argv=None) -> int:
               f"({len(loaded['spans'])} spans)", file=sys.stderr)
 
     if args.stats:
+        # Cost-card join (obs/perf.py): a --perf run's sidecars in the
+        # same dir stamp the compile/launch rows with program bytes +
+        # arithmetic intensity; absent sidecars, the table is as before.
+        cards = load_cost_cards(args.trace_dir)
         if args.format == "json":
             print(json.dumps({"dir": report["dir"],
                               "traces": len(report["traces"]),
-                              "segments": segment_stats(report)},
+                              "segments": segment_stats(
+                                  report, cards=cards),
+                              "cost_cards": len(cards)},
                              indent=2))
         else:
-            print(stats_markdown(report), end="")
+            print(stats_markdown(report, cards=cards), end="")
     elif args.format == "json":
         print(json.dumps(report, indent=2))
     else:

@@ -252,6 +252,15 @@ class Tracer:
     def _write(self, rec: dict) -> None:
         from heat2d_tpu.obs import flight
         flight.note_span(rec)
+        if _span_taps:
+            # live consumers (obs.perf.DutyCycleSampler): a tap must
+            # never take the emitting path down, and an empty tap list
+            # costs one truthiness check (the free-when-off contract)
+            for tap in tuple(_span_taps):
+                try:
+                    tap(rec)
+                except Exception:  # noqa: BLE001
+                    pass
         with self._lock:
             if self.sink is not None:
                 self.sink(rec)
@@ -284,8 +293,26 @@ _lock = threading.Lock()
 _tracer: Optional[Tracer] = None
 _enabled = False        # fast-path guard: False == all hooks no-op
 _env_checked = False
+#: live span consumers teed from Tracer._write (obs.perf duty-cycle
+#: sampling). Module-level so taps survive tracer swaps; empty ==
+#: zero-cost.
+_span_taps: list = []
 
 ENV_DIR = "HEAT2D_TRACE_DIR"
+
+
+def add_span_tap(fn) -> None:
+    """Tee every emitted span record to ``fn(rec)`` (host-side, called
+    on the emitting thread). Exceptions from taps are swallowed."""
+    with _lock:
+        if fn not in _span_taps:
+            _span_taps.append(fn)
+
+
+def remove_span_tap(fn) -> None:
+    with _lock:
+        if fn in _span_taps:
+            _span_taps.remove(fn)
 
 
 def install(tracer: Optional[Tracer]) -> None:

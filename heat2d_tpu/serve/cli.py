@@ -89,6 +89,13 @@ def build_parser() -> argparse.ArgumentParser:
                         "spans (admission, queue, launch) land as JSONL "
                         "in DIR; merge with heat2d-tpu-trace DIR "
                         "(docs/OBSERVABILITY.md). Free when off")
+    p.add_argument("--perf", action="store_true",
+                   help="arm the performance observatory: per-program "
+                        "cost cards (XLA cost/memory analysis at first "
+                        "launch) + the perf_* roofline families; cards "
+                        "persist beside the spans when --trace-dir is "
+                        "set and ride the run record "
+                        "(docs/OBSERVABILITY.md). Free when off")
     s2 = p.add_argument_group("SLO objectives (docs/OBSERVABILITY.md)")
     s2.add_argument("--slo-p99", type=float, default=None, metavar="S",
                     help="per-signature p99 latency target in seconds; "
@@ -329,6 +336,13 @@ def _write_metrics(args, registry, server, extra=None) -> None:
             # book, measured recovery episodes, and the
             # no-quarantined-serving invariant verdict.
             extra["mesh"]["fault"] = fault
+    from heat2d_tpu.obs import perf
+    obs = perf.observer()
+    if obs is not None:
+        # the card book rides the record (docs/OBSERVABILITY.md cost-
+        # card fields) and the JSONL sidecar is flushed closed
+        extra["perf"] = obs.snapshot()
+        perf.uninstall()
     if not args.metrics_out:
         return
     from heat2d_tpu.obs.record import build_record
@@ -340,6 +354,7 @@ def _write_metrics(args, registry, server, extra=None) -> None:
              "occupancy": row["occupancy"],
              "capacity": row["capacity"],
              "tuned_config": row.get("tuned_config"),
+             **({"perf": row["perf"]} if "perf" in row else {}),
              **({"mesh": row["mesh"]} if "mesh" in row else {})}
             for row in server.engine.launch_log],
         # Per-signature tuned-config pre-resolve (docs/TUNING.md):
@@ -388,6 +403,14 @@ def main(argv=None) -> int:
 
     from heat2d_tpu.obs import MetricsRegistry
     registry = MetricsRegistry()
+
+    if args.perf:
+        # cost cards share the trace campaign's directory when one is
+        # armed (heat2d-tpu-trace --stats joins them on signature)
+        from heat2d_tpu.obs import perf
+        perf.install(perf.PerfObserver(registry=registry,
+                                       dir=args.trace_dir,
+                                       service="serve"))
 
     if args.selftest:
         return run_selftest(args, registry)

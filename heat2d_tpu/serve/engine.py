@@ -25,6 +25,7 @@ from __future__ import annotations
 
 import contextlib
 import logging
+import time
 from typing import List, Tuple
 
 from heat2d_tpu.resil import chaos
@@ -186,6 +187,7 @@ class EnsembleEngine:
 
         timer = (self.registry.timer("serve_launch_s")
                  if self.registry is not None else contextlib.nullcontext())
+        t0 = time.monotonic()
         with timer:
             out = runner(u0, cxs, cys)
             if req0.convergence:
@@ -195,6 +197,7 @@ class EnsembleEngine:
             else:
                 u = np.asarray(out)
                 steps_done = [req0.steps] * capacity
+        elapsed = time.monotonic() - t0
 
         self.launches += 1
         # per (signature, capacity): the padded ladder compiles one
@@ -208,6 +211,26 @@ class EnsembleEngine:
                "first_launch": first_launch}
         if self.spatial_grid is not None:
             row["halo_plan"] = self.halo_plans.get(req0.signature())
+        # Roofline accounting on EVERY launch row (cheap host math);
+        # cost-card extraction only when the perf observer is armed —
+        # a dict hit after the first launch per (signature, capacity).
+        from heat2d_tpu.obs import perf, roofline
+        card = None
+        if perf.enabled():
+            card = perf.observe_launch(
+                runner, (u0, cxs, cys),
+                meta={"signature": str(req0.signature()),
+                      "nx": req0.nx, "ny": req0.ny,
+                      "steps": req0.steps, "method": req0.method,
+                      "convergence": req0.convergence,
+                      "capacity": capacity, "dtype": "float32",
+                      "route": "batch"})
+        roofline.stamp_launch_row(
+            row, self.registry, nx=req0.nx, ny=req0.ny,
+            steps=(sum(steps_done) / len(steps_done)
+                   if req0.convergence else req0.steps),
+            members=capacity, elapsed_s=elapsed, method=req0.method,
+            signature=str(req0.signature()), card=card)
         self.launch_log.append(row)
         if self.registry is not None:
             self.registry.counter("serve_launches_total")

@@ -1,0 +1,470 @@
+"""Performance observatory acceptance (docs/OBSERVABILITY.md).
+
+- roofline models: analytic bytes/cell-step per route, boundary-bytes
+  model, the relocated calibrated bound (bench.py identity).
+- cost cards: XLA boundary bytes agree with the analytic model within
+  the documented tolerance on every batch route; extraction is FREE
+  when off and jaxpr-pinned when on (solver/batch/band/mesh programs
+  byte-identical with the observer + duty sampler armed).
+- duty-cycle sampler: interval merge math on a synthetic span feed.
+- anomaly sentinel: a seeded latency regression flags within the
+  detection budget, a healthy twin stays silent, and findings land in
+  the ControlPlane decision log.
+- launch stamping: serve + mesh launch rows carry the roofline fields.
+- surfaces: RECORD_KINDS, trace --stats cost-card join, the perf CLI.
+"""
+
+import itertools
+import json
+import os
+
+import pytest
+
+from heat2d_tpu.obs import perf, roofline
+from heat2d_tpu.obs.metrics import MetricsRegistry
+from heat2d_tpu.serve.schema import SolveRequest
+from tests._pin import (assert_jaxpr_equal, band_runner_jaxpr,
+                        batch_runner_jaxpr, mesh_runner_jaxpr,
+                        solver_jaxpr)
+
+
+def reqs(n, nx=16, ny=16, steps=4, method="jnp", **kw):
+    return [SolveRequest(nx=nx, ny=ny, steps=steps, method=method,
+                         cx=0.1 + 0.01 * i, cy=0.1, **kw).validate()
+            for i in range(n)]
+
+
+# --------------------------------------------------------------------- #
+# roofline models
+# --------------------------------------------------------------------- #
+
+def test_analytic_bytes_per_cell_step_routes():
+    m = roofline.analytic_bytes_per_cell_step(64, 64, method="jnp")
+    assert m["bytes_per_cell_step"] == 8.0 and m["route"] == "jnp"
+    # bf16 storage halves the stream — the ROADMAP item 2 lever
+    m16 = roofline.analytic_bytes_per_cell_step(
+        64, 64, method="jnp", dtype="bfloat16")
+    assert m16["bytes_per_cell_step"] == 4.0
+    from heat2d_tpu.ops import pallas_stencil as ps
+    t = ps.DEFAULT_TSTEPS
+    m = roofline.analytic_bytes_per_cell_step(64, 64, method="pallas")
+    assert m["bytes_per_cell_step"] == pytest.approx(8.0 / t)
+    m = roofline.analytic_bytes_per_cell_step(4096, 4096,
+                                              method="band")
+    # band: 1 write + (bm+2T)/bm read per T steps — strictly above the
+    # resident route, strictly below plain streaming
+    assert 8.0 / t < m["bytes_per_cell_step"] < 8.0
+    for meth in ("adi", "mg"):
+        assert roofline.analytic_bytes_per_cell_step(
+            64, 64, method=meth)["coarse"]
+
+
+def test_mcells_per_hbm_byte_is_reciprocal():
+    m = roofline.analytic_bytes_per_cell_step(64, 64, method="jnp")
+    assert roofline.mcells_per_hbm_byte(64, 64, method="jnp") \
+        == pytest.approx(1.0 / (1e6 * m["bytes_per_cell_step"]))
+
+
+def test_boundary_bytes_model():
+    bb = roofline.boundary_bytes(16, 24, batch=3)
+    assert bb["argument_bytes"] == 3 * 16 * 24 * 4 + 2 * 3 * 4
+    assert bb["output_bytes"] == 3 * 16 * 24 * 4
+    conv = roofline.boundary_bytes(16, 24, batch=3, convergence=True)
+    assert conv["output_bytes"] == bb["output_bytes"] + 4 * 3
+
+
+def test_calibrated_bound_relocated_identity():
+    """The bench.py formula, verbatim: calib x bm/(bm+2T) at the
+    4096^2 window plan (tune_bands.md round 4)."""
+    import bench
+    assert bench.calibrated_bound_mcells is roofline.calibrated_bound_mcells
+    assert bench.VPU_CALIB_MCELLS is roofline.VPU_CALIB_MCELLS
+    from heat2d_tpu.ops import pallas_stencil as ps
+    t = ps.DEFAULT_TSTEPS
+    bm, _ = ps.plan_window_band(4096, 4096, t)
+    want = roofline.VPU_CALIB_MCELLS[4096] * bm / (bm + 2 * t)
+    assert roofline.calibrated_bound_mcells(4096, 4096) \
+        == pytest.approx(want)
+
+
+def test_calibrated_bound_honest_absences():
+    # VMEM-resident: no streaming structure to bound
+    assert roofline.calibrated_bound_mcells(64, 64) is None
+    # uncalibrated dtype / device kind: absent, never a guess
+    assert roofline.calibrated_bound_mcells(4096, 4096,
+                                            dtype="bfloat16") is None
+    assert roofline.calibrated_bound_mcells(
+        4096, 4096, device_kind="TPU v9000") is None
+    assert roofline.roofline_bound(64, 64, method="jnp") is None
+
+
+def test_bench_record_carries_efficiency_rows():
+    import bench
+    rec = bench.build_record(100.0, "two-point", 1.0, nx=64, ny=64,
+                             steps=8, mode="jnp")
+    assert rec["bytes_per_cell_step"] == 8.0
+    assert rec["mcells_per_hbm_byte"] == pytest.approx(1 / 8e6,
+                                                       rel=1e-3)
+
+
+# --------------------------------------------------------------------- #
+# cost cards
+# --------------------------------------------------------------------- #
+
+def _card(nx, ny, steps, method, batch=2, registry=None):
+    import jax.numpy as jnp
+
+    from heat2d_tpu.models import ensemble
+    runner = ensemble.batch_runner(nx, ny, steps, method)
+    u0 = jnp.zeros((batch, nx, ny), jnp.float32)
+    cxs = jnp.asarray([0.1] * batch, jnp.float32)
+    return perf.extract_cost_card(
+        runner, (u0, cxs, cxs), registry=registry,
+        meta={"signature": f"t:{nx}x{ny}:{method}", "nx": nx, "ny": ny,
+              "steps": steps, "method": method, "convergence": False,
+              "capacity": batch, "dtype": "float32", "route": "batch"})
+
+
+@pytest.mark.parametrize("method", ["jnp", "auto", "band", "adi", "mg"])
+def test_cost_card_boundary_within_tolerance(method):
+    """The acceptance tolerance: XLA's program-boundary bytes within
+    +-15% of the analytic boundary model, per batch route."""
+    card = _card(24, 32, 4, method)
+    assert card is not None, f"no cost card for {method}"
+    agree = card["model"]["boundary_agreement_pct"]
+    assert agree is not None and abs(agree - 100.0) <= 15.0, \
+        f"{method}: boundary bytes {agree}% of model"
+    assert card["model"]["route"] in ("jnp", "pallas", "band", "adi",
+                                      "mg")
+
+
+def test_cost_card_streaming_sanity():
+    """Op-level bytes accessed can never undercut one read + one write
+    of the grid (2b per cell for ONE loop-body application)."""
+    card = _card(24, 32, 4, "jnp")
+    assert card["bytes_accessed"] >= 2 * 4 * 2 * 24 * 32
+    assert card["flops"] > 0
+    assert card["arithmetic_intensity"] is not None
+
+
+def test_cost_card_failure_is_counted_not_raised():
+    reg = MetricsRegistry()
+    assert perf.extract_cost_card(object(), (), meta={},
+                                  registry=reg) is None
+    assert reg.find_counters("perf_card_failures_total")
+
+
+def test_perf_observer_dedup_and_persistence(tmp_path):
+    import jax.numpy as jnp
+
+    from heat2d_tpu.models import ensemble
+    reg = MetricsRegistry()
+    obs = perf.PerfObserver(registry=reg, dir=str(tmp_path),
+                            service="t")
+    runner = ensemble.batch_runner(16, 16, 2, "jnp")
+    u0 = jnp.zeros((1, 16, 16), jnp.float32)
+    cxs = jnp.asarray([0.1], jnp.float32)
+    meta = {"signature": "s", "capacity": 1, "route": "batch",
+            "nx": 16, "ny": 16, "method": "jnp", "dtype": "float32"}
+    first = obs.observe(runner, (u0, cxs, cxs), meta)
+    assert first is not None
+    # second observe: dict hit, no re-extraction, returns the card
+    assert obs.observe(runner, (u0, cxs, cxs), meta) is first
+    assert obs.card_for("s", 1, "batch") is first
+    obs.close()
+    files = [f for f in os.listdir(tmp_path)
+             if f.startswith("cost-cards-t-")]
+    assert len(files) == 1
+    lines = [json.loads(ln) for ln in
+             (tmp_path / files[0]).read_text().splitlines()]
+    assert len(lines) == 1 and lines[0]["signature"] == "s"
+
+
+# --------------------------------------------------------------------- #
+# jaxpr pins — extraction + sampler change NO traced program
+# --------------------------------------------------------------------- #
+
+def test_observatory_armed_programs_byte_identical(tmp_path):
+    from heat2d_tpu.obs import tracing
+    base = {
+        "solver": solver_jaxpr(),
+        "batch": batch_runner_jaxpr(),
+        "band": band_runner_jaxpr(),
+        "mesh": mesh_runner_jaxpr(),
+    }
+    reg = MetricsRegistry()
+    sampler = perf.DutyCycleSampler(reg, interval_s=0.01)
+    perf.install(perf.PerfObserver(registry=reg, dir=str(tmp_path)))
+    tracing.add_span_tap(sampler.feed)
+    sampler.start()
+    try:
+        # extraction actually exercised while armed (card on the very
+        # runner whose program the pin retraces)
+        assert _card(16, 16, 4, "jnp") is not None
+        armed = {
+            "solver": solver_jaxpr(),
+            "batch": batch_runner_jaxpr(),
+            "band": band_runner_jaxpr(),
+            "mesh": mesh_runner_jaxpr(),
+        }
+    finally:
+        sampler.stop()
+        tracing.remove_span_tap(sampler.feed)
+        perf.uninstall()
+    for name in base:
+        assert_jaxpr_equal(armed[name], base[name],
+                           f"perf-armed {name} runner")
+
+
+# --------------------------------------------------------------------- #
+# duty-cycle sampler
+# --------------------------------------------------------------------- #
+
+def _span(t0, t1, lane="serve", pid=1):
+    return {"event": "span", "kind": "launch", "service": lane,
+            "pid": pid, "span_id": f"{t0}-{t1}", "t0": t0, "t1": t1}
+
+
+def test_duty_cycle_interval_merge():
+    s = perf.DutyCycleSampler(window_s=2.0)
+    now = 1000.0
+    # two overlapping spans + one disjoint: busy = [998.5,999.5] +
+    # [999.8,1000] = 1.2s of a 2s window
+    s.feed(_span(998.5, 999.2))
+    s.feed(_span(999.0, 999.5))
+    s.feed(_span(999.8, 1000.0))
+    duty = s._sample(now)
+    assert duty["serve:1"] == pytest.approx(0.6)
+    # an open span counts to 'now'; lanes are independent
+    s.feed({"event": "span_start", "kind": "launch", "service": "mesh",
+            "pid": 2, "span_id": "o", "t0": 999.0})
+    duty = s._sample(now)
+    assert duty["mesh:2"] == pytest.approx(0.5)
+    # the retroactive close replaces the open span; idle decay then
+    # reports an explicit 0.0 instead of holding stale duty
+    s.feed({"event": "span", "kind": "launch", "service": "mesh",
+            "pid": 2, "span_id": "o", "t0": 999.0, "t1": 1000.2})
+    duty = s._sample(now + 100.0)
+    assert duty["serve:1"] == 0.0 and duty["mesh:2"] == 0.0
+    assert s.samples == 3
+
+
+def test_duty_cycle_ignores_other_span_kinds():
+    s = perf.DutyCycleSampler(window_s=2.0)
+    s.feed({"event": "span", "kind": "queue", "service": "serve",
+            "pid": 1, "span_id": "q", "t0": 999.0, "t1": 1000.0})
+    assert s._sample(1000.0) == {}
+
+
+# --------------------------------------------------------------------- #
+# anomaly sentinel
+# --------------------------------------------------------------------- #
+
+def _drive(sentinel, reg, windows, latency, sig="sig", n=3):
+    out = []
+    for w in range(windows):
+        for i in range(n):
+            reg.counter("serve_signature_requests_total",
+                        signature=sig, outcome="completed")
+            reg.observe("serve_signature_latency_s",
+                        latency(w, i), signature=sig)
+        out.append(sentinel.tick(reg))
+    return out
+
+
+def _sentinel():
+    clock = itertools.count()
+    return perf.AnomalySentinel(warmup=3, sustain=2,
+                                clock=lambda: float(next(clock)))
+
+
+def test_sentinel_flags_seeded_regression_within_budget():
+    reg = MetricsRegistry()
+    s = _sentinel()
+    _drive(s, reg, 8, lambda w, i: 0.02 + 0.001 * (i % 2))
+    assert s.findings == []          # healthy phase: silent
+    per_window = _drive(s, reg, 4, lambda w, i: 0.5)
+    first = next(i for i, f in enumerate(per_window) if f)
+    assert first + 1 <= 3, "detection blew the 3-window budget"
+    assert any(f["metric"] == "latency_mean_s"
+               for f in per_window[first])
+    f = [f for f in per_window[first]
+         if f["metric"] == "latency_mean_s"][0]
+    assert f["score"] >= s.k and f["windows"] == s.sustain
+    # one finding per episode, not one per window
+    assert sum(1 for fs in per_window
+               for f in fs if f["metric"] == "latency_mean_s") == 1
+    # frozen baseline: the outburst never became its own reference
+    assert s._state[("sig", "latency_mean_s")]["ewma"] \
+        == pytest.approx(0.02, abs=0.005)
+
+
+def test_sentinel_healthy_soak_zero_findings():
+    reg = MetricsRegistry()
+    s = _sentinel()
+    _drive(s, reg, 20, lambda w, i: 0.02 * (1 + 0.2 * ((w + i) % 3)))
+    assert s.findings == []
+
+
+def test_sentinel_zero_traffic_is_no_evidence():
+    reg = MetricsRegistry()
+    s = _sentinel()
+    _drive(s, reg, 5, lambda w, i: 0.02)
+    for _ in range(10):              # drained queue: nothing arrives
+        assert s.tick(reg) == []
+    assert s.findings == []
+
+
+def test_sentinel_scores_exported(tmp_path):
+    reg = MetricsRegistry()
+    s = _sentinel()
+    _drive(s, reg, 6, lambda w, i: 0.02)
+    assert reg.find_gauges("perf_anomaly_score")
+
+
+def test_sentinel_findings_reach_control_plane_decision_log():
+    from heat2d_tpu.control.plane import ControlPlane
+    from heat2d_tpu.obs.perf_cli import _StubFleet
+    reg = MetricsRegistry()
+    s = _sentinel()
+    plane = ControlPlane(_StubFleet(), registry=reg, sentinel=s)
+    for w in range(12):
+        for i in range(3):
+            reg.counter("serve_signature_requests_total",
+                        signature="sig", outcome="completed")
+            reg.observe("serve_signature_latency_s",
+                        0.02 if w < 8 else 0.5, signature="sig")
+        plane.tick()
+    rows = [d for d in plane.decisions if d["action"] == "perf_anomaly"]
+    assert rows and rows[0]["metric"] == "latency_mean_s"
+    assert reg.find_counters("perf_anomalies_total")
+
+
+# --------------------------------------------------------------------- #
+# launch stamping — serve + mesh rows carry the roofline fields
+# --------------------------------------------------------------------- #
+
+PERF_ROW_KEYS = {"achieved_mcells_per_s", "bound_mcells_per_s",
+                 "pct_of_bound", "bytes_per_cell_step",
+                 "mcells_per_hbm_byte", "route", "elapsed_s"}
+
+
+def test_serve_launch_rows_stamped():
+    from heat2d_tpu.serve.engine import EnsembleEngine
+    reg = MetricsRegistry()
+    eng = EnsembleEngine(registry=reg, max_batch=4)
+    eng.solve_batch(reqs(2))
+    row = eng.launch_log[-1]
+    assert PERF_ROW_KEYS <= set(row["perf"])
+    p = row["perf"]
+    assert p["route"] == "jnp" and p["achieved_mcells_per_s"] > 0
+    assert p["bytes_per_cell_step"] == 8.0
+    assert reg.find_counters("perf_launches_stamped_total")
+    assert reg.find_gauges("perf_achieved_mcells_per_s")
+    assert reg.find_gauges("perf_bytes_per_cell_step")
+
+
+def test_serve_launch_card_joined_when_armed(tmp_path):
+    from heat2d_tpu.serve.engine import EnsembleEngine
+    reg = MetricsRegistry()
+    perf.install(perf.PerfObserver(registry=reg, dir=str(tmp_path),
+                                   service="serve"))
+    try:
+        eng = EnsembleEngine(registry=reg, max_batch=4)
+        eng.solve_batch(reqs(2))
+        obs = perf.observer()
+        cards = obs.cards()
+        assert len(cards) == 1 and cards[0]["route"] == "batch"
+        assert eng.launch_log[-1]["perf"]["arithmetic_intensity"] \
+            == cards[0]["arithmetic_intensity"]
+        assert reg.find_counters("perf_cost_cards_total")
+    finally:
+        perf.uninstall()
+    assert [f for f in os.listdir(tmp_path)
+            if f.startswith("cost-cards-serve-")]
+
+
+def test_mesh_launch_rows_stamped():
+    from heat2d_tpu.mesh.engine import MeshEnsembleEngine
+    reg = MetricsRegistry()
+    eng = MeshEnsembleEngine(registry=reg)
+    eng.solve_batch(reqs(1))
+    row = eng.launch_log[-1]
+    assert PERF_ROW_KEYS <= set(row["perf"])
+    assert row["perf"]["achieved_mcells_per_s"] > 0
+
+
+def test_convergence_launch_stamps_mean_steps():
+    from heat2d_tpu.serve.engine import EnsembleEngine
+    reg = MetricsRegistry()
+    eng = EnsembleEngine(registry=reg, max_batch=4)
+    rs = reqs(2, steps=50, convergence=True, interval=5)
+    out = eng.solve_batch(rs)
+    p = eng.launch_log[-1]["perf"]
+    mean_done = sum(s for _, s in out) / len(out)
+    # stamped throughput used steps-actually-done, not the cap
+    assert p["achieved_mcells_per_s"] > 0
+    assert mean_done <= 50
+
+
+# --------------------------------------------------------------------- #
+# surfaces: record kind, trace --stats join, CLI
+# --------------------------------------------------------------------- #
+
+def test_record_kinds_includes_perf():
+    from heat2d_tpu.obs.record import RECORD_KINDS
+    assert "perf" in RECORD_KINDS
+
+
+def test_trace_stats_cost_card_join(tmp_path):
+    from heat2d_tpu.obs import trace_cli
+    (tmp_path / "cost-cards-t-1.jsonl").write_text(json.dumps(
+        {"signature": "SIG", "bytes_accessed": 128.0,
+         "arithmetic_intensity": 0.25}) + "\n")
+    cards = trace_cli.load_cost_cards(str(tmp_path))
+    assert cards == {"SIG": {"signature": "SIG",
+                             "bytes_accessed": 128.0,
+                             "arithmetic_intensity": 0.25}}
+    report = {"dir": str(tmp_path), "traces": [
+        {"signature": "SIG", "connected": True,
+         "breakdown": {"launch": 1.0}}]}
+    stats = trace_cli.segment_stats(report, cards=cards)
+    assert stats["launch"]["hbm_bytes"] == 128.0
+    assert stats["launch"]["arith_intensity"] == 0.25
+    assert "hbm_bytes" not in stats["queue"]
+    md = trace_cli.stats_markdown(report, cards=cards)
+    assert "hbm bytes" in md and "128" in md
+    # no cards -> the table keeps its old shape
+    md = trace_cli.stats_markdown(report, cards={})
+    assert "hbm bytes" not in md
+
+
+def test_perf_cli_roofline_and_card_gate(capsys):
+    from heat2d_tpu.obs import perf_cli
+    assert perf_cli.main(["--roofline", "64x64,4096x4096",
+                          "--json"]) == 0
+    rows = json.loads(capsys.readouterr().out)
+    assert rows[1]["route"] == "band"
+    assert rows[1]["bound_mcells_per_s"] == pytest.approx(
+        roofline.calibrated_bound_mcells(4096, 4096), abs=0.1)
+    assert perf_cli.main(["--card", "24x24", "--steps", "3",
+                          "--method", "jnp", "--batch", "2",
+                          "--gate-model-pct", "15", "--json"]) == 0
+    card = json.loads(capsys.readouterr().out.splitlines()[0])
+    assert card["model"]["boundary_agreement_pct"] is not None
+
+
+def test_perf_cli_requires_a_mode(capsys):
+    from heat2d_tpu.obs import perf_cli
+    assert perf_cli.main([]) == 2
+
+
+def test_env_arming(tmp_path, monkeypatch):
+    monkeypatch.setenv("HEAT2D_PERF_DIR", str(tmp_path))
+    perf._env_checked = False
+    perf._observer = None
+    try:
+        assert perf.enabled()
+        assert perf.observer().dir == str(tmp_path)
+    finally:
+        perf.uninstall()
