@@ -89,13 +89,21 @@ def _cxys(b: int):
 
 
 def batch_runner_jaxpr(nx: int = 16, ny: int = 16, steps: int = 4,
-                       method: str = "jnp", b: int = 2) -> str:
-    """The serve compile cache's memoized batch runner's program."""
+                       method: str = "jnp", b: int = 2,
+                       problem: Optional[str] = None) -> str:
+    """The serve compile cache's memoized batch runner's program.
+    ``problem`` (None = don't name the axis at all) lets the problem-
+    registry pins compare the explicitly-threaded heat5 program to the
+    pre-registry call shape."""
     import jax.numpy as jnp
 
     from heat2d_tpu.models import ensemble
 
-    fn = ensemble.batch_runner(nx, ny, steps, method)
+    if problem is None:
+        fn = ensemble.batch_runner(nx, ny, steps, method)
+    else:
+        fn = ensemble.batch_runner(nx, ny, steps, method,
+                                   problem=problem)
     u0 = jnp.zeros((b, nx, ny), jnp.float32)
     cxs = _cxys(b)
     return jaxpr_text(fn, u0, cxs, cxs)
@@ -119,7 +127,8 @@ def band_runner_jaxpr(nx: int = 64, ny: int = 128, steps: int = 10,
 def mesh_runner_jaxpr(nx: int = 16, ny: int = 16, steps: int = 4,
                       method: str = "jnp", b: Optional[int] = None,
                       n_devices: Optional[int] = None,
-                      abft: bool = False) -> str:
+                      abft: bool = False,
+                      problem: str = "heat5") -> str:
     """The mesh-sharded serve batch runner's program (heat2d_tpu/
     mesh/runner.py) — pins that the scheduler/admission/fault layers
     are pure host-side math: the traced mesh program is identical
@@ -132,7 +141,8 @@ def mesh_runner_jaxpr(nx: int = 16, ny: int = 16, steps: int = 4,
     from heat2d_tpu.mesh.runner import mesh_batch_runner
 
     run = mesh_batch_runner(nx, ny, steps, method,
-                            n_devices=n_devices, abft=abft)
+                            n_devices=n_devices, abft=abft,
+                            problem=problem)
     b = b if b is not None else run.n_devices
     u0 = jnp.zeros((b, nx, ny), jnp.float32)
     cxs = _cxys(b)

@@ -25,6 +25,7 @@ import os
 import sys
 
 from heat2d_tpu.config import ConfigError, HeatConfig
+from heat2d_tpu.vocab import PROBLEMS
 
 
 def build_parser() -> argparse.ArgumentParser:
@@ -44,6 +45,13 @@ def build_parser() -> argparse.ArgumentParser:
                         "stable, so --cx/--cy become dt-scaled "
                         "diffusion numbers chosen by accuracy")
     g = p.add_argument_group("problem (reference #define names)")
+    g.add_argument("--problem", default="heat5", choices=list(PROBLEMS),
+                   help="spatial-operator family (problem registry, "
+                        "docs/PROBLEMS.md): heat5 is the reference "
+                        "5-point stencil (byte-identical to the "
+                        "pre-registry solver); other families run the "
+                        "registry's kernels with per-family stability "
+                        "bounds and capability gating")
     g.add_argument("--nxprob", type=int, default=10)
     g.add_argument("--nyprob", type=int, default=10)
     g.add_argument("--steps", type=int, default=100)
@@ -347,6 +355,8 @@ def _run_ensemble_cli(args, cfg) -> int:
         print(f"Starting ensemble of {len(cxs)} members"
               + (f" over {len(jax.devices())} devices" if sharded else ""))
         print(f"Problem size:{cfg.nxprob}x{cfg.nyprob}")
+        if cfg.problem != "heat5":
+            print(f"Problem family: {cfg.problem}")
         if spatial_grid:
             print(f"Each member decomposed over a "
                   f"{spatial_grid[0]}x{spatial_grid[1]} spatial submesh")
@@ -360,7 +370,8 @@ def _run_ensemble_cli(args, cfg) -> int:
             sensitivity=cfg.sensitivity, spatial_grid=spatial_grid,
             halo_depth=cfg.halo_depth, halo=cfg.halo,
             tap=(telemetry.tap_members if telemetry is not None
-                 and spatial_grid is None else None))
+                 and spatial_grid is None else None),
+            problem=cfg.problem)
     except (ConfigError, ValueError) as e:
         print(f"{e}\nQuitting...", file=sys.stderr)
         return 1
@@ -493,7 +504,8 @@ def main(argv=None) -> int:
             accum_dtype=args.accum_dtype, numworkers=args.numworkers,
             strict_baseline=args.strict_baseline, debug=args.debug,
             halo_depth=args.halo_depth, halo=args.halo,
-            bitwise_parity=args.bitwise_parity, method=args.method)
+            bitwise_parity=args.bitwise_parity, method=args.method,
+            problem=args.problem)
     except ConfigError as e:
         print(f"{e}\nQuitting...", file=sys.stderr)
         return 1
@@ -532,6 +544,8 @@ def main(argv=None) -> int:
     # Startup banner (grad1612_mpi_heat.c:66-69).
     say(f"Starting with {cfg.n_shards} shards")
     say(f"Problem size:{cfg.nxprob}x{cfg.nyprob}")
+    if cfg.problem != "heat5":
+        say(f"Problem family: {cfg.problem}")
     if cfg.mode in ("dist2d", "hybrid"):
         say(f"Each shard will take: {cfg.xcell}x{cfg.ycell}")
     say(f"Amount of iterations: {cfg.steps}")

@@ -20,6 +20,8 @@ from __future__ import annotations
 import dataclasses
 from typing import Optional
 
+from heat2d_tpu import vocab as _vocab
+
 
 class ConfigError(ValueError):
     """Invalid solver configuration (the framework's MPI_Abort analogue)."""
@@ -64,7 +66,15 @@ HALO_ROUTES = ("collective", "fused")
 #:              multigrid V-cycles (ops/multigrid.py): no splitting
 #:              error; the iterative route for steady/convergence
 #:              solves.
-TIME_METHODS = ("explicit", "adi", "mg")
+#: Derived from the single-source vocabulary (vocab.py) so this list,
+#: diff/vocab.METHODS, and serve.schema.SUPPORTED_METHODS cannot
+#: drift independently (the R005-style drift class).
+TIME_METHODS = _vocab.TIME_METHODS
+
+#: Problem families (the spatial-operator axis — heat2d_tpu/problems/,
+#: docs/PROBLEMS.md). "heat5" is the reference's 5-point operator and
+#: keeps every pre-registry program byte-identical (jaxpr-pinned).
+PROBLEMS = _vocab.PROBLEMS
 
 
 @dataclasses.dataclass(frozen=True)
@@ -95,6 +105,12 @@ class HeatConfig:
     # pre-existing route byte-identical (jaxpr-pinned); the implicit
     # schemes are unconditionally stable and skip the stability box.
     method: str = "explicit"
+    # Problem family (PROBLEMS — the spatial operator). The default
+    # "heat5" is the reference operator and leaves every pre-registry
+    # program byte-identical (jaxpr-pinned); other families validate
+    # their own stability bound and capability matrix
+    # (heat2d_tpu/problems/base.py).
+    problem: str = "heat5"
     # Wide-halo depth T for the distributed modes: each halo exchange
     # carries a T-deep ghost ring and the shard advances T steps locally
     # per exchange — 4 ppermutes per T steps instead of 4T (the distributed
@@ -171,7 +187,40 @@ class HeatConfig:
             raise ConfigError(
                 f"method must be one of {TIME_METHODS}, got "
                 f"{self.method!r}")
-        if self.method == "explicit":
+        if self.problem not in PROBLEMS:
+            raise ConfigError(
+                f"problem must be one of {PROBLEMS}, got "
+                f"{self.problem!r}")
+        if self.problem != _vocab.DEFAULT_PROBLEM:
+            # Registry families: per-family capability matrix + grid
+            # floor + stability bound (heat2d_tpu/problems/base.py).
+            # The heat5 branch below is the pre-registry code path,
+            # byte-for-byte — the jaxpr pins hold it.
+            from heat2d_tpu.problems.base import spec_for
+            spec = spec_for(self.problem)
+            if self.mode != "serial":
+                raise ConfigError(
+                    f"problem {self.problem!r} runs mode 'serial' "
+                    f"only in the solver (the pallas/distributed "
+                    f"modes are built for the heat5 operator; use "
+                    f"the ensemble/serve path for batched kernel "
+                    f"routes) — got mode {self.mode!r}")
+            ok, reason = spec.supports_method(self.method)
+            if not ok:
+                raise ConfigError(reason)
+            if min(self.nxprob, self.nyprob) < spec.min_grid:
+                raise ConfigError(
+                    f"problem {self.problem!r} (halo width "
+                    f"{spec.halo_width}) needs a grid of at least "
+                    f"{spec.min_grid}x{spec.min_grid} for interior "
+                    f"cells, got {self.nxprob}x{self.nyprob}")
+            if self.method == "explicit":
+                from heat2d_tpu.ops.stability import (
+                    check_problem_stability)
+                check_problem_stability(self.problem, self.cx,
+                                        self.cy,
+                                        where="explicit scheme")
+        elif self.method == "explicit":
             # Explicit routes validate against the stability box; the
             # implicit routes skip it by design (ops/stability.py).
             from heat2d_tpu.ops.stability import (

@@ -6,6 +6,8 @@ these tuples, and ``adjoint.py`` (jax-heavy) is the wrong place to
 make them import from.
 """
 
+from heat2d_tpu import vocab as _vocab
+
 #: coefficient forms of the differentiable solve
 COEFFS = ("const", "var")
 
@@ -15,8 +17,11 @@ ADJOINTS = ("checkpoint", "full")
 #: primal multi-step routes ("adi": the implicit Crank-Nicolson ADI
 #: step — different MATH, not just a different kernel; its adjoint
 #: rides the implicit differentiation of the tridiagonal solves,
-#: ops/tridiag.thomas_solve's custom_vjp)
-METHODS = ("auto", "jnp", "band", "adi")
+#: ops/tridiag.thomas_solve's custom_vjp). Derived from the
+#: single-source method vocabulary (heat2d_tpu/vocab.py) by excluding
+#: the non-differentiable routes — this list, config.TIME_METHODS,
+#: and serve.schema.SUPPORTED_METHODS share one set of atoms.
+METHODS = _vocab.DIFF_METHODS
 
 #: inverse-problem recovery targets
 TARGETS = ("init", "diffusivity")

@@ -48,7 +48,10 @@ def spec_from_signature(sig: tuple, rng: random.Random) -> tuple:
     """(kind, spec dict) for one recorded signature tuple.
 
     Solve signatures are ``(nx, ny, steps, dtype, method, convergence,
-    interval, sensitivity)``; inverse signatures are ``("inverse", nx,
+    interval, sensitivity)`` with an optional 9th ``problem`` element
+    (the problem-registry axis: campaigns recorded before it exist as
+    8-tuples and replay as problem="heat5"; current signatures carry
+    the family explicitly); inverse signatures are ``("inverse", nx,
     ny, steps, target, iterations, adjoint, segment, dtype)`` — the
     layouts serve/schema.py and diff/serving.py define. Raises
     ``ValueError`` on anything else (a trace from a future schema
@@ -75,9 +78,12 @@ def spec_from_signature(sig: tuple, rng: random.Random) -> tuple:
         if int(seg):
             spec["segment"] = int(seg)
         return "inverse", spec
-    if len(sig) != 8:
+    if len(sig) not in (8, 9):
         raise ValueError(f"malformed solve signature: {sig!r}")
-    nx, ny, steps, dtype, method, convergence, interval, sens = sig
+    nx, ny, steps, dtype, method, convergence, interval, sens = sig[:8]
+    # Pre-registry campaigns recorded 8-tuples: those replay as the
+    # reference family (heat5, the only problem that existed).
+    problem = str(sig[8]) if len(sig) == 9 else "heat5"
     spec = {
         "nx": int(nx), "ny": int(ny), "steps": int(steps),
         "dtype": str(dtype), "method": str(method),
@@ -85,6 +91,8 @@ def spec_from_signature(sig: tuple, rng: random.Random) -> tuple:
         "cx": round(0.05 + 0.15 * rng.random(), 6),
         "cy": round(0.05 + 0.15 * rng.random(), 6),
     }
+    if problem != "heat5":
+        spec["problem"] = problem
     if convergence:
         spec["interval"] = int(interval)
         spec["sensitivity"] = float(sens)

@@ -240,13 +240,15 @@ class MeshEnsembleEngine(EnsembleEngine):
         cxs, cys, u0 = ensemble._validated_batch(
             req0.nx, req0.ny, cxs, cys, None)
         interval, sensitivity = req0.schedule()
+        problem = getattr(req0, "problem", "heat5")
         runner = mesh_batch_runner(
             req0.nx, req0.ny, req0.steps, req0.method,
             convergence=req0.convergence, interval=interval,
             sensitivity=sensitivity,
             n_devices=(None if device_indices is not None
                        else self.n_devices),
-            device_indices=device_indices, abft=abft)
+            device_indices=device_indices, abft=abft,
+            problem=problem)
         timer = (self.registry.timer("serve_launch_s")
                  if self.registry is not None
                  else contextlib.nullcontext())
@@ -279,6 +281,7 @@ class MeshEnsembleEngine(EnsembleEngine):
                       "steps": req0.steps, "method": req0.method,
                       "convergence": req0.convergence,
                       "capacity": capacity, "dtype": "float32",
+                      "problem": problem,
                       "route": "mesh_batch"})
         self._launch_perf = {
             "elapsed_s": elapsed,
@@ -308,15 +311,30 @@ class MeshEnsembleEngine(EnsembleEngine):
         policy = self.degrader.policy
         req0 = requests[0]
         n = len(requests)
-        method = ensemble._pick_method(req0.method, req0.nx, req0.ny)
-        abft_armed = (policy.abft
-                      and abft_lib.supported_family(method) is not None)
+        problem = getattr(req0, "problem", "heat5")
+        if problem == "heat5":
+            method = ensemble._pick_method(req0.method, req0.nx,
+                                           req0.ny)
+            abft_armed = (policy.abft
+                          and abft_lib.supported_family(method)
+                          is not None)
+            unsupported_reason = method
+        else:
+            # The ABFT checksum recurrence is derived for the heat5
+            # operator; families declare abft=False (problems/base.py)
+            # and serve unverified under a fault policy — counted,
+            # never crashed (the runner-level gate would raise).
+            from heat2d_tpu.problems import runners as prunners
+            method = prunners.pick_route(problem, req0.method,
+                                         req0.nx, req0.ny)
+            abft_armed = False
+            unsupported_reason = f"problem_{problem}"
         if (policy.abft and not abft_armed
                 and self.registry is not None):
             # opt-in tier, honestly reported: this method has no exact
             # linear recurrence — served unverified, counted
             self.registry.counter("mesh_abft_unsupported_total",
-                                  reason=method)
+                                  reason=unsupported_reason)
         requeues = 0
         first_cause: Optional[str] = None
         casualties: List[int] = []
@@ -614,6 +632,9 @@ class MeshEnsembleEngine(EnsembleEngine):
         self.launch_log.append(row)
         if self.registry is not None:
             self.registry.counter("serve_launches_total")
+            self.registry.counter(
+                "problem_requests_total",
+                problem=getattr(req0, "problem", "heat5"))
         self._tag_launch(decision, capacity=capacity)
         if devices is not None:
             mesh_row = self.launch_log[-1]["mesh"]
@@ -631,7 +652,8 @@ class MeshEnsembleEngine(EnsembleEngine):
                 row, self.registry, nx=req0.nx, ny=req0.ny,
                 steps=lp["steps"], members=capacity,
                 elapsed_s=lp["elapsed_s"], method=req0.method,
-                signature=str(req0.signature()), card=lp["card"])
+                signature=str(req0.signature()), card=lp["card"],
+                problem=getattr(req0, "problem", "heat5"))
 
     def fault_snapshot(self) -> Optional[dict]:
         """Run-record ``mesh_fault`` block: policy, measured recovery

@@ -63,7 +63,7 @@ def mesh_batch_runner(nx: int, ny: int, steps: int, method: str = "auto",
                       sensitivity: float = 0.1,
                       n_devices: Optional[int] = None,
                       device_indices: Optional[tuple] = None,
-                      abft: bool = False):
+                      abft: bool = False, problem: str = "heat5"):
     """The per-(signature, mesh) COMPILE-CACHED mesh-sharded runner: a
     ``(u0, cxs, cys) -> batch`` (fixed-step) or ``-> (batch,
     steps_done)`` (convergence) callable whose batch axis is sharded
@@ -79,6 +79,12 @@ def mesh_batch_runner(nx: int, ny: int, steps: int, method: str = "auto",
     counting alone cannot name them. Wins over ``n_devices`` when
     given; each subset is its own cache entry (its own compile ladder
     per mesh shape).
+
+    ``problem`` names the spatial-operator family (heat2d_tpu/
+    problems/): "heat5" (default) shards the pre-registry runners
+    byte-identically (jaxpr-pinned); other families shard the
+    registry's generic route runners — the batch axis carries whole
+    members either way, so the shard_map wrap is family-independent.
 
     ``abft=True`` arms the checksum verify tier (ops/abft.py): the
     runner additionally returns per-member ``(steps_done, s_obs,
@@ -97,7 +103,19 @@ def mesh_batch_runner(nx: int, ny: int, steps: int, method: str = "auto",
     from heat2d_tpu.models import ensemble
     from heat2d_tpu.parallel.mesh import shard_map_compat
 
-    method = ensemble._pick_method(method, nx, ny)
+    if problem != "heat5":
+        from heat2d_tpu.problems import runners as prunners
+        from heat2d_tpu.problems.base import spec_for
+        if abft and not spec_for(problem).abft:
+            raise ValueError(
+                f"problem {problem!r} declares no ABFT recurrence "
+                f"(problems/base.py) — gate with spec_for(...).abft "
+                f"before arming the runner")
+        method = prunners.pick_route(problem, method, nx, ny)
+        base = prunners.fixed_runner(problem, method)
+    else:
+        method = ensemble._pick_method(method, nx, ny)
+        base = None
     if device_indices is not None:
         pool = attached_devices(None)
         devices = [pool[i] for i in device_indices]
@@ -105,7 +123,17 @@ def mesh_batch_runner(nx: int, ny: int, steps: int, method: str = "auto",
         devices = attached_devices(n_devices)
     nd = len(devices)
     mesh = Mesh(np.asarray(devices), ("batch",))
-    if convergence:
+    if base is not None:
+        # Generic-family local runner: the same chunked convergence
+        # loop the single-chip batch_runner composes (runner-agnostic).
+        if convergence:
+            local = functools.partial(
+                ensemble._run_batch_conv_kernel, steps=steps,
+                interval=interval, sensitivity=sensitivity,
+                runner=base)
+        else:
+            local = functools.partial(base, steps=steps)
+    elif convergence:
         local = ensemble._conv_runner(method, steps, interval,
                                       sensitivity)
     else:
@@ -119,7 +147,9 @@ def mesh_batch_runner(nx: int, ny: int, steps: int, method: str = "auto",
     # sentinel attribute every mesh compile to this runner (host-side
     # metadata only — the traced program is unchanged).
     try:
-        mapped.__name__ = f"mesh_batch_runner_{method}"
+        mapped.__name__ = (f"mesh_batch_runner_{method}"
+                           if problem == "heat5" else
+                           f"mesh_batch_runner_{problem}_{method}")
     except (AttributeError, TypeError):
         pass
     jitted = jax.jit(mapped)
@@ -139,6 +169,7 @@ def mesh_batch_runner(nx: int, ny: int, steps: int, method: str = "auto",
     run.method = method
     run.device_indices = device_indices
     run.abft = abft
+    run.problem = problem
     run.jitted = jitted      # the traced program (jaxpr pins)
     return run
 

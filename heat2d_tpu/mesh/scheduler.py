@@ -60,9 +60,15 @@ from heat2d_tpu.serve.schema import Rejected
 DEFAULT_PER_CHIP_MCELLS_PER_S = 500.0
 
 
-def grid_bytes(nx: int, ny: int, itemsize: int = 4) -> int:
-    """One member's grid bytes — the resource model's unit."""
-    return int(nx) * int(ny) * itemsize
+def grid_bytes(nx: int, ny: int, itemsize: int = 4,
+               problem: str = "heat5") -> int:
+    """One member's grid bytes — the resource model's unit. Scaled by
+    the problem family's declared state-array count (problems/base.py:
+    varcoef carries per-cell diffusivity fields beside the state, so a
+    member costs 3x the bare grid)."""
+    from heat2d_tpu.problems.base import state_arrays
+
+    return int(nx) * int(ny) * itemsize * state_arrays(problem)
 
 
 def _per_chip_vmem_bytes() -> int:
@@ -156,7 +162,8 @@ class MeshScheduler:
         return d
 
     def _decide(self, req0) -> dict:
-        bytes_ = grid_bytes(req0.nx, req0.ny)
+        problem = getattr(req0, "problem", "heat5")
+        bytes_ = grid_bytes(req0.nx, req0.ny, problem=problem)
         out = {
             "signature": str(req0.signature()),
             "n_devices": self.n_devices,
@@ -173,6 +180,13 @@ class MeshScheduler:
         if bytes_ <= self.spatial_bytes_threshold:
             return dict(out, route="batch", reason="fits_chip",
                         spatial_grid=None)
+        if problem != "heat5":
+            # The spatial decomposition (halo plans, fused kernels) is
+            # built on the heat5 stencil; oversized members of other
+            # families follow the totality contract — served single-
+            # chip (the generic runners band-stream from HBM), never
+            # rejected.
+            return dict(out, route="single", reason="problem_spatial")
         from heat2d_tpu.models import ensemble
 
         gx, gy = self.spatial_grid()

@@ -745,7 +745,8 @@ def _conv_runner(method, steps, interval, sensitivity, tap=None):
 
 def run_ensemble_convergence(nx: int, ny: int, steps: int, interval: int,
                              sensitivity: float, cxs, cys, u0=None,
-                             method: str = "auto", tap=None):
+                             method: str = "auto", tap=None,
+                             problem: str = "heat5"):
     """Ensemble with per-member convergence early-exit — the intended
     grad1612_mpi_heat.c:262-271 residual schedule applied member-wise
     (the reference could only run one instance per launch; SURVEY.md
@@ -755,8 +756,15 @@ def run_ensemble_convergence(nx: int, ny: int, steps: int, interval: int,
 
     ``tap``: optional chunk-progress telemetry stream (see
     obs/stream.TelemetryStream.tap_members); honored by the batched
-    kernel methods, ignored by 'jnp' (vmapped loop)."""
+    kernel methods, ignored by 'jnp' (vmapped loop) and by registry
+    families (``problem`` != "heat5", which run the generic chunked
+    loop without a tap)."""
     cxs, cys, u0 = _validated_batch(nx, ny, cxs, cys, u0)
+    if problem != "heat5":
+        fn = batch_runner(nx, ny, steps, method, convergence=True,
+                          interval=interval, sensitivity=sensitivity,
+                          problem=problem)
+        return fn(u0, cxs, cys)
     method = _pick_method(method, nx, ny)
     fn = jax.jit(_conv_runner(method, steps, interval, sensitivity,
                               tap=tap))
@@ -777,7 +785,7 @@ def _pick_method(method, nx, ny):
 @functools.lru_cache(maxsize=128)
 def batch_runner(nx: int, ny: int, steps: int, method: str = "auto",
                  convergence: bool = False, interval: int = 20,
-                 sensitivity: float = 0.1):
+                 sensitivity: float = 0.1, problem: str = "heat5"):
     """The per-signature COMPILE-CACHED batch-of-heterogeneous-params
     entry: a jitted ``(u0, cxs, cys) -> batch`` (fixed-step) or
     ``-> (batch, steps_done)`` (convergence) runner, memoized by
@@ -789,7 +797,29 @@ def batch_runner(nx: int, ny: int, steps: int, method: str = "auto",
     steady-state traffic on a warm signature never retraces. cxs/cys are
     traced operands — heterogeneous per-member diffusivities share one
     executable; only a new batch shape or dtype triggers a (cached)
-    re-specialization inside the one jitted callable."""
+    re-specialization inside the one jitted callable.
+
+    ``problem``: the spatial-operator family (heat2d_tpu/problems/).
+    The default "heat5" takes the pre-registry path below, byte-for-
+    byte (jaxpr-pinned); other families dispatch to the registry's
+    generic runners with route legality enforced against the declared
+    capability matrix (problems.runners.pick_route)."""
+    if problem != "heat5":
+        from heat2d_tpu.problems import runners as prunners
+        route = prunners.pick_route(problem, method, nx, ny)
+        runner = prunners.fixed_runner(problem, route)
+        if convergence:
+            fn = functools.partial(_run_batch_conv_kernel, steps=steps,
+                                   interval=interval,
+                                   sensitivity=sensitivity,
+                                   runner=runner)
+        else:
+            fn = functools.partial(runner, steps=steps)
+        try:
+            fn.__name__ = f"batch_runner_{problem}_{route}"
+        except (AttributeError, TypeError):
+            pass
+        return jax.jit(fn)
     method = _pick_method(method, nx, ny)
     if convergence:
         fn = _conv_runner(method, steps, interval, sensitivity)
@@ -808,7 +838,7 @@ def batch_runner(nx: int, ny: int, steps: int, method: str = "auto",
 
 
 def run_ensemble(nx: int, ny: int, steps: int, cxs, cys, u0=None,
-                 method: str = "auto"):
+                 method: str = "auto", problem: str = "heat5"):
     """Advance an ensemble of diffusivity pairs ``steps`` steps.
 
     ``cxs``/``cys``: 1D arrays of equal length B. ``u0``: optional (B, nx,
@@ -819,8 +849,16 @@ def run_ensemble(nx: int, ny: int, steps: int, cxs, cys, u0=None,
     VMEM-resident), 'band' (batched temporally-blocked band kernel for
     HBM-sized members), or 'auto' (pallas when a member fits VMEM, band
     otherwise).
+
+    ``problem``: spatial-operator family — "heat5" (default, the
+    pre-registry path, jaxpr-pinned) or any registered family, which
+    dispatches through the registry's generic runners with the route
+    validated against the declared capability matrix.
     """
     cxs, cys, u0 = _validated_batch(nx, ny, cxs, cys, u0)
+    if problem != "heat5":
+        fn = batch_runner(nx, ny, steps, method, problem=problem)
+        return fn(u0, cxs, cys)
     method = _pick_method(method, nx, ny)
     fn, args, b = _build_single(steps, method, u0, cxs, cys)
     return fn(*args)
@@ -1150,7 +1188,8 @@ def timed_ensemble(nx: int, ny: int, steps: int, cxs, cys, u0=None,
                    devices=None, convergence: bool = False,
                    interval: int = 20, sensitivity: float = 0.1,
                    spatial_grid=None, halo_depth=None,
-                   halo: str = "collective", tap=None):
+                   halo: str = "collective", tap=None,
+                   problem: str = "heat5"):
     """(batch, steps_done, elapsed): one ensemble launch under the
     reference timing protocol (compile/warmup excluded, scalar-readback
     fence) — the CLI entry point. ``sharded=True`` spreads members over
@@ -1163,6 +1202,21 @@ def timed_ensemble(nx: int, ny: int, steps: int, cxs, cys, u0=None,
     from heat2d_tpu.utils.timing import timed_call
 
     cxs, cys, u0 = _validated_batch(nx, ny, cxs, cys, u0)
+    if problem != "heat5":
+        from heat2d_tpu.config import ConfigError
+        if sharded or spatial_grid is not None:
+            raise ConfigError(
+                f"problem {problem!r} runs the single-chip batch path "
+                f"only (the sharded/spatial meshes are built for the "
+                f"heat5 operator); drop sharded/spatial_grid")
+        fn = batch_runner(nx, ny, steps, method,
+                          convergence=convergence, interval=interval,
+                          sensitivity=sensitivity, problem=problem)
+        out, elapsed = timed_call(fn, u0, cxs, cys)
+        if convergence:
+            u, k = out
+            return u, k, elapsed
+        return out, None, elapsed
     if spatial_grid is not None:
         gx, gy = spatial_grid
         fn, args, b, _meta = _build_spatial(

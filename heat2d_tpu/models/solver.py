@@ -168,8 +168,20 @@ class Heat2DSolver:
             self._runner = make_single_chip_runner(cfg, tap=tap)
             return self._runner
 
-        def step(u):
-            return stencil_step(u, cfg.cx, cfg.cy, accum)
+        if cfg.problem != "heat5":
+            # Registry families (config validated: serial + explicit
+            # only): the step comes from the family's jnp reference
+            # kernel; the engine loops are family-agnostic. The heat5
+            # branch below is the pre-registry closure, byte-for-byte
+            # (jaxpr-pinned).
+            from heat2d_tpu.problems import get_family
+            fam = get_family(cfg.problem)
+
+            def step(u):
+                return fam.step(u, cfg.cx, cfg.cy)
+        else:
+            def step(u):
+                return stencil_step(u, cfg.cx, cfg.cy, accum)
 
         def multi(u, n):
             from jax import lax

@@ -62,15 +62,22 @@ def _itemsize(dtype: str) -> int:
         raise ValueError(f"no itemsize for dtype {dtype!r}") from None
 
 
-def resolve_route(nx: int, ny: int, method: str = "auto") -> str:
+def resolve_route(nx: int, ny: int, method: str = "auto",
+                  problem: str = "heat5") -> str:
     """The memory-structure route a (shape, method) actually executes:
     ``jnp`` | ``pallas`` (VMEM-resident) | ``band`` (HBM-streamed
     bands/window) | ``adi`` | ``mg``. Resolved through the SAME
     dispatch the runners use (``ensemble._pick_method`` +
-    ``ps.fits_vmem``), so the analytic model below describes the
-    program that compiles, not the method string the caller typed."""
+    ``ps.fits_vmem`` for heat5; ``problems.runners.pick_route`` for
+    registry families, which respects the declared kernel routes — so
+    e.g. varcoef always resolves to jnp), so the analytic model below
+    describes the program that compiles, not the method string the
+    caller typed."""
     if method in ("adi", "mg", "jnp"):
         return method
+    if problem != "heat5":
+        from heat2d_tpu.problems.runners import pick_route
+        return pick_route(problem, method, nx, ny)
     from heat2d_tpu.models import ensemble
     from heat2d_tpu.ops import pallas_stencil as ps
     m = ensemble._pick_method(method, nx, ny)
@@ -81,7 +88,8 @@ def resolve_route(nx: int, ny: int, method: str = "auto") -> str:
 
 def analytic_bytes_per_cell_step(nx: int, ny: int, *,
                                  method: str = "auto",
-                                 dtype: str = "float32") -> dict:
+                                 dtype: str = "float32",
+                                 problem: str = "heat5") -> dict:
     """HBM bytes one cell-update must move, per route.
 
     Returns ``{"bytes_per_cell_step", "route", "model", "coarse"}``.
@@ -102,12 +110,28 @@ def analytic_bytes_per_cell_step(nx: int, ny: int, *,
     - ``mg``:     smoothing + residual + transfer over the level
                   hierarchy (4/3 geometric factor) -> ~``16b``
                   (coarse).
+
+    ``problem``: registry families adjust the constants from their
+    declared resource model (problems/base.py): the jnp route reads
+    ``reads_per_step`` grid arrays (varcoef streams u + two
+    coefficient fields -> 4b), and the band route's halo re-read
+    scales with the family halo width (``bm + 2*w*T`` rows per band).
+    heat5 keeps the exact pre-registry numbers and model strings.
     """
     b = _itemsize(dtype)
-    route = resolve_route(nx, ny, method)
+    route = resolve_route(nx, ny, method, problem=problem)
+    w, reads = 1, 1
+    if problem != "heat5":
+        from heat2d_tpu.problems.base import spec_for
+        spec = spec_for(problem)
+        w, reads = spec.halo_width, spec.reads_per_step
     if route == "jnp":
-        return {"bytes_per_cell_step": 2.0 * b, "route": route,
-                "model": "2b stream", "coarse": False}
+        n_arrays = reads + 1.0   # reads + the written plane
+        return {"bytes_per_cell_step": n_arrays * b, "route": route,
+                "model": ("2b stream" if reads == 1
+                          else f"{n_arrays:g}b stream "
+                               f"(reads={reads})"),
+                "coarse": False}
     if route == "adi":
         return {"bytes_per_cell_step": 8.0 * b, "route": route,
                 "model": "~8b (2 sweeps x rhs+thomas)", "coarse": True}
@@ -123,9 +147,12 @@ def analytic_bytes_per_cell_step(nx: int, ny: int, *,
     p, bm = ps.plan_panels(nx, ny, t)
     if p == 1:
         bm, _ = ps.plan_window_band(nx, ny, t)
-    bpcs = b * (1.0 + (bm + 2 * t) / bm) / t
+    h = w * t
+    bpcs = b * (1.0 + (bm + 2 * h) / bm) / t
+    model = (f"band bm={bm}, T={t}" if w == 1
+             else f"band bm={bm}, T={t}, w={w}")
     return {"bytes_per_cell_step": bpcs, "route": "band",
-            "model": f"band bm={bm}, T={t}", "coarse": False}
+            "model": model, "coarse": False}
 
 
 def mcells_per_hbm_byte(nx: int, ny: int, *, method: str = "auto",
@@ -205,7 +232,8 @@ def stamp_launch_row(row: dict, registry=None, *, nx: int, ny: int,
                      steps: float, members: int, elapsed_s: float,
                      method: str = "auto", dtype: str = "float32",
                      signature: Optional[str] = None,
-                     card: Optional[dict] = None) -> dict:
+                     card: Optional[dict] = None,
+                     problem: str = "heat5") -> dict:
     """Stamp one launch's roofline accounting into its launch-log row
     (``row["perf"]``) and the ``perf_*`` gauge families.
 
@@ -219,8 +247,13 @@ def stamp_launch_row(row: dict, registry=None, *, nx: int, ny: int,
     cells = float(members) * nx * ny
     achieved = (cells * steps / elapsed_s / 1e6
                 if elapsed_s > 0 else 0.0)
-    m = analytic_bytes_per_cell_step(nx, ny, method=method, dtype=dtype)
-    bound = roofline_bound(nx, ny, method=method, dtype=dtype)
+    m = analytic_bytes_per_cell_step(nx, ny, method=method, dtype=dtype,
+                                     problem=problem)
+    # The calibrated ceiling is measured on the heat5 kernels; other
+    # families' band programs do different arithmetic per sweep, so
+    # the bound is honestly absent rather than borrowed.
+    bound = (roofline_bound(nx, ny, method=method, dtype=dtype)
+             if problem == "heat5" else None)
     perf = {
         "achieved_mcells_per_s": round(achieved, 3),
         "bound_mcells_per_s": (round(bound["bound_mcells_per_s"], 1)

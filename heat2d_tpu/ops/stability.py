@@ -30,9 +30,17 @@ this on host-side paths.
 from __future__ import annotations
 
 from heat2d_tpu.config import ConfigError
+from heat2d_tpu.vocab import (ADVECTION_VELOCITY, IMPLICIT_METHODS,
+                              REACTION_RATE)
 
 #: The dimensionless coefficient-sum bound: cx + cy <= 1/2.
 EXPLICIT_COEFF_LIMIT = 0.5
+
+#: The 4th-order 9-point family's tighter box: the wide operator's
+#: worst von Neumann mode has eigenvalue ``lam4(pi) = 16/3`` per axis
+#: (vs the 5-point's 4), so ``|1 - (cx + cy) * 16/3| <= 1`` gives
+#: ``cx + cy <= 3/8`` (problems/heat9; docs/PROBLEMS.md).
+HEAT9_COEFF_LIMIT = 0.375
 
 #: Stability box for projected diffusivity iterates (diff/inverse.py):
 #: isotropic kappa (kx = ky) must satisfy 2*kappa <= 1/2; 0.24 leaves
@@ -40,9 +48,10 @@ EXPLICIT_COEFF_LIMIT = 0.5
 #: (kappa >= 0) and the solve sensitive to it.
 KAPPA_MIN, KAPPA_MAX = 1e-4, 0.24
 
-#: Methods that skip the explicit stability box entirely (A-stable
-#: time discretizations: Crank-Nicolson ADI, multigrid-solved CN).
-IMPLICIT_METHODS = ("adi", "mg")
+# IMPLICIT_METHODS is re-exported from vocab.py (the single-source
+# method vocabulary): the A-stable time discretizations that skip the
+# explicit stability box entirely.
+IMPLICIT_METHODS = IMPLICIT_METHODS
 
 
 def stability_limit(dx: float = 1.0, dy: float = 1.0) -> float:
@@ -79,6 +88,89 @@ def check_explicit_stability(cx: float, cy: float,
             f"ops/stability.py). Use an implicit method "
             f"(--method adi or mg), which is unconditionally stable, "
             f"or reduce the time step")
+
+
+def check_heat9_stability(cx: float, cy: float,
+                          where: str = "heat9 step") -> None:
+    """heat9's guard — same contract as the 5-point check, tighter
+    box: the 4th-order operator's worst-mode eigenvalue is 16/3 per
+    axis, so the bound is ``cx + cy <= 3/8`` (NAMED in the error)."""
+    if cx < 0 or cy < 0:
+        raise ConfigError(
+            f"{where}: diffusivity coefficients must be >= 0, got "
+            f"cx={cx} cy={cy}")
+    if cx + cy > HEAT9_COEFF_LIMIT:
+        raise ConfigError(
+            f"{where}: cx + cy = {cx + cy:g} exceeds the heat9 "
+            f"(4th-order 9-point) stability limit cx + cy <= "
+            f"{HEAT9_COEFF_LIMIT} (worst-mode eigenvalue 16/3 per "
+            f"axis — ops/stability.py); reduce the time step")
+
+
+def check_advdiff_stability(cx: float, cy: float,
+                            where: str = "advdiff step") -> None:
+    """advdiff's guard: the diffusion box PLUS the central-advection
+    cell-Reynolds bounds ``vx**2 <= 2*cx`` and ``vy**2 <= 2*cy`` (the
+    FTCS advection-diffusion condition; the family velocities are
+    fixed constants, vocab.ADVECTION_VELOCITY). Both bounds NAMED."""
+    check_explicit_stability(cx, cy, where=where)
+    vx, vy = ADVECTION_VELOCITY
+    for axis, v, c in (("x", vx, cx), ("y", vy, cy)):
+        if v * v > 2.0 * c:
+            raise ConfigError(
+                f"{where}: advection CFL (cell-Reynolds) bound "
+                f"v{axis}^2 <= 2*c{axis} violated: {v:g}^2 = "
+                f"{v * v:g} > {2.0 * c:g} (family velocity "
+                f"v{axis} = {v:g}, vocab.ADVECTION_VELOCITY — "
+                f"ops/stability.py); increase c{axis} or use a "
+                f"diffusivity of at least {v * v / 2.0:g}")
+
+
+def check_reactdiff_stability(cx: float, cy: float,
+                              where: str = "reactdiff step") -> None:
+    """reactdiff's guard: the diffusion box PLUS the explicit
+    reaction-rate bound ``r <= 1/2`` for the saturating source
+    ``r*u/(1+u)``, whose Jacobian ``r/(1+u)^2`` is bounded by r at
+    u = 0 (amplification 1 - 4cx - 4cy + r must stay in [-1, 1] with
+    the diffusive worst mode: ``cx + cy <= 1/2`` and ``r <= 1/2``
+    jointly suffice for u >= 0, where the source itself saturates at
+    r). r is the fixed family constant (vocab.REACTION_RATE); the
+    bound is checked so an out-of-tree family edit cannot silently
+    destabilize."""
+    check_explicit_stability(cx, cy, where=where)
+    r = REACTION_RATE
+    if r > 0.5:
+        raise ConfigError(
+            f"{where}: explicit reaction-rate bound r <= 1/2 "
+            f"violated: r = {r:g} (vocab.REACTION_RATE — "
+            f"ops/stability.py); reduce the reaction time step")
+
+
+#: problem -> validation guard. heat5 and varcoef share the 5-point
+#: box (varcoef's per-cell fields are bounded by (cx, cy) pointwise —
+#: problems/kernels.varcoef_profiles).
+_PROBLEM_CHECKS = {
+    "heat5": check_explicit_stability,
+    "varcoef": check_explicit_stability,
+    "heat9": check_heat9_stability,
+    "advdiff": check_advdiff_stability,
+    "reactdiff": check_reactdiff_stability,
+}
+
+
+def check_problem_stability(problem: str, cx: float, cy: float,
+                            where: str = "explicit step") -> None:
+    """Per-family explicit-stability dispatch: every registered
+    family's bound, NAMED in its error (the kx+ky <= 1/2 contract
+    generalized). heat5 routes to ``check_explicit_stability``
+    unchanged — identical error text on the default family."""
+    try:
+        check = _PROBLEM_CHECKS[problem]
+    except KeyError:
+        raise ConfigError(
+            f"no stability bound registered for problem "
+            f"{problem!r} (known: {tuple(_PROBLEM_CHECKS)})") from None
+    check(cx, cy, where=where)
 
 
 def project_stable(kappa):

@@ -1,0 +1,28 @@
+"""Problem registry: pluggable stencil/PDE families (docs/PROBLEMS.md).
+
+The package splits jax-free from jax-bound on purpose:
+
+- ``base``     — FamilySpec contract + FAMILY_SPECS (no jax): config
+                 validation, serve admission, mesh bytes routing,
+                 tune keys, roofline constants read this half.
+- ``kernels``  — the jax/numpy kernel templates per family.
+- ``registry`` — runtime Family objects binding spec + kernels.
+- ``runners``  — generic batched jnp/pallas/band ensemble runners.
+
+Import ``heat2d_tpu.problems`` (this module) for the full API;
+import ``heat2d_tpu.problems.base`` directly on host-side paths that
+must stay jax-free.
+"""
+
+from heat2d_tpu.problems.base import (FAMILY_SPECS, FamilySpec,
+                                      capability_matrix, spec_for,
+                                      state_arrays, supports_method)
+from heat2d_tpu.problems.registry import (Family, family_names,
+                                          get_family, register)
+from heat2d_tpu.vocab import DEFAULT_PROBLEM, PROBLEMS
+
+__all__ = [
+    "FAMILY_SPECS", "FamilySpec", "capability_matrix", "spec_for",
+    "state_arrays", "supports_method", "Family", "family_names",
+    "get_family", "register", "DEFAULT_PROBLEM", "PROBLEMS",
+]

@@ -1,0 +1,226 @@
+"""Problem-family CONTRACT — the jax-free declarative half of the
+registry.
+
+A problem family is one spatial operator: the reference hardcodes
+exactly one (the 5-point constant-coefficient heat stencil —
+SURVEY.md §7.1; ROADMAP open item 1), and before this package every
+layer of the platform was welded to it. ``FamilySpec`` is what a
+family DECLARES about itself; everything the dispatch spine needs on
+host-side paths (config validation, serving admission, the mesh
+scheduler's bytes model, tune-db keys, roofline constants) reads the
+spec alone and never imports jax — the kernels live in
+``problems/kernels.py`` and bind lazily through
+``problems/registry.py``.
+
+Capability gating falls out of the declared properties
+(docs/PROBLEMS.md capability matrix):
+
+- ``time_methods`` — which time discretizations the platform's built
+  kernels serve for this operator. The implicit routes (ADI's batched
+  constant-coefficient Thomas sweeps, MG's 5-point V-cycle smoother)
+  are OPERATOR-SPECIFIC kernels, so only ``heat5`` inherits them
+  today; a nonlinear source additionally rules them out structurally
+  (Crank-Nicolson's linear solves do not apply). The gate's error
+  NAMES the reason (``gate_reason``).
+- ``abft`` — whether ABFT's closed-form checksum recurrence holds
+  (requires linearity AND the separable-mode eigenvector structure
+  plus a constant boundary flux — ops/abft.py); nonlinear families
+  get probe/quarantine tiers only.
+- ``kernel_routes`` — which explicit batched kernel templates exist
+  (``varcoef``'s per-cell coefficient FIELDS don't ride the scalar
+  SMEM operand scheme, so it is jnp-only).
+- ``halo_width`` — T_spatial: the operator's spatial radius. The band
+  templates carry ``halo_width * T`` ghost rows per sweep and the
+  per-step keep-mask holds a ``halo_width``-deep boundary ring (the
+  Bandishti-et-al wider-stencil generalization, PAPERS.md).
+- ``state_arrays`` / ``reads_per_step`` — the resource model: grid-
+  sized device arrays per member (mesh scheduler bytes routing,
+  tune/space VMEM working set) and HBM arrays read per jnp step
+  (obs/roofline.py bytes/cell-step).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Tuple
+
+from heat2d_tpu.vocab import (ADVECTION_VELOCITY, DEFAULT_PROBLEM,
+                              IMPLICIT_METHODS, PROBLEMS, REACTION_RATE)
+
+
+@dataclasses.dataclass(frozen=True)
+class FamilySpec:
+    """What one problem family declares about itself (module
+    docstring). Pure data — the registry binds kernels to it."""
+
+    name: str
+    title: str
+    #: spatial radius (T_spatial): per-step valid-region shrink, halo
+    #: ring depth, and the boundary-ring width the update holds.
+    halo_width: int
+    #: linear in u — the property the implicit/ABFT gates derive from.
+    linear: bool
+    #: grid-sized device arrays per member (u + coefficient fields).
+    state_arrays: int
+    #: HBM grid arrays read per jnp step (u + coefficient fields).
+    reads_per_step: int
+    #: SMEM scalar operands of the kernel templates (cx, cy, + family
+    #: constants that ride as traced values).
+    n_scalars: int
+    #: time discretizations the platform's kernels serve (subset of
+    #: vocab.TIME_METHODS).
+    time_methods: Tuple[str, ...]
+    #: explicit batched kernel routes with a template for this family
+    #: (subset of vocab.EXPLICIT_ROUTES).
+    kernel_routes: Tuple[str, ...]
+    #: ABFT closed-form checksum recurrence applies (ops/abft.py).
+    abft: bool
+    #: the diff subsystem's adjoints cover this operator.
+    adjoint: bool
+    #: why the non-declared methods are missing — quoted verbatim by
+    #: the gates' structured errors.
+    gate_reason: str
+
+    @property
+    def min_grid(self) -> int:
+        """Smallest nx/ny with at least one interior cell: the held
+        boundary ring is ``halo_width`` deep on each side."""
+        return 2 * self.halo_width + 1
+
+    def supports_method(self, method: str) -> Tuple[bool, Optional[str]]:
+        """(ok, reason) for a solve ``method`` against this family's
+        declared capabilities. ``method`` is a serve/config method
+        name: 'explicit' checks ``time_methods``, 'auto'/explicit
+        kernel routes check ``kernel_routes``, implicit methods check
+        ``time_methods``. The reason string NAMES the unsupported
+        combination — it becomes the ConfigError/Rejected message
+        verbatim."""
+        if method == "explicit":
+            if "explicit" in self.time_methods:
+                return True, None
+            return False, (
+                f"problem {self.name!r} does not support explicit "
+                f"time stepping (supported time methods: "
+                f"{self.time_methods})")
+        if method in IMPLICIT_METHODS:
+            if method in self.time_methods:
+                return True, None
+            return False, (
+                f"problem {self.name!r} does not support method "
+                f"{method!r}: {self.gate_reason} (supported time "
+                f"methods: {self.time_methods})")
+        if method == "auto" or method in self.kernel_routes:
+            return True, None
+        return False, (
+            f"problem {self.name!r} has no {method!r} kernel template "
+            f"(available routes: {self.kernel_routes}); use one of "
+            f"those or 'auto'")
+
+
+_IMPLICIT_5PT = ("the batched tridiagonal (ADI) and multigrid kernels "
+                 "are built for the constant-coefficient 5-point "
+                 "operator")
+
+#: The declarative registry half: every family's spec, keyed by name.
+#: Kernel-free on purpose — admission paths read this without jax.
+FAMILY_SPECS = {
+    "heat5": FamilySpec(
+        name="heat5",
+        title="5-point constant-coefficient heat (the reference)",
+        halo_width=1, linear=True, state_arrays=1, reads_per_step=1,
+        n_scalars=2,
+        time_methods=("explicit",) + IMPLICIT_METHODS,
+        kernel_routes=("jnp", "pallas", "band"),
+        abft=True, adjoint=True,
+        gate_reason="(fully supported)"),
+    "varcoef": FamilySpec(
+        name="varcoef",
+        title="variable-coefficient (heterogeneous-material) diffusion",
+        halo_width=1, linear=True, state_arrays=3, reads_per_step=3,
+        n_scalars=2,
+        time_methods=("explicit",),
+        kernel_routes=("jnp",),
+        abft=False, adjoint=True,
+        gate_reason=_IMPLICIT_5PT),
+    "heat9": FamilySpec(
+        name="heat9",
+        title="4th-order 9-point (wide-stencil) heat",
+        halo_width=2, linear=True, state_arrays=1, reads_per_step=1,
+        n_scalars=2,
+        time_methods=("explicit",),
+        kernel_routes=("jnp", "pallas", "band"),
+        abft=False, adjoint=False,
+        gate_reason=_IMPLICIT_5PT + " (the 4th-order operator is "
+                    "pentadiagonal per axis)"),
+    "advdiff": FamilySpec(
+        name="advdiff",
+        title="advection-diffusion (central advection)",
+        halo_width=1, linear=True, state_arrays=1, reads_per_step=1,
+        n_scalars=4,
+        time_methods=("explicit",),
+        kernel_routes=("jnp", "pallas", "band"),
+        abft=False, adjoint=False,
+        gate_reason=_IMPLICIT_5PT + " (no advection terms in the "
+                    "tridiagonal systems)"),
+    "reactdiff": FamilySpec(
+        name="reactdiff",
+        title="reaction-diffusion (saturating nonlinear source)",
+        halo_width=1, linear=False, state_arrays=1, reads_per_step=1,
+        n_scalars=3,
+        time_methods=("explicit",),
+        kernel_routes=("jnp", "pallas", "band"),
+        abft=False, adjoint=False,
+        gate_reason="the nonlinear source term rules out the "
+                    "Crank-Nicolson linear solves (and the ABFT "
+                    "checksum recurrence); nonlinear families get "
+                    "explicit stepping + probe/quarantine only"),
+}
+
+assert tuple(FAMILY_SPECS) == PROBLEMS, \
+    "FAMILY_SPECS and vocab.PROBLEMS drifted"
+
+
+def spec_for(problem: str) -> FamilySpec:
+    """The declared spec, or a ValueError naming the vocabulary —
+    raised as the caller's structured error type (ConfigError is a
+    ValueError subclass; serve admission catches and re-codes)."""
+    try:
+        return FAMILY_SPECS[problem]
+    except KeyError:
+        raise ValueError(
+            f"unknown problem {problem!r}; registered families: "
+            f"{PROBLEMS}") from None
+
+
+def supports_method(problem: str, method: str):
+    """(ok, reason) — module-level convenience over ``spec_for``."""
+    return spec_for(problem).supports_method(method)
+
+
+def state_arrays(problem: str = DEFAULT_PROBLEM) -> int:
+    """Grid-sized device arrays per member — the mesh scheduler's
+    bytes-model multiplier (heat5 = 1: byte-identical routing)."""
+    return spec_for(problem).state_arrays
+
+
+def capability_matrix() -> dict:
+    """problem -> {time_methods, kernel_routes, abft, adjoint,
+    linear, halo_width} — the docs/PROBLEMS.md table and the CI
+    ``problems-smoke`` assertion read the same source."""
+    return {
+        name: {
+            "time_methods": spec.time_methods,
+            "kernel_routes": spec.kernel_routes,
+            "abft": spec.abft,
+            "adjoint": spec.adjoint,
+            "linear": spec.linear,
+            "halo_width": spec.halo_width,
+        }
+        for name, spec in FAMILY_SPECS.items()
+    }
+
+
+# Re-exported family constants (vocab.py owns them; stability and the
+# kernels bind the same values through this namespace).
+ADVECTION_VELOCITY = ADVECTION_VELOCITY
+REACTION_RATE = REACTION_RATE

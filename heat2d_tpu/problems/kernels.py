@@ -1,0 +1,266 @@
+"""Problem-family kernel templates — the jax half of the registry.
+
+Per family, three forms of the SAME update (the contract the parity
+tests pin against each other):
+
+- ``<fam>_step(u, cx, cy)`` — the jnp reference step: interior
+  updated via ``.at[].set``, a ``halo_width``-deep boundary ring held
+  (the clamped BC every mode shares — ops/stencil.py boundary
+  semantics, generalized to wider rings).
+- ``<fam>_step_value(u, *scalars)`` — the Pallas band/ensemble
+  template: value-in/value-out on an array, reassembled via
+  concatenation of static slices (Mosaic has no scatter lowering —
+  ops/pallas_stencil._step_value's scheme, generalized to ring depth
+  ``halo_width``). Inside the band kernels the caller's keep-mask
+  owns the GLOBAL boundary; this form holds the LOCAL window ring.
+- ``<fam>_np_step(u, cx, cy)`` — the numpy golden oracle, evaluated
+  in float64 and cast back (parity tolerance is documented per test,
+  not bitwise: the jnp forms accumulate in float32).
+
+``heat5`` deliberately re-exports the EXISTING functions
+(``ops.stencil.stencil_step`` / ``ops.pallas_stencil._step_value``)
+rather than reimplementing them — the byte-identity pins require the
+same function objects on every pre-registry path.
+
+Family constants (advection velocity, reaction rate) come from
+``vocab.py`` so the jax-free stability checks and the traced kernels
+can never disagree about the numbers.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from heat2d_tpu.ops.stencil import stencil_step, stencil_step_var
+from heat2d_tpu.vocab import ADVECTION_VELOCITY, REACTION_RATE
+
+
+def _ring_reassemble(u, new, w):
+    """Value-form reassembly: ``new`` replaces the interior of ``u``
+    inside a ``w``-deep held ring (concatenation of static slices —
+    the Mosaic-safe scheme)."""
+    mid = jnp.concatenate([u[w:-w, :w], new, u[w:-w, -w:]], axis=1)
+    return jnp.concatenate([u[:w, :], mid, u[-w:, :]], axis=0)
+
+
+# --------------------------------------------------------------------- #
+# heat5 — the reference family (existing functions, byte-identical)
+# --------------------------------------------------------------------- #
+
+def heat5_step(u, cx, cy):
+    """The reference update — ops.stencil.stencil_step verbatim (the
+    registry must not introduce a second copy of the hot math)."""
+    return stencil_step(u, cx, cy)
+
+
+def heat5_step_value(u, cx, cy):
+    from heat2d_tpu.ops.pallas_stencil import _step_value
+    return _step_value(u, cx, cy)
+
+
+def heat5_np_step(u, cx, cy):
+    v = np.asarray(u, np.float64)
+    c = v[1:-1, 1:-1]
+    sx = v[2:, 1:-1] + v[:-2, 1:-1]
+    sy = v[1:-1, 2:] + v[1:-1, :-2]
+    out = np.array(u, copy=True)
+    out[1:-1, 1:-1] = (c + cx * (sx - 2.0 * c)
+                       + cy * (sy - 2.0 * c)).astype(u.dtype)
+    return out
+
+
+# --------------------------------------------------------------------- #
+# varcoef — per-cell diffusivity fields (promoted ops.stencil_step_var)
+# --------------------------------------------------------------------- #
+
+def varcoef_profiles(nx, ny, xp=jnp, dtype=None):
+    """The family's deterministic "graded-material lens" coefficient
+    PROFILES: separable polynomial bumps in [0.5, 1.0], multiplied by
+    (cx, cy) to give per-cell fields bounded by the constant
+    coefficients — so ``kx + ky <= cx + cy`` pointwise and the heat5
+    stability box governs (ops/stencil.py stability note). Profiles
+    depend only on the grid shape; the request's two knobs stay
+    (cx, cy), exactly like every other family."""
+    dtype = dtype or (jnp.float32 if xp is jnp else np.float32)
+    si = xp.linspace(0.0, 1.0, nx, dtype=dtype)[:, None]
+    sj = xp.linspace(0.0, 1.0, ny, dtype=dtype)[None, :]
+    px = (0.5 + 2.0 * si * (1.0 - si)).astype(dtype)
+    py = (0.5 + 2.0 * sj * (1.0 - sj)).astype(dtype)
+    ones = xp.ones((nx, ny), dtype)
+    return px * ones, py * ones
+
+
+def varcoef_step(u, cx, cy):
+    px, py = varcoef_profiles(u.shape[0], u.shape[1])
+    return stencil_step_var(u, cx * px, cy * py)
+
+
+def varcoef_np_step(u, cx, cy):
+    px, py = varcoef_profiles(u.shape[0], u.shape[1], xp=np,
+                              dtype=np.float64)
+    v = np.asarray(u, np.float64)
+    kx, ky = cx * px, cy * py
+    c = v[1:-1, 1:-1]
+    sx = v[2:, 1:-1] + v[:-2, 1:-1]
+    sy = v[1:-1, 2:] + v[1:-1, :-2]
+    out = np.array(u, copy=True)
+    out[1:-1, 1:-1] = (c + kx[1:-1, 1:-1] * (sx - 2.0 * c)
+                       + ky[1:-1, 1:-1] * (sy - 2.0 * c)).astype(u.dtype)
+    return out
+
+
+# --------------------------------------------------------------------- #
+# heat9 — 4th-order 9-point (wide) stencil, halo width 2
+# --------------------------------------------------------------------- #
+
+def _heat9_interior(u, cx, cy):
+    """4th-order central second differences on the w=2 interior:
+    ``dxx4 = (-u[i-2] + 16 u[i-1] - 30 u[i] + 16 u[i+1] - u[i+2])/12``
+    per axis (the classic 5-point-per-axis wide stencil)."""
+    c = u[2:-2, 2:-2]
+    dxx = (-u[4:, 2:-2] + 16.0 * u[3:-1, 2:-2] - 30.0 * c
+           + 16.0 * u[1:-3, 2:-2] - u[:-4, 2:-2]) * (1.0 / 12.0)
+    dyy = (-u[2:-2, 4:] + 16.0 * u[2:-2, 3:-1] - 30.0 * c
+           + 16.0 * u[2:-2, 1:-3] - u[2:-2, :-4]) * (1.0 / 12.0)
+    return c + cx * dxx + cy * dyy
+
+
+def heat9_step(u, cx, cy):
+    return u.at[2:-2, 2:-2].set(_heat9_interior(u, cx, cy)
+                                .astype(u.dtype))
+
+
+def heat9_step_value(u, cx, cy):
+    return _ring_reassemble(u, _heat9_interior(u, cx, cy), 2)
+
+
+def heat9_np_step(u, cx, cy):
+    v = np.asarray(u, np.float64)
+    c = v[2:-2, 2:-2]
+    dxx = (-v[4:, 2:-2] + 16.0 * v[3:-1, 2:-2] - 30.0 * c
+           + 16.0 * v[1:-3, 2:-2] - v[:-4, 2:-2]) / 12.0
+    dyy = (-v[2:-2, 4:] + 16.0 * v[2:-2, 3:-1] - 30.0 * c
+           + 16.0 * v[2:-2, 1:-3] - v[2:-2, :-4]) / 12.0
+    out = np.array(u, copy=True)
+    out[2:-2, 2:-2] = (c + cx * dxx + cy * dyy).astype(u.dtype)
+    return out
+
+
+def heat9_mode_factor(nx, ny, cx, cy):
+    """Exact per-step amplification of the lowest separable sine mode
+    under the 4th-order operator: the discrete sine IS an eigenvector
+    of ``dxx4`` on the held-ring domain restricted to the full-domain
+    mode structure, with eigenvalue ``lam4(k) = (30 - 32 cos k +
+    2 cos 2k)/12`` at ``k = pi/(n-1)`` — the analytic-accuracy oracle
+    (tests compare a small-amplitude evolution's decay rate)."""
+    kx = np.pi / (nx - 1)
+    ky = np.pi / (ny - 1)
+    lam4 = lambda k: (30.0 - 32.0 * np.cos(k) + 2.0 * np.cos(2 * k)) / 12.0
+    return 1.0 - cx * lam4(kx) - cy * lam4(ky)
+
+
+# --------------------------------------------------------------------- #
+# advdiff — central advection + diffusion (fixed family velocities)
+# --------------------------------------------------------------------- #
+
+def _advdiff_interior(u, cx, cy, vx, vy):
+    c = u[1:-1, 1:-1]
+    sx = u[2:, 1:-1] + u[:-2, 1:-1]
+    sy = u[1:-1, 2:] + u[1:-1, :-2]
+    dx = u[2:, 1:-1] - u[:-2, 1:-1]
+    dy = u[1:-1, 2:] - u[1:-1, :-2]
+    return (c + cx * (sx - 2.0 * c) + cy * (sy - 2.0 * c)
+            - 0.5 * vx * dx - 0.5 * vy * dy)
+
+
+def advdiff_step(u, cx, cy):
+    vx, vy = ADVECTION_VELOCITY
+    return u.at[1:-1, 1:-1].set(
+        _advdiff_interior(u, cx, cy, vx, vy).astype(u.dtype))
+
+
+def advdiff_step_value(u, cx, cy, vx, vy):
+    return _ring_reassemble(u, _advdiff_interior(u, cx, cy, vx, vy), 1)
+
+
+def advdiff_np_step(u, cx, cy):
+    vx, vy = ADVECTION_VELOCITY
+    v = np.asarray(u, np.float64)
+    c = v[1:-1, 1:-1]
+    sx = v[2:, 1:-1] + v[:-2, 1:-1]
+    sy = v[1:-1, 2:] + v[1:-1, :-2]
+    dx = v[2:, 1:-1] - v[:-2, 1:-1]
+    dy = v[1:-1, 2:] - v[1:-1, :-2]
+    out = np.array(u, copy=True)
+    out[1:-1, 1:-1] = (c + cx * (sx - 2.0 * c) + cy * (sy - 2.0 * c)
+                       - 0.5 * vx * dx
+                       - 0.5 * vy * dy).astype(u.dtype)
+    return out
+
+
+# --------------------------------------------------------------------- #
+# reactdiff — reaction-diffusion with a saturating nonlinear source
+# --------------------------------------------------------------------- #
+#
+# The source is Michaelis-Menten kinetics, r*u/(1+u): genuinely
+# nonlinear (the property the capability matrix gates ADI/MG/ABFT on —
+# no closed-form linear recurrence exists), yet BOUNDED for any u >= 0
+# (the term saturates at r), so the family is stable on the reference
+# initial condition, whose values run to ~nx^2*ny^2/16 — far outside
+# the [0, 1] range a logistic source would need. The reaction Jacobian
+# r/(1+u)^2 <= r gives the explicit bound ops/stability.py names.
+
+def _reactdiff_interior(u, cx, cy, r):
+    c = u[1:-1, 1:-1]
+    sx = u[2:, 1:-1] + u[:-2, 1:-1]
+    sy = u[1:-1, 2:] + u[1:-1, :-2]
+    return (c + cx * (sx - 2.0 * c) + cy * (sy - 2.0 * c)
+            + r * c / (1.0 + c))
+
+
+def reactdiff_step(u, cx, cy):
+    r = REACTION_RATE
+    return u.at[1:-1, 1:-1].set(
+        _reactdiff_interior(u, cx, cy, r).astype(u.dtype))
+
+
+def reactdiff_step_value(u, cx, cy, r):
+    return _ring_reassemble(u, _reactdiff_interior(u, cx, cy, r), 1)
+
+
+def reactdiff_np_step(u, cx, cy):
+    r = REACTION_RATE
+    v = np.asarray(u, np.float64)
+    c = v[1:-1, 1:-1]
+    sx = v[2:, 1:-1] + v[:-2, 1:-1]
+    sy = v[1:-1, 2:] + v[1:-1, :-2]
+    out = np.array(u, copy=True)
+    out[1:-1, 1:-1] = (c + cx * (sx - 2.0 * c) + cy * (sy - 2.0 * c)
+                       + r * c / (1.0 + c)).astype(u.dtype)
+    return out
+
+
+# --------------------------------------------------------------------- #
+# scalar-operand mappings (the SMEM rows of the batched kernels)
+# --------------------------------------------------------------------- #
+
+def heat5_scalars(cx, cy):
+    return (cx, cy)
+
+
+def varcoef_scalars(cx, cy):
+    return (cx, cy)
+
+
+def heat9_scalars(cx, cy):
+    return (cx, cy)
+
+
+def advdiff_scalars(cx, cy):
+    vx, vy = ADVECTION_VELOCITY
+    return (cx, cy, jnp.full_like(cx, vx), jnp.full_like(cy, vy))
+
+
+def reactdiff_scalars(cx, cy):
+    return (cx, cy, jnp.full_like(cx, REACTION_RATE))

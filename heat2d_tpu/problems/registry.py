@@ -1,0 +1,134 @@
+"""Runtime problem registry — spec + bound kernels per family.
+
+``base.FAMILY_SPECS`` is the jax-free declarative half; this module
+binds each spec to its kernel templates (``problems/kernels.py``) and
+exposes the lookup the dispatch spine uses on device-side paths:
+
+    fam = get_family("advdiff")
+    step = fam.step                  # u -> u' (jnp reference form)
+    vals = fam.step_value            # value-form (Pallas templates)
+    ops  = fam.scalars(cxs, cys)     # SMEM scalar operands, len S
+
+The two kernel forms plus the numpy oracle are THE contract a family
+ships (tests/test_problems.py pins them against each other); adding a
+family = one FamilySpec + these callables + a registry entry.
+
+``register()`` exists so an out-of-tree scenario can plug in without
+editing this package — the capability gates and resource models read
+the spec it carries, so the whole platform (serve admission, mesh
+routing, tune keys, roofline) follows for free.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Dict, Optional
+
+from heat2d_tpu.problems import kernels as _k
+from heat2d_tpu.problems.base import FAMILY_SPECS, FamilySpec
+
+
+@dataclasses.dataclass(frozen=True)
+class Family:
+    """One registered problem family: the declared spec plus the
+    kernel templates bound to it.
+
+    - ``step(u, cx, cy)`` — jnp reference step (at-based interior
+      update; the solver's serial mode and the vmapped jnp batch
+      runner build on it).
+    - ``step_value(u, *scalars)`` — value-form template (concatenate
+      reassembly, Mosaic-safe) with exactly ``spec.n_scalars`` scalar
+      operands; the generic Pallas ensemble/band kernels trace it.
+    - ``scalars(cx, cy)`` — maps the request's two coefficient knobs
+      to the family's scalar-operand tuple (family constants ride as
+      traced values so one compiled kernel serves all members).
+    - ``np_step(u, cx, cy)`` — numpy float64 golden oracle.
+    - ``mode_factor(nx, ny, cx, cy)`` — analytic per-step
+      amplification of the lowest sine mode, when the family has one
+      (linear, constant-coefficient); None otherwise.
+    """
+
+    spec: FamilySpec
+    step: Callable
+    step_value: Callable
+    scalars: Callable
+    np_step: Callable
+    mode_factor: Optional[Callable] = None
+
+    @property
+    def name(self) -> str:
+        return self.spec.name
+
+
+def _heat5_mode_factor(nx, ny, cx, cy):
+    from heat2d_tpu.ops.analytic import mode_decay_factor
+    return mode_decay_factor(nx, ny, cx, cy)
+
+
+_FAMILIES: Dict[str, Family] = {}
+
+
+def register(family: Family) -> Family:
+    """Add (or replace) a family. The spec must already satisfy the
+    base contract; out-of-tree specs just construct FamilySpec."""
+    _FAMILIES[family.name] = family
+    return family
+
+
+def get_family(problem: str) -> Family:
+    try:
+        return _FAMILIES[problem]
+    except KeyError:
+        raise ValueError(
+            f"unknown problem {problem!r}; registered families: "
+            f"{tuple(_FAMILIES)}") from None
+
+
+def family_names():
+    return tuple(_FAMILIES)
+
+
+register(Family(
+    spec=FAMILY_SPECS["heat5"],
+    step=_k.heat5_step,
+    step_value=_k.heat5_step_value,
+    scalars=_k.heat5_scalars,
+    np_step=_k.heat5_np_step,
+    mode_factor=_heat5_mode_factor,
+))
+
+register(Family(
+    spec=FAMILY_SPECS["varcoef"],
+    step=_k.varcoef_step,
+    # varcoef carries per-cell coefficient FIELDS: no value-form
+    # scalar-operand template exists (kernel_routes declares jnp-only;
+    # the route gate rejects pallas/band before anything traces this).
+    step_value=_k.varcoef_step,
+    scalars=_k.varcoef_scalars,
+    np_step=_k.varcoef_np_step,
+))
+
+register(Family(
+    spec=FAMILY_SPECS["heat9"],
+    step=_k.heat9_step,
+    step_value=_k.heat9_step_value,
+    scalars=_k.heat9_scalars,
+    np_step=_k.heat9_np_step,
+    mode_factor=_k.heat9_mode_factor,
+))
+
+register(Family(
+    spec=FAMILY_SPECS["advdiff"],
+    step=_k.advdiff_step,
+    step_value=_k.advdiff_step_value,
+    scalars=_k.advdiff_scalars,
+    np_step=_k.advdiff_np_step,
+))
+
+register(Family(
+    spec=FAMILY_SPECS["reactdiff"],
+    step=_k.reactdiff_step,
+    step_value=_k.reactdiff_step_value,
+    scalars=_k.reactdiff_scalars,
+    np_step=_k.reactdiff_np_step,
+))

@@ -1,0 +1,245 @@
+"""Generic batched ensemble runners over the problem registry.
+
+models/ensemble.py's heat5 runners are kept VERBATIM (the jaxpr pins
+hold them byte-identical); these are their family-generic twins, one
+per explicit kernel route, parameterized by the registry's kernel
+templates instead of the hardcoded 5-point update:
+
+- jnp    — vmap of the engine fixed-step loop over ``family.step``
+- pallas — one batched VMEM-resident kernel: SMEM scalar block grows
+           from (1, 1, 2) to (1, 1, S) for the family's S scalar
+           operands; the fori_loop traces ``family.step_value``
+- band   — the gathered-strip temporally-blocked sweep with halo
+           depth ``h = halo_width * T`` per sweep (the Bandishti et
+           al. wider-stencil generalization, PAPERS.md): strips carry
+           h rows, the keep-mask holds a ``halo_width``-deep global
+           boundary ring, and pollution from the held LOCAL window
+           edges advances ``halo_width`` rows per step — after T
+           steps it reaches exactly the discarded h-row halo, never
+           the kept band interior.
+
+Convergence composes for free: ensemble's ``_run_batch_conv_kernel``
+is runner-agnostic, so any family's fixed-step runner slots in as its
+``runner=`` argument (the per-member residual is a plain difference
+norm — family-independent).
+
+Route legality is decided here (``pick_route``) from the declared
+spec: a named route missing from ``kernel_routes`` is a structured
+ConfigError naming the combination; 'auto' resolves pallas-if-fits
+else band else jnp, restricted to the declared routes (heat5's
+resolution is byte-identical to ``ensemble._pick_method``).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+from heat2d_tpu.models import engine
+from heat2d_tpu.problems.base import spec_for
+from heat2d_tpu.problems.registry import get_family
+from heat2d_tpu.vocab import DEFAULT_PROBLEM, IMPLICIT_METHODS
+
+
+def pick_route(problem: str, method: str, nx: int, ny: int) -> str:
+    """Resolve a serve/config ``method`` to a concrete kernel route
+    for ``problem``, enforcing the declared capability matrix. Raises
+    ConfigError (the structured validation type) naming the
+    unsupported combination. heat5 + auto resolves exactly as
+    ``ensemble._pick_method`` (pallas when a member fits VMEM, band
+    otherwise) — the pinned legacy behavior."""
+    from heat2d_tpu.config import ConfigError
+
+    spec = spec_for(problem)
+    ok, reason = spec.supports_method(method)
+    if not ok:
+        raise ConfigError(reason)
+    if method in IMPLICIT_METHODS:
+        return method
+    if method != "auto":
+        return method
+    from heat2d_tpu.ops.pallas_stencil import fits_vmem
+    routes = spec.kernel_routes
+    if "pallas" in routes and fits_vmem((nx, ny)):
+        return "pallas"
+    if "band" in routes:
+        return "band"
+    return "jnp"
+
+
+# --------------------------------------------------------------------- #
+# jnp route — vmap of the engine loop over the family's reference step
+# --------------------------------------------------------------------- #
+
+def _run_batch_jnp_family(u0, cxs, cys, *, steps, family):
+    def solve_one(u, cx, cy):
+        u, _ = engine.run_fixed(lambda v: family.step(v, cx, cy), u,
+                                steps)
+        return u
+
+    return jax.vmap(solve_one)(u0, cxs, cys)
+
+
+# --------------------------------------------------------------------- #
+# pallas route — batched VMEM-resident kernel, S-scalar SMEM block
+# --------------------------------------------------------------------- #
+
+def _family_ensemble_kernel(s_ref, u_ref, out_ref, *, steps, step_value,
+                            n_scalars):
+    scalars = tuple(s_ref[0, 0, k] for k in range(n_scalars))
+    u = u_ref[0]
+    u = jax.lax.fori_loop(0, steps,
+                          lambda _, v: step_value(v, *scalars), u,
+                          unroll=False)
+    out_ref[0] = u
+
+
+def _scal_block(family, cxs, cys):
+    """(B, 1, S) SMEM operand block: the family's scalar mapping of
+    the request's two coefficient knobs (family constants ride as
+    traced values so one executable serves every member)."""
+    return jnp.stack(family.scalars(cxs, cys), axis=1)[:, None, :]
+
+
+def _run_batch_pallas_family(u0, cxs, cys, *, steps, family):
+    from heat2d_tpu.ops.pallas_stencil import (_interpret, _mem_spaces,
+                                               _parallel_grid)
+
+    b, nx, ny = u0.shape
+    s = family.spec.n_scalars
+    scal = _scal_block(family, cxs, cys)
+    mspace, smem = _mem_spaces()
+    grid_spec = pl.GridSpec(
+        grid=(b,),
+        in_specs=[
+            pl.BlockSpec((1, 1, s), lambda i: (i, 0, 0), **smem),
+            pl.BlockSpec((1, nx, ny), lambda i: (i, 0, 0), **mspace),
+        ],
+        out_specs=pl.BlockSpec((1, nx, ny), lambda i: (i, 0, 0),
+                               **mspace),
+    )
+    return pl.pallas_call(
+        functools.partial(_family_ensemble_kernel, steps=steps,
+                          step_value=family.step_value,
+                          n_scalars=s),
+        out_shape=jax.ShapeDtypeStruct(u0.shape, u0.dtype),
+        grid_spec=grid_spec,
+        interpret=_interpret(),
+        **_parallel_grid(1))(scal, u0)
+
+
+# --------------------------------------------------------------------- #
+# band route — gathered-strip sweeps with halo depth h = w * T
+# --------------------------------------------------------------------- #
+
+def _family_band_kernel(s_ref, up_ref, u_ref, dn_ref, out_ref, *, bm,
+                        tsteps, w, nx, step_value, n_scalars):
+    j = pl.program_id(1)
+    h = w * tsteps
+    scalars = tuple(s_ref[0, 0, k] for k in range(n_scalars))
+    ext = jnp.concatenate([up_ref[0, 0], u_ref[0], dn_ref[0, 0]],
+                          axis=0)
+    gi = (j * bm - h
+          + jax.lax.broadcasted_iota(jnp.int32, (bm + 2 * h, 1), 0))
+    keep = (gi <= w - 1) | (gi >= nx - w)
+    from heat2d_tpu.ops.pallas_stencil import _unrolled_steps
+    out_ref[0] = _unrolled_steps(
+        tsteps,
+        lambda v: jnp.where(keep, v, step_value(v, *scalars)),
+        ext)[h:-h]
+
+
+def _family_band_sweep(scal, u, bm, tsteps, family, nx, ny):
+    from heat2d_tpu.ops.pallas_stencil import (_interpret, _mem_spaces,
+                                               _parallel_grid,
+                                               _row_strips)
+
+    b, m, n = u.shape
+    nblk = m // bm
+    w = family.spec.halo_width
+    s = family.spec.n_scalars
+    h = w * tsteps
+    zeros = jnp.zeros((b, 1, h, n), u.dtype)
+    ups, dns = _row_strips(u.reshape(b, nblk, bm, n), h, zeros, zeros)
+    mspace, smem = _mem_spaces()
+    grid_spec = pl.GridSpec(
+        grid=(b, nblk),
+        in_specs=[
+            pl.BlockSpec((1, 1, s), lambda i, j: (i, 0, 0), **smem),
+            pl.BlockSpec((1, 1, h, n), lambda i, j: (i, j, 0, 0),
+                         **mspace),
+            pl.BlockSpec((1, bm, n), lambda i, j: (i, j, 0), **mspace),
+            pl.BlockSpec((1, 1, h, n), lambda i, j: (i, j, 0, 0),
+                         **mspace),
+        ],
+        out_specs=pl.BlockSpec((1, bm, n), lambda i, j: (i, j, 0),
+                               **mspace),
+    )
+    return pl.pallas_call(
+        functools.partial(_family_band_kernel, bm=bm, tsteps=tsteps,
+                          w=w, nx=nx, step_value=family.step_value,
+                          n_scalars=s),
+        out_shape=jax.ShapeDtypeStruct(u.shape, u.dtype),
+        grid_spec=grid_spec,
+        interpret=_interpret(),
+        input_output_aliases={2: 0},
+        **_parallel_grid(2))(scal, ups, u, dns)
+
+
+def _run_batch_band_family(u0, cxs, cys, *, steps, family):
+    from heat2d_tpu.ops import pallas_stencil as ps
+
+    b, nx, ny = u0.shape
+    w = family.spec.halo_width
+    t = ps.DEFAULT_TSTEPS
+    bm, m_pad = ps._resolve_bands(nx, ny, u0.dtype, None)
+    # Shallow bands: the per-sweep halo depth h = w*t must stay below
+    # the band height (the heat5 shallow-band reduction scaled by w).
+    if bm <= 2 * w * t:
+        t = max(1, (bm - 1) // (2 * w))
+    ps._check_band_vmem(bm, w * t, ny, u0.dtype)
+    u = u0
+    if m_pad > nx:
+        u = jnp.pad(u, ((0, 0), (0, m_pad - nx), (0, 0)))
+    scal = _scal_block(family, cxs, cys)
+    nsweeps, rem = divmod(steps, t)
+    if nsweeps:
+        u = jax.lax.fori_loop(
+            0, nsweeps,
+            lambda _, v: _family_band_sweep(scal, v, bm, t, family,
+                                            nx, ny),
+            u, unroll=False)
+    if rem:
+        u = _family_band_sweep(scal, u, bm, rem, family, nx, ny)
+    return u[:, :nx] if m_pad > nx else u
+
+
+# --------------------------------------------------------------------- #
+# Dispatch — the ensemble layer's entry points
+# --------------------------------------------------------------------- #
+
+_ROUTE_RUNNERS = {
+    "jnp": _run_batch_jnp_family,
+    "pallas": _run_batch_pallas_family,
+    "band": _run_batch_band_family,
+}
+
+
+def fixed_runner(problem: str, route: str):
+    """The family's fixed-step batch runner for a resolved explicit
+    route — signature-compatible with ensemble._BATCH_RUNNERS values
+    (``(u0, cxs, cys, *, steps) -> batch``), so the convergence
+    chunked loop and the mesh shard_map wrap it unchanged."""
+    if problem == DEFAULT_PROBLEM:
+        from heat2d_tpu.models import ensemble
+        return ensemble._BATCH_RUNNERS[route]
+    try:
+        base = _ROUTE_RUNNERS[route]
+    except KeyError:
+        raise ValueError(
+            f"no generic batch runner for route {route!r} "
+            f"(explicit routes: {tuple(_ROUTE_RUNNERS)})") from None
+    return functools.partial(base, family=get_family(problem))

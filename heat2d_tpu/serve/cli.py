@@ -177,6 +177,55 @@ def _selftest_workload(client):
             failures.append("adi repeat not bitwise-identical")
     except Exception as e:  # noqa: BLE001 — report, don't crash
         failures.append(f"adi request failed: {e!r}")
+
+    f2, fail2 = _problems_workload(client)
+    return fired + f2, failures + fail2
+
+
+def _problems_workload(client):
+    """Every registered problem family end-to-end through the real
+    server path (admission -> bucketing -> ensemble launch), plus the
+    capability matrix's structured-rejection leg: reactdiff (nonlinear)
+    x adi must come back ``Rejected("unsupported_combination")``
+    NAMING the combination, never a crash (docs/PROBLEMS.md)."""
+    import numpy as np
+
+    from heat2d_tpu.serve.schema import Rejected, SolveRequest
+    from heat2d_tpu.vocab import PROBLEMS
+
+    fired = 0
+    failures = []
+    for fam in PROBLEMS:
+        if fam == "heat5":
+            continue    # the whole rest of the selftest is heat5
+        req = SolveRequest(nx=16, ny=16, steps=5, cx=0.1, cy=0.1,
+                           method="jnp", problem=fam)
+        try:
+            r = client.solve(req, timeout=120)
+            fired += 1
+            u = np.asarray(r.u)
+            if u.shape != (16, 16) or not np.isfinite(u).all():
+                failures.append(f"problem {fam}: bad result "
+                                f"(shape {u.shape})")
+        except Exception as e:  # noqa: BLE001 — report, don't crash
+            failures.append(f"problem {fam} request failed: {e!r}")
+    bad = SolveRequest(nx=16, ny=16, steps=5, cx=0.1, cy=0.1,
+                       method="adi", problem="reactdiff")
+    try:
+        client.solve(bad, timeout=60)
+        failures.append("reactdiff x adi was served (expected the "
+                        "unsupported_combination rejection)")
+    except Rejected as e:
+        if e.code != "unsupported_combination":
+            failures.append(f"reactdiff x adi rejected with "
+                            f"{e.code!r}, expected "
+                            f"'unsupported_combination'")
+        elif "reactdiff" not in e.message:
+            failures.append("unsupported_combination rejection does "
+                            "not name the problem")
+    except Exception as e:  # noqa: BLE001 — report, don't crash
+        failures.append(f"reactdiff x adi raised {e!r} instead of a "
+                        f"structured rejection")
     return fired, failures
 
 
@@ -231,6 +280,13 @@ def run_selftest(args, registry) -> int:
         failures.append("no cache hit recorded")
     if "serve_e2e_latency_s" not in snap["histograms"]:
         failures.append("no end-to-end latency recorded")
+    from heat2d_tpu.vocab import PROBLEMS
+    for fam in PROBLEMS:
+        if fam == "heat5":
+            continue
+        if snap["counters"].get(
+                f"problem_requests_total{{problem={fam}}}", 0) < 1:
+            failures.append(f"no launch counted for problem {fam}")
 
     print(f"selftest: {fired} requests -> {launches} launches, "
           f"occupancy max {occ['max'] if occ else 0:.0f}, "

@@ -91,7 +91,12 @@ class EnsembleEngine:
             return self.tuned[sig]
         tuned = None
         from heat2d_tpu.models import ensemble
-        if (ensemble._pick_method(req0.method, req0.nx, req0.ny)
+        # Tuned band configs are measured on the heat5 kernels; other
+        # families run the registry's generic runners, whose tuning
+        # entries live under their own problem-prefixed keys
+        # (tune/space.py) and are resolved inside _resolve_bands.
+        if (getattr(req0, "problem", "heat5") == "heat5"
+                and ensemble._pick_method(req0.method, req0.nx, req0.ny)
                 == "band" and not self._window_route(req0)):
             from heat2d_tpu.tune import runtime as tune_runtime
             # allow_window=False: the batched runner's LEGACY band
@@ -180,10 +185,11 @@ class EnsembleEngine:
         # (0, 0.0), never their unused interval/sensitivity, so one
         # signature maps to exactly one memoized runner.
         interval, sensitivity = req0.schedule()
+        problem = getattr(req0, "problem", "heat5")
         runner = ensemble.batch_runner(
             req0.nx, req0.ny, req0.steps, req0.method,
             convergence=req0.convergence, interval=interval,
-            sensitivity=sensitivity)
+            sensitivity=sensitivity, problem=problem)
 
         timer = (self.registry.timer("serve_launch_s")
                  if self.registry is not None else contextlib.nullcontext())
@@ -224,16 +230,20 @@ class EnsembleEngine:
                       "steps": req0.steps, "method": req0.method,
                       "convergence": req0.convergence,
                       "capacity": capacity, "dtype": "float32",
+                      "problem": problem,
                       "route": "batch"})
         roofline.stamp_launch_row(
             row, self.registry, nx=req0.nx, ny=req0.ny,
             steps=(sum(steps_done) / len(steps_done)
                    if req0.convergence else req0.steps),
             members=capacity, elapsed_s=elapsed, method=req0.method,
-            signature=str(req0.signature()), card=card)
+            signature=str(req0.signature()), card=card,
+            problem=problem)
         self.launch_log.append(row)
         if self.registry is not None:
             self.registry.counter("serve_launches_total")
+            self.registry.counter("problem_requests_total",
+                                  problem=problem)
             self.registry.gauge("serve_compile_cache_size",
                                 ensemble.batch_runner.cache_info().currsize)
         log.debug("launch %d: %dx%d steps=%d occupancy=%d/%d",

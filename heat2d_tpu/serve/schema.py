@@ -23,6 +23,8 @@ import dataclasses
 import hashlib
 import json
 
+from heat2d_tpu.vocab import DEFAULT_PROBLEM, PROBLEMS, SERVE_METHODS
+
 #: dtypes the batched ensemble runners are validated for (the reference
 #: stores f32; accum-dtype promotion is a CLI-solver concern, rejected
 #: at the ensemble entry — cli.py's unsupported-flag check).
@@ -33,8 +35,15 @@ SUPPORTED_DTYPES = ("float32",)
 #: are dt-scaled diffusion numbers far past the explicit kx+ky <= 1/2
 #: box — the ensemble runners dispatch them like any other method and
 #: the whole serving stack (signature bucketing, padded-capacity
-#: compile ladder, mesh sharding) absorbs them unchanged.
-SUPPORTED_METHODS = ("auto", "jnp", "pallas", "band", "adi", "mg")
+#: compile ladder, mesh sharding) absorbs them unchanged. Derived from
+#: the single-source method vocabulary (heat2d_tpu/vocab.py).
+SUPPORTED_METHODS = SERVE_METHODS
+
+#: Problem families a request may name (the spatial-operator axis,
+#: heat2d_tpu/problems/): per-family capability is validated at
+#: admission (method x problem from the declared matrix), so an
+#: unsupported combination is a structured rejection, never a crash.
+SUPPORTED_PROBLEMS = PROBLEMS
 
 
 class Rejected(Exception):
@@ -69,6 +78,11 @@ class SolveRequest:
     convergence: bool = False
     interval: int = 20
     sensitivity: float = 0.1
+    #: spatial-operator family (SUPPORTED_PROBLEMS). The default
+    #: "heat5" keeps every pre-registry request's spec, hash, and
+    #: signature unchanged (back-compat: load/replay.py parses
+    #: problem-less legacy signatures as heat5).
+    problem: str = "heat5"
     #: distributed-tracing context (obs/tracing.TraceContext) riding
     #: BESIDE the problem spec: compare=False keeps it out of eq/hash,
     #: and spec()/content_hash()/signature() never read it — two
@@ -92,6 +106,27 @@ class SolveRequest:
         if self.method not in SUPPORTED_METHODS:
             raise Rejected("invalid", f"method {self.method!r} not in "
                            f"{SUPPORTED_METHODS}")
+        if self.problem not in SUPPORTED_PROBLEMS:
+            raise Rejected("invalid", f"problem {self.problem!r} not "
+                           f"in {SUPPORTED_PROBLEMS}")
+        if self.problem != DEFAULT_PROBLEM:
+            # Capability matrix (problems/base.py, jax-free): an
+            # unsupported method x problem combination is a structured
+            # rejection NAMING the combination, never a crash.
+            from heat2d_tpu.problems.base import spec_for
+            spec = spec_for(self.problem)
+            ok, reason = spec.supports_method(self.method)
+            if not ok:
+                raise Rejected("unsupported_combination", reason,
+                               problem=self.problem,
+                               method=self.method)
+            if min(self.nx, self.ny) < spec.min_grid:
+                raise Rejected(
+                    "invalid",
+                    f"problem {self.problem!r} (halo width "
+                    f"{spec.halo_width}) needs a grid of at least "
+                    f"{spec.min_grid}x{spec.min_grid}, got "
+                    f"{self.nx}x{self.ny}")
         if self.convergence and self.interval < 1:
             raise Rejected("invalid", f"interval must be >= 1, got "
                            f"{self.interval}")
@@ -113,7 +148,7 @@ class SolveRequest:
         different kernels), so the spec stays plain data and 'auto'
         is its own cache/bucket key."""
         interval, sensitivity = self.schedule()
-        return {
+        d = {
             "nx": int(self.nx), "ny": int(self.ny),
             "steps": int(self.steps),
             "cx": float(self.cx), "cy": float(self.cy),
@@ -122,6 +157,12 @@ class SolveRequest:
             "interval": interval,
             "sensitivity": sensitivity,
         }
+        if self.problem != "heat5":
+            # heat5 hashes the pre-registry spec byte-identically (its
+            # cache keys and signature hashes are untouched by the
+            # registry); other families are their own cache entries.
+            d["problem"] = self.problem
+        return d
 
     def content_hash(self) -> str:
         """sha256 over the canonical JSON spec. repr-exact floats: two
@@ -134,9 +175,19 @@ class SolveRequest:
     def signature(self) -> tuple:
         """The compiled-signature bucket key: every spec field EXCEPT
         (cx, cy), which ride as traced operands through one executable.
-        Requests sharing a signature batch into one ensemble launch."""
-        return (self.nx, self.ny, self.steps, self.dtype, self.method,
+        Requests sharing a signature batch into one ensemble launch.
+
+        The problem family rides at index 8 — but ONLY for non-heat5
+        families: heat5 keeps the pre-registry 8-tuple byte-identical,
+        so its content hashes, rendezvous routing weights, recorded
+        trace campaigns, and tune-db consults are untouched by the
+        registry. load/replay.py parses both generations (8-tuples as
+        problem="heat5")."""
+        base = (self.nx, self.ny, self.steps, self.dtype, self.method,
                 self.convergence) + self.schedule()
+        if self.problem == "heat5":
+            return base
+        return base + (self.problem,)
 
     @classmethod
     def from_dict(cls, d: dict) -> "SolveRequest":

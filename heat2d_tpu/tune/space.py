@@ -65,14 +65,26 @@ DEFAULT_ADI_PANELS = (128, 256, 512, 1024)
 
 @dataclasses.dataclass(frozen=True)
 class Problem:
-    """A tuning problem: one single-chip stencil workload shape."""
+    """A tuning problem: one single-chip stencil workload shape.
+
+    ``problem`` is the spatial-operator family (heat2d_tpu/problems/):
+    measured step times are per-FAMILY (a 9-point sweep does different
+    arithmetic and halo traffic than the 5-point), so non-heat5
+    entries live under a ``<family>:`` key namespace — heat5 keeps the
+    legacy ``NXxNY:dtype`` format so every existing db entry keeps
+    resolving, and the prefix deliberately breaks the legacy parse so
+    family frontiers never shadow the heat5 lookup ladder."""
     nx: int
     ny: int
     dtype: str = "float32"
+    problem: str = "heat5"
 
     def key(self) -> str:
-        """The db problem key — shape and dtype; the route rides in the
-        candidate/entry, not the key (one frontier per shape)."""
+        """The db problem key — shape and dtype (legacy format for
+        heat5; ``<family>:NXxNY:dtype`` otherwise); the route rides in
+        the candidate/entry, not the key (one frontier per shape)."""
+        if self.problem != "heat5":
+            return f"{self.problem}:{self.nx}x{self.ny}:{self.dtype}"
         return f"{self.nx}x{self.ny}:{self.dtype}"
 
     def adi_key(self) -> str:
@@ -106,7 +118,22 @@ class Problem:
 
     @staticmethod
     def from_key(key: str) -> "Problem":
-        shape, dtype = key.split(":")
+        """Inverse of ``key()``: legacy 2-part keys are heat5;
+        3-part keys carry a registered family prefix. The ``adi:`` /
+        ``fused:`` route namespaces are NOT problems and stay
+        unparseable here on purpose (their prefixes are not family
+        names — callers query those keys verbatim)."""
+        parts = key.split(":")
+        if len(parts) == 3:
+            from heat2d_tpu.vocab import PROBLEMS
+            fam, shape, dtype = parts
+            if fam not in PROBLEMS:
+                raise ValueError(
+                    f"key {key!r} is not a problem key (prefix "
+                    f"{fam!r} is not a registered family)")
+            nx, ny = shape.split("x")
+            return Problem(int(nx), int(ny), dtype, problem=fam)
+        shape, dtype = parts
         nx, ny = shape.split("x")
         return Problem(int(nx), int(ny), dtype)
 

@@ -1,0 +1,83 @@
+"""The framework's shared vocabularies — ONE definition each, jax-free.
+
+Before this module the method vocabularies drifted independently:
+``config.TIME_METHODS``, ``diff.vocab.METHODS``, and
+``serve.schema.SUPPORTED_METHODS`` each hand-listed overlapping method
+names (the R005-style drift class: three lists that must agree and
+nothing checks). Every vocabulary now derives from the atoms here, and
+the new PROBLEMS vocabulary (the spatial-operator axis, PR 17) is born
+single-sourced.
+
+jax-free on purpose: config validation, serving admission
+(serve/schema.py), and the stability module all consume these on
+host-side paths that must import without jax.
+"""
+
+from __future__ import annotations
+
+# -- time discretization (the PR 14 axis) ------------------------------ #
+
+#: Unconditionally stable (A-stable) time-stepping routes — they skip
+#: the explicit stability box by design (ops/stability.py):
+#:   adi — Crank-Nicolson ADI (Peaceman-Rachford) on batched
+#:         tridiagonal Thomas solves (ops/tridiag.py)
+#:   mg  — unsplit Crank-Nicolson solved per step by geometric
+#:         multigrid V-cycles (ops/multigrid.py)
+IMPLICIT_METHODS = ("adi", "mg")
+
+#: Time-stepping schemes (config.method, docs/ALGORITHMS.md):
+#: "explicit" is the reference's forward-Euler update.
+TIME_METHODS = ("explicit",) + IMPLICIT_METHODS
+
+# -- single-chip kernel routes (the ensemble/serve axis) ---------------- #
+
+#: Explicit-scheme kernel routes of the batched ensemble runners:
+#:   jnp    — vmapped golden model
+#:   pallas — batched VMEM-resident kernel
+#:   band   — temporally-blocked HBM-streaming band kernel
+EXPLICIT_ROUTES = ("jnp", "pallas", "band")
+
+#: Everything a serve request's ``method`` may name: 'auto' resolves
+#: per shape, the explicit routes are kernel choices, and the implicit
+#: methods are different MATH (serve/schema.py admission contract).
+SERVE_METHODS = ("auto",) + EXPLICIT_ROUTES + IMPLICIT_METHODS
+
+#: Routes the differentiable subsystem's adjoints cover
+#: (diff/adjoint.py): the pallas single-instance kernel has no VJP
+#: registration and mg's V-cycle recursion is not differentiated —
+#: derived by EXCLUSION from the serve vocabulary so a new method
+#: must be classified here, not silently drifted.
+_NON_DIFFERENTIABLE = ("pallas", "mg")
+DIFF_METHODS = tuple(m for m in SERVE_METHODS
+                     if m not in _NON_DIFFERENTIABLE)
+
+# -- problem families (the spatial-operator axis, PR 17) ---------------- #
+
+#: Registered stencil/PDE families (heat2d_tpu/problems/):
+#:   heat5     — the reference's 5-point constant-coefficient heat
+#:               stencil (every pre-registry program, byte-identical)
+#:   varcoef   — variable-coefficient (heterogeneous-material)
+#:               diffusion, promoted from ops.stencil_step_var
+#:   heat9     — 4th-order 9-point (wide-stencil) heat operator,
+#:               halo width 2 (the Bandishti et al. generalization)
+#:   advdiff   — advection-diffusion (central advection + diffusion)
+#:   reactdiff — reaction-diffusion with a saturating NONLINEAR
+#:               source (Michaelis-Menten kinetics, r*u/(1+u))
+PROBLEMS = ("heat5", "varcoef", "heat9", "advdiff", "reactdiff")
+
+#: The default family — the reference problem. Every entry point
+#: defaults to it so pre-registry callers are untouched (jaxpr-pinned).
+DEFAULT_PROBLEM = "heat5"
+
+# -- fixed family constants (problems/base.py binds them) --------------- #
+
+#: advdiff's dimensionless advection velocities (v * dt / dx): fixed
+#: family constants today — the serve schema's two knobs stay (cx, cy)
+#: — chosen inside both the CFL and cell-Reynolds boxes at the default
+#: diffusivities (ops/stability.check_advdiff_stability).
+ADVECTION_VELOCITY = (0.1, 0.1)
+
+#: reactdiff's dimensionless reaction rate (r * dt) for the saturating
+#: source ``r * u / (1 + u)`` — inside the explicit reaction-rate
+#: bound (ops/stability.check_reactdiff_stability).
+REACTION_RATE = 0.25

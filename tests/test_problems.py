@@ -1,0 +1,393 @@
+"""Problem registry (heat2d_tpu/problems/): the pluggable spatial-
+operator axis — registry contract, per-family kernel/oracle parity,
+analytic accuracy, capability gating, per-family stability bounds,
+heat5 byte-identity pins, serve round-trips, replay back-compat, and
+the problem-namespaced tune keys (ISSUE 17 acceptance criteria)."""
+
+import numpy as np
+import pytest
+
+from heat2d_tpu import vocab
+from heat2d_tpu.config import ConfigError, HeatConfig
+from heat2d_tpu.problems import (FAMILY_SPECS, capability_matrix,
+                                 family_names, get_family, spec_for)
+from heat2d_tpu.problems import runners as prunners
+
+from tests._pin import (assert_jaxpr_equal, batch_runner_jaxpr,
+                        mesh_runner_jaxpr, solver_jaxpr)
+
+FAMILIES = vocab.PROBLEMS
+NEW_FAMILIES = tuple(f for f in FAMILIES if f != "heat5")
+
+
+def small_state(nx=12, ny=12, seed=0):
+    """A smooth positive O(1) field with a cold boundary ring — inside
+    every family's stable regime (reactdiff's saturating source is
+    bounded for any u >= 0; the ring matches the held-boundary
+    semantics all families share)."""
+    rng = np.random.default_rng(seed)
+    u = rng.uniform(0.2, 1.0, (nx, ny)).astype(np.float32)
+    return u
+
+
+# --------------------------------------------------------------------- #
+# registry contract
+# --------------------------------------------------------------------- #
+
+def test_registry_matches_vocabulary():
+    assert family_names() == FAMILIES
+    assert tuple(FAMILY_SPECS) == FAMILIES
+    assert vocab.DEFAULT_PROBLEM == "heat5"
+
+
+@pytest.mark.parametrize("fam", FAMILIES)
+def test_family_ships_the_contract(fam):
+    """Adding a family = one spec + the bound callables (registry
+    module docstring) — every registered family carries all of them,
+    with internally consistent declarations."""
+    f = get_family(fam)
+    s = f.spec
+    assert f.name == fam == s.name
+    assert callable(f.step) and callable(f.step_value)
+    assert callable(f.scalars) and callable(f.np_step)
+    assert s.halo_width >= 1
+    assert s.min_grid == 2 * s.halo_width + 1
+    assert s.state_arrays >= 1 and s.reads_per_step >= 1
+    # scalar mapping arity matches the declared SMEM operand count
+    import jax.numpy as jnp
+    ops = f.scalars(jnp.asarray([0.1]), jnp.asarray([0.1]))
+    assert len(ops) == s.n_scalars
+    # explicit families name at least one kernel route; implicit
+    # methods only appear on linear families (the ADI/MG gate)
+    assert "jnp" in s.kernel_routes
+    if not s.linear:
+        assert not any(m in s.time_methods for m in
+                       vocab.IMPLICIT_METHODS)
+
+
+def test_capability_matrix_shape():
+    m = capability_matrix()
+    assert set(m) == set(FAMILIES)
+    for fam, row in m.items():
+        assert set(row) == {"time_methods", "kernel_routes", "abft",
+                            "adjoint", "linear", "halo_width"}, fam
+    # heat5 inherits every serve method; the nonlinear family's gate
+    # reason NAMES the unsupported combination
+    for method in vocab.SERVE_METHODS:
+        ok, _ = spec_for("heat5").supports_method(method)
+        assert ok, f"heat5 lost method {method}"
+    ok, reason = spec_for("reactdiff").supports_method("adi")
+    assert not ok and "reactdiff" in reason and "adi" in reason
+
+
+# --------------------------------------------------------------------- #
+# numpy-oracle parity + route agreement
+# --------------------------------------------------------------------- #
+
+@pytest.mark.parametrize("fam", FAMILIES)
+def test_numpy_oracle_parity(fam):
+    """family.step (the jnp reference kernel) tracks the float64 numpy
+    golden oracle over a multi-step evolution."""
+    import jax.numpy as jnp
+    f = get_family(fam)
+    u = small_state(nx=max(12, f.spec.min_grid), ny=12)
+    uj, un = jnp.asarray(u), u.copy()
+    for _ in range(10):
+        uj = f.step(uj, 0.1, 0.12)
+        un = f.np_step(un, 0.1, 0.12)
+    np.testing.assert_allclose(np.asarray(uj), un, rtol=2e-5,
+                               atol=2e-6)
+
+
+@pytest.mark.parametrize("fam", NEW_FAMILIES)
+def test_kernel_routes_agree(fam):
+    """Every declared kernel route computes the same evolution: the
+    value-form Pallas/band templates against the jnp reference (the
+    two-kernel-forms contract the registry docstring pins)."""
+    import jax.numpy as jnp
+    f = get_family(fam)
+    nx = max(16, f.spec.min_grid)
+    b = 2
+    u0 = jnp.asarray(np.stack([small_state(nx, 16, seed=i)
+                               for i in range(b)]))
+    cxs = jnp.asarray([0.1, 0.08], jnp.float32)
+    cys = jnp.asarray([0.12, 0.1], jnp.float32)
+    ref = prunners.fixed_runner(fam, "jnp")(u0, cxs, cys, steps=7)
+    for route in f.spec.kernel_routes:
+        if route == "jnp":
+            continue
+        out = prunners.fixed_runner(fam, route)(u0, cxs, cys, steps=7)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=2e-5, atol=2e-6,
+                                   err_msg=f"{fam}:{route}")
+
+
+def test_heat9_analytic_mode_factor():
+    """The 4th-order operator damps the lowest sine mode by EXACTLY
+    ``1 - cx*lam4(kx) - cy*lam4(ky)`` in one step (the discrete sine
+    is an eigenvector of the wide stencil at step 0) — the family's
+    analytic accuracy oracle, checked in float64."""
+    f = get_family("heat9")
+    nx = ny = 17
+    i = np.arange(nx)[:, None]
+    j = np.arange(ny)[None, :]
+    u = (np.sin(np.pi * i / (nx - 1))
+         * np.sin(np.pi * j / (ny - 1))).astype(np.float64)
+    cx, cy = 0.1, 0.12
+    stepped = f.np_step(u, cx, cy)
+    factor = f.mode_factor(nx, ny, cx, cy)
+    c = (slice(2, -2), slice(2, -2))
+    np.testing.assert_allclose(stepped[c], factor * u[c], rtol=1e-12)
+    # 4th-order: lam4 approximates the continuous k^2 far better than
+    # the 2nd-order 3-point eigenvalue does (the reason the family
+    # exists) — accuracy, not just stability.
+    k = np.pi / (nx - 1)
+    lam4 = (30.0 - 32.0 * np.cos(k) + 2.0 * np.cos(2 * k)) / 12.0
+    lam2 = 2.0 - 2.0 * np.cos(k)
+    assert abs(lam4 - k * k) < abs(lam2 - k * k) / 50.0
+
+
+def test_heat5_family_is_the_reference_kernel():
+    """The heat5 entry binds the EXISTING kernels (no second copy of
+    the hot math), and its band/pallas batch runners are literally the
+    legacy ensemble runners (not generic twins)."""
+    from heat2d_tpu.models import ensemble
+    from heat2d_tpu.ops.stencil import stencil_step
+
+    f = get_family("heat5")
+    u = np.asarray(small_state())
+    import jax.numpy as jnp
+    np.testing.assert_array_equal(
+        np.asarray(f.step(jnp.asarray(u), 0.1, 0.1)),
+        np.asarray(stencil_step(jnp.asarray(u), 0.1, 0.1)))
+    for route in ("jnp", "pallas", "band"):
+        assert prunners.fixed_runner("heat5", route) \
+            is ensemble._BATCH_RUNNERS[route]
+
+
+# --------------------------------------------------------------------- #
+# heat5 byte-identity pins
+# --------------------------------------------------------------------- #
+
+def test_heat5_jaxpr_pins():
+    """Naming problem='heat5' anywhere on the dispatch spine traces
+    the SAME program as the pre-registry call shape — the solver, the
+    serve batch runner, and the mesh-sharded runner are byte-identical
+    (the aggressive-refactor safety anchor)."""
+    assert_jaxpr_equal(solver_jaxpr(), solver_jaxpr(problem="heat5"),
+                       label="solver")
+    assert_jaxpr_equal(batch_runner_jaxpr(),
+                       batch_runner_jaxpr(problem="heat5"),
+                       label="batch_runner")
+    assert_jaxpr_equal(mesh_runner_jaxpr(n_devices=2),
+                       mesh_runner_jaxpr(n_devices=2, problem="heat5"),
+                       label="mesh_runner")
+
+
+def test_heat5_band_runner_jaxpr_pin():
+    """The batched band runner's program with the problem axis named
+    vs not — the HBM-sized serve kernel path stays byte-identical."""
+    assert_jaxpr_equal(
+        batch_runner_jaxpr(nx=64, ny=128, steps=10, method="band"),
+        batch_runner_jaxpr(nx=64, ny=128, steps=10, method="band",
+                           problem="heat5"),
+        label="band_runner")
+
+
+# --------------------------------------------------------------------- #
+# capability gating + stability bounds at validation
+# --------------------------------------------------------------------- #
+
+def test_pick_route_enforces_matrix():
+    assert prunners.pick_route("heat5", "auto", 16, 16) in ("pallas",
+                                                           "band")
+    assert prunners.pick_route("varcoef", "auto", 16, 16) == "jnp"
+    with pytest.raises(ConfigError, match="varcoef"):
+        prunners.pick_route("varcoef", "band", 16, 16)
+    with pytest.raises(ConfigError, match="reactdiff"):
+        prunners.pick_route("reactdiff", "adi", 16, 16)
+
+
+@pytest.mark.parametrize("fam", NEW_FAMILIES)
+def test_config_accepts_each_family_serial(fam):
+    cfg = HeatConfig(nxprob=max(12, spec_for(fam).min_grid),
+                     nyprob=12, steps=4, problem=fam)
+    assert cfg.problem == fam
+
+
+def test_config_rejects_with_named_bounds():
+    # heat9: tighter diffusion box, the 16/3 worst eigenvalue
+    with pytest.raises(ConfigError, match=r"0\.375"):
+        HeatConfig(nxprob=12, nyprob=12, steps=4, problem="heat9",
+                   cx=0.2, cy=0.2)
+    # advdiff: the cell-Reynolds bound names v^2 <= 2c
+    with pytest.raises(ConfigError, match="cell-Reynolds"):
+        HeatConfig(nxprob=12, nyprob=12, steps=4, problem="advdiff",
+                   cx=0.001, cy=0.1)
+    # halo-width floor: heat9 needs 5x5
+    with pytest.raises(ConfigError, match="at least 5x5"):
+        HeatConfig(nxprob=4, nyprob=12, steps=4, problem="heat9")
+    # implicit methods stay heat5-only (the linearity gate)
+    with pytest.raises(ConfigError, match="heat9"):
+        HeatConfig(nxprob=12, nyprob=12, steps=4, problem="heat9",
+                   method="adi")
+    # non-heat5 families run the serial solver mode only
+    with pytest.raises(ConfigError, match="serial"):
+        HeatConfig(nxprob=12, nyprob=12, steps=4, problem="advdiff",
+                   mode="dist2d", gridx=2, gridy=2)
+    with pytest.raises(ConfigError, match="must be one of"):
+        HeatConfig(nxprob=12, nyprob=12, steps=4, problem="heat7")
+
+
+# --------------------------------------------------------------------- #
+# serve round-trips + back-compat
+# --------------------------------------------------------------------- #
+
+def test_serve_roundtrip_every_family():
+    """One request per family through the real server path: admitted,
+    bucketed, launched, answered finite — and the reactdiff x adi
+    combination is a structured rejection naming the combination."""
+    from heat2d_tpu.obs import MetricsRegistry
+    from heat2d_tpu.serve import Rejected, SolveRequest, SolveServer
+
+    registry = MetricsRegistry()
+    with SolveServer(registry=registry, max_delay=0.02) as server:
+        for fam in NEW_FAMILIES:
+            nx = max(16, spec_for(fam).min_grid)
+            r = server.solve(SolveRequest(nx=nx, ny=16, steps=5,
+                                          cx=0.1, cy=0.1, method="jnp",
+                                          problem=fam), timeout=120)
+            u = np.asarray(r.u)
+            assert u.shape == (nx, 16) and np.isfinite(u).all(), fam
+        with pytest.raises(Rejected) as ei:
+            server.solve(SolveRequest(nx=16, ny=16, steps=5,
+                                      method="adi",
+                                      problem="reactdiff"), timeout=60)
+        assert ei.value.code == "unsupported_combination"
+        assert "reactdiff" in ei.value.message
+    snap = registry.snapshot()
+    for fam in NEW_FAMILIES:
+        key = "problem_requests_total{problem=%s}" % fam
+        assert snap["counters"].get(key, 0) >= 1, key
+
+
+def test_serve_signature_and_hash_carry_problem():
+    from heat2d_tpu.serve import SolveRequest
+
+    a = SolveRequest(nx=16, ny=16, steps=5, method="jnp")
+    b = SolveRequest(nx=16, ny=16, steps=5, method="jnp",
+                     problem="heat9")
+    assert a.signature() != b.signature()
+    assert a.content_hash() != b.content_hash()
+    # problem rides at index 8, after the legacy 8-tuple — which heat5
+    # keeps byte-identical (hashes, rendezvous routing, trace
+    # campaigns, tune consults are untouched by the registry)
+    assert len(a.signature()) == 8 and "problem" not in a.spec()
+    assert b.signature()[8] == "heat9"
+    assert a.signature() == b.signature()[:8]
+
+
+def test_replay_parses_both_signature_generations():
+    """Pre-registry trace campaigns recorded 8-tuple solve signatures:
+    they replay as heat5; current 9-tuples carry the family."""
+    import random
+
+    from heat2d_tpu.load.replay import spec_from_signature
+
+    rng = random.Random(0)
+    legacy = (20, 24, 8, "float32", "jnp", False, 0, 0.0)
+    kind, spec = spec_from_signature(legacy, rng)
+    assert kind == "solve"
+    assert "problem" not in spec      # heat5 spec stays byte-identical
+    kind, spec = spec_from_signature(legacy + ("advdiff",), rng)
+    assert kind == "solve" and spec["problem"] == "advdiff"
+    kind, spec = spec_from_signature(legacy + ("heat5",), rng)
+    assert "problem" not in spec
+    with pytest.raises(ValueError, match="malformed"):
+        spec_from_signature(legacy[:7], rng)
+    with pytest.raises(ValueError, match="malformed"):
+        spec_from_signature(legacy + ("advdiff", "extra"), rng)
+
+
+def test_mesh_scheduler_problem_routing():
+    """The resource model prices a member by its declared state-array
+    count, and oversized non-heat5 members route single (the spatial
+    decomposition is heat5-only) — served, never rejected."""
+    from heat2d_tpu.mesh.scheduler import MeshScheduler, grid_bytes
+    from heat2d_tpu.serve import SolveRequest
+
+    assert grid_bytes(16, 16, problem="varcoef") == \
+        3 * grid_bytes(16, 16)
+    sched = MeshScheduler(n_devices=2, spatial_bytes_threshold=1024)
+    big = SolveRequest(nx=64, ny=64, steps=2, method="jnp",
+                       problem="heat9")
+    d = sched.decide(big)
+    assert d["route"] == "single"
+    assert d["reason"] == "problem_spatial"
+    small = SolveRequest(nx=12, ny=12, steps=2, method="jnp",
+                         problem="heat9")
+    assert sched.decide(small)["route"] == "batch"
+
+
+def test_mesh_runner_serves_families():
+    """The mesh-sharded runner advances a non-heat5 family identically
+    to the single-chip batch runner (whole members shard, so the wrap
+    is family-independent), and the ABFT gate rejects non-heat5
+    arming with the declared reason."""
+    import jax.numpy as jnp
+
+    from heat2d_tpu.mesh.runner import mesh_batch_runner
+    from heat2d_tpu.models import ensemble
+
+    run = mesh_batch_runner(12, 12, 5, "jnp", n_devices=2,
+                            problem="advdiff")
+    u0 = jnp.asarray(np.stack([small_state(seed=i) for i in range(2)]))
+    cxs = jnp.asarray([0.1, 0.08], jnp.float32)
+    cys = jnp.asarray([0.12, 0.1], jnp.float32)
+    got = np.asarray(run(u0, cxs, cys))
+    want = np.asarray(ensemble.batch_runner(
+        12, 12, 5, "jnp", problem="advdiff")(u0, cxs, cys))
+    np.testing.assert_array_equal(got, want)
+    with pytest.raises(ValueError, match="reactdiff"):
+        mesh_batch_runner(12, 12, 5, "jnp", n_devices=2, abft=True,
+                          problem="reactdiff")
+
+
+# --------------------------------------------------------------------- #
+# tune keys + roofline resource model
+# --------------------------------------------------------------------- #
+
+def test_tune_keys_namespace_families():
+    from heat2d_tpu.tune.space import Problem
+
+    legacy = Problem(64, 128)
+    assert legacy.key() == "64x128:float32"
+    fam = Problem(64, 128, problem="heat9")
+    assert fam.key() == "heat9:64x128:float32"
+    rt = Problem.from_key(fam.key())
+    assert (rt.nx, rt.ny, rt.problem) == (64, 128, "heat9")
+    assert Problem.from_key("64x128:float32").problem == "heat5"
+    # the adi:/fused: namespaces must NOT parse as problem keys
+    with pytest.raises(ValueError):
+        Problem.from_key("adi:64x128:float32")
+
+
+def test_roofline_bytes_model_per_family():
+    """varcoef streams its two coefficient fields beside the state
+    (3x the jnp-route traffic); the calibrated bound stays heat5-only
+    (honestly absent elsewhere)."""
+    from heat2d_tpu.obs import roofline
+
+    base = roofline.analytic_bytes_per_cell_step(
+        64, 64, method="jnp", problem="heat5")
+    var = roofline.analytic_bytes_per_cell_step(
+        64, 64, method="jnp", problem="varcoef")
+    assert var["bytes_per_cell_step"] == \
+        pytest.approx(2.0 * base["bytes_per_cell_step"])
+    row = {}
+    roofline.stamp_launch_row(row, None, nx=16, ny=16, steps=5,
+                              members=2, elapsed_s=0.01, method="jnp",
+                              signature="sig", problem="advdiff")
+    assert row["perf"]["bound_mcells_per_s"] is None
+    assert row["perf"]["pct_of_bound"] is None
+    assert row["perf"]["bytes_per_cell_step"] > 0
