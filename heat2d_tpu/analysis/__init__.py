@@ -1,10 +1,14 @@
 """Static analysis + correctness tooling (docs/ANALYSIS.md).
 
-Three engines and one CLI:
+Four engines and one CLI:
 
 - ``analysis.lint`` — AST linter for the repo's hand-enforced
   conventions (rules R001-R006), gated in CI by ``heat2d-tpu-lint``
   (analysis/cli.py) at zero non-baselined findings.
+- ``analysis.ir`` (+ ``footprint``, ``dtype_flow``) — jaxpr IR
+  verifier: offset-interval footprint analysis, dtype cast census,
+  and collective-contract checks over every registered program,
+  gated in CI by ``heat2d-tpu-lint --ir`` at zero findings.
 - ``analysis.locks`` — audited drop-in locks: lock-order inversion
   (deadlock-cycle) detection plus ``@guarded_by`` guarded-state
   checking, opt-in via ``HEAT2D_LOCK_AUDIT=1``, zero overhead off.

@@ -6,6 +6,12 @@ justification, at ``analysis/baseline.json``). ``--format json`` emits
 machine-readable findings for tooling; stale baseline entries (the
 finding was fixed but its entry lingers) are reported so the baseline
 only ever shrinks deliberately.
+
+``--ir`` switches from source lint to the jaxpr IR verifier
+(analysis/ir.py): it traces every registered (family × route) batch
+program plus the dist2d sharded programs on an 8-device simulated
+mesh and checks the declared footprint / dtype / collective contracts
+— rc 1 on any finding. This is the CI ``ir-gate`` entry point.
 """
 
 from __future__ import annotations
@@ -35,6 +41,43 @@ def default_baseline_path() -> str:
                         "baseline.json")
 
 
+def _run_ir(args) -> int:
+    """The ``--ir`` mode: force the 8-device sim mesh BEFORE jax
+    initializes a backend (the collective pass degrades gracefully on
+    fewer devices but the gate wants the full sweep), then run the
+    verifier and render findings."""
+    from heat2d_tpu.utils.platform import force_host_devices
+
+    force_host_devices(8)
+    from heat2d_tpu.analysis import ir
+
+    try:
+        rep = ir.verify_all()
+    except Exception as e:      # a crash must fail the gate loudly
+        print(f"heat2d-tpu-lint --ir: verifier error: "
+              f"{type(e).__name__}: {e}", file=sys.stderr)
+        return 2
+    if args.format == "json":
+        print(json.dumps({
+            "findings": [vars(f) for f in rep.findings],
+            "footprint_rows": [
+                {**r, "derived": list(r["derived"]) if r["derived"]
+                 else None,
+                 "witness": list(r["witness"]) if r["witness"]
+                 else None}
+                for r in rep.footprint_rows],
+            "cards": [{"program": c.program,
+                       "casts": [c2.describe() for c2 in c.casts]}
+                      for c in rep.cards],
+            "collectives": rep.collective_rows,
+            "notes": rep.notes,
+            "ok": rep.ok,
+        }, indent=2))
+    else:
+        print(ir.render_report(rep, verbose=args.verbose))
+    return 0 if rep.ok else 1
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(
         prog="heat2d-tpu-lint",
@@ -54,7 +97,18 @@ def main(argv=None) -> int:
     p.add_argument("--docs", default=None,
                    help="docs directory for the drift rule "
                         "(default: <root>/docs)")
+    p.add_argument("--ir", action="store_true",
+                   help="run the jaxpr IR verifier (footprint, "
+                        "dtype-flow, collective contracts) over every "
+                        "registered program instead of the source "
+                        "lint rules")
+    p.add_argument("--verbose", action="store_true",
+                   help="with --ir: print precision cards and "
+                        "collective censuses, not just findings")
     args = p.parse_args(argv)
+
+    if args.ir:
+        return _run_ir(args)
 
     root = args.root or _default_root()
     rules = ([r.strip() for r in args.rules.split(",") if r.strip()]

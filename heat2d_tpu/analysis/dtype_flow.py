@@ -1,0 +1,224 @@
+"""Dtype-flow census — per-program precision cards over jaxpr IR.
+
+ROADMAP item 2 (bf16 storage / f32 compute) will make implicit dtype
+casts the platform's dominant correctness hazard: one stray
+``convert_element_type`` inside a traced kernel silently halves (or
+doubles) the precision of every cell-step. This pass makes every cast
+in a traced program *visible and accountable*:
+
+- ``census_casts`` walks a ClosedJaxpr (descending into pjit / scan /
+  while / cond / shard_map / pallas_call sub-jaxprs) and records every
+  ``convert_element_type`` / ``reduce_precision`` equation with its
+  provenance path — the chain of enclosing sub-jaxpr primitives, with
+  jitted-function names (``pjit[_linspace]``) so a finding points at
+  the Python source that introduced the cast.
+- ``PrecisionCard`` is the per-program report: the full cast list plus
+  ``findings(allowlist)`` — precision-relevant casts (a floating dtype
+  on either side, dtype actually changed) not covered by the program's
+  declared allowlist. Pure integer/bool index casts are listed on the
+  card but are never findings: they cannot lose field precision.
+
+The allowlist lives in the registry (``FamilySpec.cast_allowlist``),
+mirroring the lint baseline's justified-entries workflow: a cast is
+either declared where the family is declared, or it is a finding. An
+allowlist entry that matches nothing is NOT an error — casts can be
+flag-dependent (x64 tracing inserts float64→float32 narrowings that
+non-x64 tracing never creates), and an entry must stay valid under
+both.
+
+Host-side only: operates on ``jax.make_jaxpr`` output, never runs a
+program (analysis/ir.py proves the sweep leaves traced programs
+byte-identical).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Iterable, List, Sequence, Tuple
+
+import numpy as np
+
+#: cast-like primitives the census records
+CAST_PRIMS = ("convert_element_type", "reduce_precision")
+
+
+@dataclasses.dataclass(frozen=True)
+class CastSite:
+    """One (src → dst, provenance) cast class in a traced program.
+    ``count`` aggregates identical sites (a vmapped/scanned cast traces
+    once per call site, not per lane)."""
+
+    src: str
+    dst: str
+    #: enclosing sub-jaxpr chain, outermost first, e.g.
+    #: ("pjit[_linspace]",); () for a top-level cast
+    path: Tuple[str, ...]
+    count: int = 1
+
+    @property
+    def precision_relevant(self) -> bool:
+        """Involves a floating dtype and actually changes dtype —
+        the class of casts that can create/destroy field precision."""
+        if self.src == self.dst:
+            return False
+        return (np.issubdtype(np.dtype(self.src), np.inexact)
+                or np.issubdtype(np.dtype(self.dst), np.inexact))
+
+    @property
+    def narrowing(self) -> bool:
+        """Loses mantissa/width (the dangerous direction)."""
+        try:
+            return (np.dtype(self.src).itemsize
+                    > np.dtype(self.dst).itemsize)
+        except TypeError:
+            return False
+
+    def describe(self) -> str:
+        where = "/".join(self.path) if self.path else "<top>"
+        arrow = "⤓" if self.narrowing else "→"
+        n = f" ×{self.count}" if self.count > 1 else ""
+        return f"{self.src} {arrow} {self.dst} at {where}{n}"
+
+
+def _eqn_label(eqn) -> str:
+    """Provenance label for a sub-jaxpr-carrying eqn: primitive name,
+    plus the jitted/scanned function name when the params carry one."""
+    name = eqn.primitive.name
+    fn = eqn.params.get("name")
+    if fn:
+        return f"{name}[{fn}]"
+    return name
+
+
+def _sub_jaxprs(eqn):
+    for v in eqn.params.values():
+        vals = v if isinstance(v, (list, tuple)) else [v]
+        for s in vals:
+            if hasattr(s, "jaxpr") and hasattr(
+                    getattr(s, "jaxpr"), "eqns"):
+                yield s.jaxpr            # ClosedJaxpr
+            elif hasattr(s, "eqns"):
+                yield s                  # raw Jaxpr
+
+
+def census_casts(closed) -> List[CastSite]:
+    """Every cast eqn in ``closed`` (a ClosedJaxpr or Jaxpr),
+    recursively, aggregated by (src, dst, provenance path)."""
+    jaxpr = getattr(closed, "jaxpr", closed)
+    agg: Dict[Tuple[str, str, Tuple[str, ...]], int] = {}
+
+    def walk(jx, path: Tuple[str, ...]) -> None:
+        for eqn in jx.eqns:
+            name = eqn.primitive.name
+            if name in CAST_PRIMS and eqn.invars:
+                aval = getattr(eqn.invars[0], "aval", None)
+                src = str(np.dtype(aval.dtype)) if aval is not None \
+                    else "?"
+                if name == "reduce_precision":
+                    dst = (f"reduced[e{eqn.params.get('exponent_bits')}"
+                           f"m{eqn.params.get('mantissa_bits')}]")
+                else:
+                    dst = str(np.dtype(eqn.params["new_dtype"]))
+                if src != dst:
+                    key = (src, dst, path)
+                    agg[key] = agg.get(key, 0) + 1
+            for sub in _sub_jaxprs(eqn):
+                walk(sub, path + (_eqn_label(eqn),))
+
+    walk(jaxpr, ())
+    return [CastSite(src=s, dst=d, path=p, count=c)
+            for (s, d, p), c in sorted(agg.items(),
+                                       key=lambda kv: kv[0])]
+
+
+@dataclasses.dataclass
+class PrecisionCard:
+    """Per-program cast report: everything on the card, findings only
+    for precision-relevant casts outside the declared allowlist."""
+
+    program: str
+    casts: List[CastSite]
+
+    def findings(self, allowlist: Iterable[Tuple[str, str]] = ()
+                 ) -> List[CastSite]:
+        allowed = {tuple(a) for a in allowlist}
+        return [c for c in self.casts
+                if c.precision_relevant
+                and (c.src, c.dst) not in allowed]
+
+    def lines(self) -> List[str]:
+        if not self.casts:
+            return [f"{self.program}: no casts"]
+        out = [f"{self.program}: {len(self.casts)} cast site(s)"]
+        out.extend(f"  {c.describe()}" for c in self.casts)
+        return out
+
+
+def precision_card(program: str, fn, *args, **kwargs) -> PrecisionCard:
+    """Trace ``fn(*args)`` and build its precision card."""
+    import jax
+
+    closed = jax.make_jaxpr(fn)(*args, **kwargs)
+    return PrecisionCard(program=program, casts=census_casts(closed))
+
+
+# ------------------------------------------------------------------ #
+# collective census — shares the recursive walker (analysis/ir.py's
+# collective-contract pass consumes this)
+# ------------------------------------------------------------------ #
+
+#: cross-device communication primitives worth a contract
+COLLECTIVE_PRIMS = ("ppermute", "psum", "pmin", "pmax", "all_gather",
+                    "all_to_all", "reduce_scatter", "pgather",
+                    "psum_scatter", "pbroadcast")
+
+#: trace-time aliases: jax versions split some collectives into
+#: rewrite-pass twins (psum traces as ``psum2`` under modern
+#: shard_map); the census reports the canonical name so contracts
+#: stay version-independent
+_CANONICAL = {"psum2": "psum", "all_gather_invariant": "all_gather"}
+
+
+@dataclasses.dataclass(frozen=True)
+class CollectiveSite:
+    """One collective primitive occurrence class in a traced program."""
+
+    prim: str
+    path: Tuple[str, ...]
+    count: int = 1
+    #: for ppermute: the flattened (src, dst) pairs (dedup'd)
+    perms: Tuple[Tuple[int, int], ...] = ()
+
+    def describe(self) -> str:
+        where = "/".join(self.path) if self.path else "<top>"
+        n = f" ×{self.count}" if self.count > 1 else ""
+        return f"{self.prim} at {where}{n}"
+
+
+def census_collectives(closed) -> List[CollectiveSite]:
+    """Every collective eqn in ``closed``, recursively, aggregated by
+    (primitive, provenance path); ppermute sites carry their
+    permutation pairs so contract checks can assert nearest-neighbor
+    structure."""
+    jaxpr = getattr(closed, "jaxpr", closed)
+    agg: Dict[Tuple[str, Tuple[str, ...]], List] = {}
+
+    def walk(jx, path: Tuple[str, ...]) -> None:
+        for eqn in jx.eqns:
+            name = _CANONICAL.get(eqn.primitive.name,
+                                  eqn.primitive.name)
+            if name in COLLECTIVE_PRIMS:
+                key = (name, path)
+                entry = agg.setdefault(key, [0, set()])
+                entry[0] += 1
+                perm = eqn.params.get("perm")
+                if perm:
+                    entry[1].update((int(a), int(b)) for a, b in perm)
+            for sub in _sub_jaxprs(eqn):
+                walk(sub, path + (_eqn_label(eqn),))
+
+    walk(jaxpr, ())
+    return [CollectiveSite(prim=p, path=pa, count=c,
+                           perms=tuple(sorted(perms)))
+            for (p, pa), (c, perms) in sorted(agg.items(),
+                                              key=lambda kv: kv[0])]

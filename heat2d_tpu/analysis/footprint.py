@@ -1,0 +1,635 @@
+"""Static spatial-footprint derivation — an offset-interval abstract
+interpreter over jaxprs.
+
+The problem registry (problems/base.py) is a *declared* contract:
+``FamilySpec.halo_width`` drives the ghost-row depth the band kernels
+gather (``w * T`` rows per sweep), the boundary-ring width every
+keep-mask holds, and the shard-seam geometry of the fused halo route.
+Nothing checked those declarations against what the traced kernels
+actually *do* — a family whose kernel reads one row wider than its
+declared halo silently corrupts shard seams. This module derives the
+TRUE spatial access radius of a kernel from its jaxpr, so the registry
+contract becomes machine-checked (analysis/ir.py wires it into the
+``ir-gate``).
+
+Abstract domain: per traced array, per axis, an **offset interval**
+``[lo, hi]`` meaning "element ``j`` of this array depends on tracked-
+input elements in ``[j+lo, j+hi]``". The tracked input (the state grid
+``u``) starts at ``[0, 0]``; arrays with no data dependence on it are
+``BOT`` (coefficient fields, iota masks, scalars); anything the domain
+cannot express collapses to ``TOP`` carrying the primitive that caused
+it (an *underivable* footprint is a finding, never a silent pass).
+
+Transfer functions cover the primitives stencil kernels lower to —
+``slice`` / ``pad`` / ``concatenate`` / ``scatter``-as-update /
+``dynamic_(update_)slice`` / ``conv_general_dilated`` / ``transpose``
+/ elementwise joins — plus descent into ``pjit``/call sub-jaxprs.
+(``jnp.roll`` lowers to concatenate-of-slices, so rolls ride the
+slice/concatenate rules.) Every interval bound carries the name of the
+primitive that last widened it, so a footprint violation NAMES the
+responsible primitive, not just the number.
+
+As a side product the interpreter counts **coefficient-field reads**:
+distinct interior-sized arrays with no dependence on ``u`` that feed
+``u``-dependent arithmetic (varcoef's per-cell diffusivity fields).
+``1 + coef_reads`` is the static witness for the declared
+``FamilySpec.reads_per_step`` — the number the roofline ledger's
+bytes/cell-step model streams (obs/roofline.py).
+
+Pure host-side: everything here runs on ``jax.make_jaxpr`` output and
+never executes a program.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+#: elementwise primitives: output dependence = join of operand
+#: dependences (operands of lower rank are broadcast constants)
+ELEMENTWISE = {
+    "add", "sub", "mul", "div", "neg", "max", "min", "pow",
+    "integer_pow", "exp", "log", "tanh", "sqrt", "rsqrt", "abs",
+    "sign", "floor", "ceil", "round", "rem", "select_n", "and", "or",
+    "xor", "not", "eq", "ne", "lt", "le", "gt", "ge", "square",
+    "logistic", "erf", "sin", "cos", "tan", "atan2", "clamp",
+    "is_finite", "nextafter", "copy", "stop_gradient", "real", "imag",
+    "convert_element_type", "reduce_precision",
+}
+
+#: primitives that never carry a data dependence out of thin air
+PURE_SOURCES = {"iota", "broadcast_in_dim"}
+
+
+class _Top:
+    """Underivable dependence; remembers the primitive that caused it."""
+
+    __slots__ = ("why",)
+
+    def __init__(self, why: str):
+        self.why = why
+
+    def __repr__(self):
+        return f"TOP({self.why})"
+
+
+@dataclasses.dataclass(frozen=True)
+class Interval:
+    """Per-axis offset intervals with witness primitives per bound."""
+
+    lo: Tuple[int, ...]
+    hi: Tuple[int, ...]
+    wit_lo: Tuple[str, ...]
+    wit_hi: Tuple[str, ...]
+
+    @classmethod
+    def zero(cls, rank: int, wit: str = "input") -> "Interval":
+        return cls((0,) * rank, (0,) * rank, (wit,) * rank,
+                   (wit,) * rank)
+
+    def shift(self, axis: int, delta: int, wit: str) -> "Interval":
+        delta = int(delta)      # padding configs carry np.int64
+        lo, hi = list(self.lo), list(self.hi)
+        wl, wh = list(self.wit_lo), list(self.wit_hi)
+        lo[axis] += delta
+        hi[axis] += delta
+        if delta:
+            wl[axis], wh[axis] = wit, wit
+        return Interval(tuple(lo), tuple(hi), tuple(wl), tuple(wh))
+
+    def widen(self, axis: int, lo: int, hi: int, wit: str) -> "Interval":
+        lo, hi = int(lo), int(hi)
+        nlo, nhi = list(self.lo), list(self.hi)
+        wl, wh = list(self.wit_lo), list(self.wit_hi)
+        if self.lo[axis] + lo < nlo[axis]:
+            nlo[axis] += lo
+            wl[axis] = wit
+        else:
+            nlo[axis] += lo
+        if self.hi[axis] + hi > nhi[axis]:
+            nhi[axis] += hi
+            wh[axis] = wit
+        else:
+            nhi[axis] += hi
+        return Interval(tuple(nlo), tuple(nhi), tuple(wl), tuple(wh))
+
+
+def _join(a: Optional[Interval], b: Optional[Interval]):
+    """Lattice join. ``None`` is BOT; ``_Top`` dominates."""
+    if isinstance(a, _Top):
+        return a
+    if isinstance(b, _Top):
+        return b
+    if a is None:
+        return b
+    if b is None:
+        return a
+    if len(a.lo) != len(b.lo):
+        return _Top("rank-mismatched join")
+    lo, hi, wl, wh = [], [], [], []
+    for i in range(len(a.lo)):
+        if a.lo[i] <= b.lo[i]:
+            lo.append(a.lo[i])
+            wl.append(a.wit_lo[i])
+        else:
+            lo.append(b.lo[i])
+            wl.append(b.wit_lo[i])
+        if a.hi[i] >= b.hi[i]:
+            hi.append(a.hi[i])
+            wh.append(a.wit_hi[i])
+        else:
+            hi.append(b.hi[i])
+            wh.append(b.wit_hi[i])
+    return Interval(tuple(lo), tuple(hi), tuple(wl), tuple(wh))
+
+
+@dataclasses.dataclass
+class FootprintResult:
+    """Derived dependence of a program's first output on its tracked
+    input array."""
+
+    #: per-axis (lo, hi) offsets, or None when underivable
+    lo: Optional[Tuple[int, ...]]
+    hi: Optional[Tuple[int, ...]]
+    #: primitive that set each bound (names the culprit in findings)
+    wit_lo: Tuple[str, ...]
+    wit_hi: Tuple[str, ...]
+    #: when not None: the primitive the domain could not express
+    top: Optional[str]
+    #: distinct interior-sized non-input-dependent arrays feeding
+    #: input-dependent arithmetic (coefficient fields)
+    coef_reads: int
+
+    @property
+    def derivable(self) -> bool:
+        return self.top is None and self.lo is not None
+
+    def radius(self, axis: int) -> int:
+        """max(|lo|, hi): the stencil access radius along ``axis``."""
+        assert self.lo is not None and self.hi is not None
+        return max(-self.lo[axis], self.hi[axis], 0)
+
+    def radii(self) -> Tuple[int, ...]:
+        assert self.lo is not None
+        return tuple(self.radius(a) for a in range(len(self.lo)))
+
+    def witness(self, axis: int) -> str:
+        """The primitive responsible for the widest offset on ``axis``."""
+        assert self.lo is not None and self.hi is not None
+        if -self.lo[axis] >= self.hi[axis]:
+            return self.wit_lo[axis]
+        return self.wit_hi[axis]
+
+
+# ------------------------------------------------------------------ #
+# constant folding for index operands (scatter/dus starts)
+# ------------------------------------------------------------------ #
+
+_CONST_MAX_SIZE = 16
+
+
+def _literal_const(var) -> Optional[np.ndarray]:
+    val = getattr(var, "val", None)
+    if val is None:
+        return None
+    arr = np.asarray(val)
+    if arr.size <= _CONST_MAX_SIZE:
+        return arr
+    return None
+
+
+def _fold_const(eqn, const_env: Dict[int, np.ndarray],
+                operands: List[Optional[np.ndarray]]):
+    """Tiny integer constant folder: enough to resolve the index
+    vectors ``.at[].set`` builds (broadcast of literal -> concatenate)."""
+    name = eqn.primitive.name
+    try:
+        if name == "broadcast_in_dim" and operands[0] is not None:
+            return np.broadcast_to(
+                operands[0], eqn.params["shape"]).copy()
+        if name == "concatenate" and all(
+                o is not None for o in operands):
+            return np.concatenate(operands,
+                                  axis=eqn.params["dimension"])
+        if name == "convert_element_type" and operands[0] is not None:
+            return operands[0].astype(
+                np.dtype(eqn.params["new_dtype"]))
+        if name in ("reshape", "squeeze") and operands[0] is not None:
+            shape = eqn.params.get("new_sizes")
+            if shape is None:
+                shape = eqn.outvars[0].aval.shape
+            return operands[0].reshape(shape)
+        if name in ("add", "sub", "mul") and all(
+                o is not None for o in operands):
+            op = {"add": np.add, "sub": np.subtract,
+                  "mul": np.multiply}[name]
+            return op(operands[0], operands[1])
+    except Exception:
+        return None
+    return None
+
+
+# ------------------------------------------------------------------ #
+# the interpreter
+# ------------------------------------------------------------------ #
+
+def _axis_map(old_shape, new_shape) -> Optional[Dict[int, int]]:
+    """Map old axis -> new axis for reshapes that only insert/remove
+    unit axes (the ``expand_dims`` pattern conv kernels use); None for
+    genuine reshapes."""
+    old_nz = [(i, d) for i, d in enumerate(old_shape) if d != 1]
+    new_nz = [(i, d) for i, d in enumerate(new_shape) if d != 1]
+    if [d for _, d in old_nz] != [d for _, d in new_nz]:
+        return None
+    return {o: n for (o, _), (n, _) in zip(old_nz, new_nz)}
+
+
+def _remap(val: Interval, amap: Dict[int, int], old_rank: int,
+           new_rank: int, wit: str):
+    """Carry intervals through a unit-axis reshape. Dropped axes must
+    carry no offset (a unit axis cannot hold a stencil footprint)."""
+    lo = [0] * new_rank
+    hi = [0] * new_rank
+    wl = [wit] * new_rank
+    wh = [wit] * new_rank
+    for o in range(old_rank):
+        if o in amap:
+            n = amap[o]
+            lo[n], hi[n] = val.lo[o], val.hi[o]
+            wl[n], wh[n] = val.wit_lo[o], val.wit_hi[o]
+        elif val.lo[o] != 0 or val.hi[o] != 0:
+            return _Top(wit)
+    return Interval(tuple(lo), tuple(hi), tuple(wl), tuple(wh))
+
+
+class _Interp:
+    def __init__(self):
+        self.env: Dict[int, object] = {}        # id(var) -> dep value
+        self.const: Dict[int, np.ndarray] = {}  # id(var) -> folded const
+        #: id(root var) of coefficient-field reads (dep-free interior-
+        #: sized arrays feeding dep-carrying eqns), keyed by the var's
+        #: *view root* so two slices of one field count once
+        self.coef_roots: Dict[int, Tuple[int, ...]] = {}
+        self.view_parent: Dict[int, int] = {}   # pure-view lineage
+        self.min_interior: Optional[Tuple[int, ...]] = None
+
+    # -- env plumbing ------------------------------------------------ #
+
+    def read(self, var):
+        if hasattr(var, "val"):        # Literal
+            return None
+        return self.env.get(id(var))
+
+    def read_const(self, var) -> Optional[np.ndarray]:
+        lit = _literal_const(var)
+        if lit is not None:
+            return lit
+        return self.const.get(id(var))
+
+    def write(self, var, val) -> None:
+        self.env[id(var)] = val
+
+    def root_of(self, var) -> int:
+        vid = id(var)
+        seen = set()
+        while vid in self.view_parent and vid not in seen:
+            seen.add(vid)
+            vid = self.view_parent[vid]
+        return vid
+
+    def note_coef_read(self, eqn) -> None:
+        """An eqn whose output depends on the tracked input: any
+        dep-free interior-sized float operand is a coefficient-field
+        read."""
+        if self.min_interior is None:
+            return
+        for v in eqn.invars:
+            if hasattr(v, "val"):
+                continue
+            aval = getattr(v, "aval", None)
+            if aval is None or not hasattr(aval, "shape"):
+                continue
+            if len(aval.shape) != len(self.min_interior):
+                continue
+            if not np.issubdtype(np.dtype(aval.dtype), np.floating):
+                continue
+            if any(d < m for d, m in zip(aval.shape,
+                                         self.min_interior)):
+                continue
+            if self.read(v) is None:    # BOT: no input dependence
+                self.coef_roots[self.root_of(v)] = tuple(aval.shape)
+
+    # -- eqn dispatch ------------------------------------------------ #
+
+    def eval_jaxpr(self, jaxpr, in_vals: Sequence[object],
+                   const_vals: Optional[Sequence[object]] = None):
+        for var, val in zip(jaxpr.invars, in_vals):
+            self.write(var, val)
+        consts = const_vals if const_vals is not None else \
+            [None] * len(jaxpr.constvars)
+        for var, val in zip(jaxpr.constvars, consts):
+            self.write(var, val)
+        for eqn in jaxpr.eqns:
+            self.eval_eqn(eqn)
+        return [self.read(v) for v in jaxpr.outvars]
+
+    def _sub_jaxprs(self, eqn):
+        subs = []
+        for v in eqn.params.values():
+            vals = v if isinstance(v, (list, tuple)) else [v]
+            for s in vals:
+                if hasattr(s, "jaxpr") and hasattr(s, "consts"):
+                    subs.append(s.jaxpr)
+                elif hasattr(s, "eqns"):
+                    subs.append(s)
+        return subs
+
+    def eval_eqn(self, eqn) -> None:
+        name = eqn.primitive.name
+        in_vals = [self.read(v) for v in eqn.invars]
+        in_consts = [self.read_const(v) for v in eqn.invars]
+
+        folded = _fold_const(eqn, self.const, in_consts)
+        if folded is not None and len(eqn.outvars) == 1:
+            self.const[id(eqn.outvars[0])] = folded
+
+        out = self.transfer(eqn, name, in_vals, in_consts)
+        if any(isinstance(v, Interval) for v in in_vals) or \
+                isinstance(out, Interval):
+            if isinstance(out, (Interval, _Top)) or out is None:
+                if any(isinstance(v, Interval) for v in in_vals):
+                    self.note_coef_read(eqn)
+        if isinstance(out, list):
+            for var, val in zip(eqn.outvars, out):
+                self.write(var, val)
+        else:
+            for var in eqn.outvars:
+                self.write(var, out)
+
+    # -- transfer functions ------------------------------------------ #
+
+    def transfer(self, eqn, name, in_vals, in_consts):
+        deps = [v for v in in_vals if v is not None]
+        if not deps:
+            return None                 # closed under no-dependence
+        if any(isinstance(v, _Top) for v in deps):
+            return next(v for v in deps if isinstance(v, _Top))
+
+        out_rank = None
+        if eqn.outvars and hasattr(eqn.outvars[0], "aval") and \
+                hasattr(eqn.outvars[0].aval, "shape"):
+            out_rank = len(eqn.outvars[0].aval.shape)
+
+        if name in ELEMENTWISE:
+            out = None
+            for v, var in zip(in_vals, eqn.invars):
+                if v is None:
+                    continue
+                rank = len(var.aval.shape)
+                if out_rank is not None and rank != out_rank:
+                    return _Top(name)   # dep value broadcast up
+                # implicit dim-1 broadcast of a dep value loses the
+                # per-element correspondence on that axis
+                if any(d1 == 1 and d2 != 1 for d1, d2 in zip(
+                        var.aval.shape, eqn.outvars[0].aval.shape)):
+                    return _Top(name)
+                out = _join(out, v)
+            return out
+
+        if name == "slice":
+            v = in_vals[0]
+            strides = eqn.params.get("strides")
+            if strides is not None and any(s != 1 for s in strides):
+                return _Top("slice[strided]")
+            for axis, start in enumerate(eqn.params["start_indices"]):
+                v = v.shift(axis, start, "slice")
+            return v
+
+        if name == "pad":
+            v, pad_val = in_vals[0], in_vals[1]
+            if pad_val is not None:
+                return _Top("pad")
+            for axis, (lo, _hi, interior) in enumerate(
+                    eqn.params["padding_config"]):
+                if interior:
+                    return _Top("pad[interior]")
+                v = v.shift(axis, -lo, "pad")
+            return v
+
+        if name == "concatenate":
+            dim = eqn.params["dimension"]
+            out = None
+            offset = 0
+            for v, var in zip(in_vals, eqn.invars):
+                size = var.aval.shape[dim]
+                if v is not None and not isinstance(v, _Top):
+                    out = _join(out, v.shift(dim, -offset,
+                                             "concatenate"))
+                elif isinstance(v, _Top):
+                    return v
+                offset += size
+            return out
+
+        if name in ("transpose",):
+            perm = eqn.params["permutation"]
+            v = in_vals[0]
+            lo = tuple(v.lo[p] for p in perm)
+            hi = tuple(v.hi[p] for p in perm)
+            wl = tuple(v.wit_lo[p] for p in perm)
+            wh = tuple(v.wit_hi[p] for p in perm)
+            return Interval(lo, hi, wl, wh)
+
+        if name in ("reshape", "squeeze", "expand_dims"):
+            v = in_vals[0]
+            old = eqn.invars[0].aval.shape
+            new = eqn.outvars[0].aval.shape
+            amap = _axis_map(old, new)
+            if amap is None:
+                return _Top(name)
+            return _remap(v, amap, len(old), len(new), name)
+
+        if name == "broadcast_in_dim":
+            # unit-axis insertion of a dep value (the x[None, None]
+            # idiom); genuine fan-out of a dep value loses per-element
+            # correspondence -> TOP
+            v = in_vals[0]
+            bdims = eqn.params["broadcast_dimensions"]
+            old_shape = eqn.invars[0].aval.shape
+            new_shape = tuple(eqn.params["shape"])
+            if any(old_shape[o] != new_shape[n]
+                   for o, n in enumerate(bdims)):
+                return _Top("broadcast_in_dim")
+            rank = len(new_shape)
+            lo = [0] * rank
+            hi = [0] * rank
+            wl = ["broadcast_in_dim"] * rank
+            wh = ["broadcast_in_dim"] * rank
+            for o, n in enumerate(bdims):
+                lo[n], hi[n] = v.lo[o], v.hi[o]
+                wl[n], wh[n] = v.wit_lo[o], v.wit_hi[o]
+            return Interval(tuple(lo), tuple(hi), tuple(wl),
+                            tuple(wh))
+
+        if name == "dynamic_slice":
+            v = in_vals[0]
+            starts = [self.read_const(s) for s in eqn.invars[1:]]
+            if any(s is None for s in starts) or any(
+                    iv is not None for iv in in_vals[1:]):
+                return _Top("dynamic_slice")
+            for axis, s in enumerate(starts):
+                v = v.shift(axis, int(s), "dynamic_slice")
+            return v
+
+        if name == "dynamic_update_slice":
+            operand, update = in_vals[0], in_vals[1]
+            starts = [self.read_const(s) for s in eqn.invars[2:]]
+            if any(s is None for s in starts):
+                return _Top("dynamic_update_slice")
+            out = operand
+            if update is not None:
+                u = update
+                for axis, s in enumerate(starts):
+                    u = u.shift(axis, -int(s), "dynamic_update_slice")
+                out = _join(out, u)
+            return out
+
+        if name == "scatter":
+            return self._scatter(eqn, in_vals)
+
+        if name == "conv_general_dilated":
+            return self._conv(eqn, in_vals)
+
+        if name in ("pjit", "closed_call", "core_call", "remat",
+                    "remat2", "checkpoint", "custom_jvp_call",
+                    "custom_vjp_call", "custom_jvp_call_jaxpr",
+                    "custom_vjp_call_jaxpr", "named_call"):
+            subs = self._sub_jaxprs(eqn)
+            if len(subs) >= 1:
+                sub = subs[0]
+                if len(sub.invars) == len(eqn.invars):
+                    outs = _Interp._spawn(self).eval_jaxpr(sub, in_vals)
+                    if len(outs) == len(eqn.outvars):
+                        return list(outs)
+            return _Top(name)
+
+        return _Top(name)
+
+    @staticmethod
+    def _spawn(parent: "_Interp") -> "_Interp":
+        child = _Interp()
+        child.min_interior = parent.min_interior
+        child.coef_roots = parent.coef_roots      # shared accounting
+        child.view_parent = parent.view_parent
+        return child
+
+    def _scatter(self, eqn, in_vals):
+        """The ``.at[a:b, c:d].set`` lowering: a full-window scatter at
+        constant start indices == dynamic_update_slice."""
+        dnums = eqn.params.get("dimension_numbers")
+        operand, _idx, update = in_vals[0], in_vals[1], in_vals[2]
+        rank = len(eqn.invars[0].aval.shape)
+        starts = self.read_const(eqn.invars[1])
+        if dnums is None or starts is None:
+            return _Top("scatter")
+        if (tuple(dnums.update_window_dims) != tuple(range(rank))
+                or dnums.inserted_window_dims
+                or tuple(dnums.scatter_dims_to_operand_dims)
+                != tuple(range(rank))):
+            return _Top("scatter")
+        starts = np.ravel(starts)
+        if starts.size != rank:
+            return _Top("scatter")
+        if in_vals[1] is not None:
+            return _Top("scatter[traced indices]")
+        out = operand
+        if update is not None:
+            u = update
+            for axis in range(rank):
+                u = u.shift(axis, -int(starts[axis]), "scatter")
+            out = _join(out, u)
+        return out
+
+    def _conv(self, eqn, in_vals):
+        """Stride-1 spatial convolution: out[j] depends on
+        in[j - pad_lo .. j - pad_lo + (k-1)*dil]."""
+        lhs, rhs = in_vals[0], in_vals[1]
+        if rhs is not None:
+            return _Top("conv_general_dilated[traced rhs]")
+        if lhs is None:
+            return None
+        p = eqn.params
+        dn = p["dimension_numbers"]
+        strides = p["window_strides"]
+        if any(s != 1 for s in strides):
+            return _Top("conv_general_dilated[strided]")
+        if any(d != 1 for d in p.get("lhs_dilation") or []):
+            return _Top("conv_general_dilated[lhs-dilated]")
+        rhs_dil = p.get("rhs_dilation") or [1] * len(strides)
+        k_shape = eqn.invars[1].aval.shape
+        v = lhs
+        for i, (lhs_ax, rhs_ax, out_ax) in enumerate(zip(
+                dn.lhs_spec[2:], dn.rhs_spec[2:], dn.out_spec[2:])):
+            if lhs_ax != out_ax:
+                return _Top("conv_general_dilated[axis-permuted]")
+            pad_lo, _pad_hi = p["padding"][i]
+            reach = (k_shape[rhs_ax] - 1) * rhs_dil[i]
+            v = v.widen(lhs_ax, -pad_lo, reach - pad_lo,
+                        "conv_general_dilated")
+        # batch/feature axes of the output must carry no offset
+        return v
+
+
+def derive_footprint(fn, *example_args, track: int = 0,
+                     interior_margin: int = 8) -> FootprintResult:
+    """Trace ``fn(*example_args)`` and derive the dependence of its
+    first output on positional argument ``track`` (the state grid).
+
+    ``interior_margin``: arrays are counted as coefficient-field reads
+    only when every dim is within ``interior_margin`` of the tracked
+    input's dims (grid-sized or interior-sized, not reduced summaries).
+    """
+    import jax
+
+    closed = jax.make_jaxpr(fn)(*example_args)
+    jaxpr = closed.jaxpr
+    tracked = jaxpr.invars[track]
+    rank = len(tracked.aval.shape)
+    interp = _Interp()
+    interp.min_interior = tuple(
+        max(1, d - interior_margin) for d in tracked.aval.shape)
+    # record view lineage for coefficient-read dedup (pure views only)
+    for eqn in _walk_eqns(jaxpr):
+        if eqn.primitive.name in ("slice", "convert_element_type",
+                                  "broadcast_in_dim", "reshape",
+                                  "squeeze", "transpose") \
+                and len(eqn.outvars) == 1 and eqn.invars \
+                and not hasattr(eqn.invars[0], "val"):
+            interp.view_parent[id(eqn.outvars[0])] = id(eqn.invars[0])
+
+    in_vals: List[object] = [None] * len(jaxpr.invars)
+    in_vals[track] = Interval.zero(rank)
+    outs = interp.eval_jaxpr(jaxpr, in_vals)
+    out = outs[0] if outs else None
+    coef = len(interp.coef_roots)
+    if isinstance(out, _Top):
+        return FootprintResult(None, None, (), (), out.why, coef)
+    if out is None:
+        return FootprintResult((0,) * rank, (0,) * rank,
+                               ("none",) * rank, ("none",) * rank,
+                               None, coef)
+    return FootprintResult(out.lo, out.hi, out.wit_lo, out.wit_hi,
+                           None, coef)
+
+
+def _walk_eqns(jaxpr):
+    for eqn in jaxpr.eqns:
+        yield eqn
+        for v in eqn.params.values():
+            vals = v if isinstance(v, (list, tuple)) else [v]
+            for s in vals:
+                if hasattr(s, "jaxpr") and hasattr(s, "consts"):
+                    yield from _walk_eqns(s.jaxpr)
+                elif hasattr(s, "eqns"):
+                    yield from _walk_eqns(s)

@@ -63,6 +63,20 @@ HOST_CALLS = {
     "Thread", "submit", "partial",
 }
 
+#: registry-binding callees whose function-valued keyword arguments
+#: become traced scopes ACROSS modules: the problems registry binds
+#: kernels as ``Family(step=_k.heat5_step, ...)`` in a different
+#: module from the kernel definitions, so the per-module traced-scope
+#: fixpoint alone never sees them — a wall-clock/RNG leak inside a new
+#: family's kernel would lint clean. ``lint_tree`` collects the bound
+#: names in a cross-file pre-pass and seeds them as R002 roots.
+REGISTRY_BINDERS = {"Family"}
+
+#: Family(...) keyword fields whose values run under trace (np_step is
+#: the host-side numpy oracle, mode_factor is host-side scheduling
+#: math — neither is traced)
+REGISTRY_TRACED_FIELDS = {"step", "step_value", "scalars"}
+
 #: wall-clock / RNG call chains banned inside traced scopes (R002)
 WALLCLOCK_ROOTS = {"time", "random"}
 WALLCLOCK_DATETIME_ATTRS = {"now", "utcnow", "today"}
@@ -73,7 +87,7 @@ METRIC_METHODS = {"counter", "gauge", "observe", "series", "timer"}
 #: are not part of the documented contract)
 METRIC_RE = re.compile(
     r"^(serve|fleet|resil|tune|inverse|slo|load|control|mesh|adi|mg"
-    r"|perf|problem)_[a-z0-9_]+$")
+    r"|perf|problem|ir|analysis)_[a-z0-9_]+$")
 
 #: keyword names whose literal string values name a metric family
 #: (e.g. ``SingleFlight(counter="fleet_coalesced_total")``)
@@ -229,18 +243,45 @@ def _function_nodes_within(fn: ast.AST) -> Iterable[ast.AST]:
             yield sub
 
 
-def _traced_functions(tree: ast.Module, scopes: _Scopes) -> Set[ast.AST]:
+def registry_bound_names(trees: Dict[str, ast.Module]) -> Set[str]:
+    """Cross-file pre-pass: function names bound into the problems
+    registry's traced slots (``Family(step=..., step_value=...,
+    scalars=...)``) anywhere in the tree. These seed the per-module
+    traced-scope fixpoint, so kernels reached only through registry
+    dispatch are visible to R002/R003."""
+    bound: Set[str] = set()
+    for tree in trees.values():
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if _terminal_name(node.func) not in REGISTRY_BINDERS:
+                continue
+            for kw in node.keywords:
+                if kw.arg not in REGISTRY_TRACED_FIELDS:
+                    continue
+                if isinstance(kw.value, (ast.Name, ast.Attribute)):
+                    name = _terminal_name(kw.value)
+                    if name:
+                        bound.add(name)
+    return bound
+
+
+def _traced_functions(tree: ast.Module, scopes: _Scopes,
+                      extra_roots: Set[str] = frozenset()
+                      ) -> Set[ast.AST]:
     """The traced-scope set: functions handed to jit/pallas_call/
     shard_map/lax control flow (directly, by name, or through
     ``functools.partial``), ``*_kernel`` functions (the Pallas kernel
-    convention), functions decorated with a tracer, everything
+    convention), functions decorated with a tracer, names bound into
+    the problems registry's traced slots (``extra_roots`` — the
+    cross-file ``registry_bound_names`` pre-pass), everything
     lexically nested in those — then closed over same-module calls
     (a traced body calling a module-level helper traces the helper)."""
     roots: Set[ast.AST] = set()
 
     for fn in scopes.functions:
         name = getattr(fn, "name", "")
-        if name.endswith("_kernel"):
+        if name.endswith("_kernel") or name in extra_roots:
             roots.add(fn)
         for deco in getattr(fn, "decorator_list", []):
             for sub in ast.walk(deco):
@@ -374,10 +415,11 @@ def _rule_r001(rel: str, tree: ast.Module, scopes: _Scopes,
 
 
 def _rule_r002_r003(rel: str, tree: ast.Module, scopes: _Scopes,
-                    src_lines: List[str],
-                    rules: Set[str]) -> List[Finding]:
+                    src_lines: List[str], rules: Set[str],
+                    extra_roots: Set[str] = frozenset()
+                    ) -> List[Finding]:
     out: List[Finding] = []
-    traced = _traced_functions(tree, scopes)
+    traced = _traced_functions(tree, scopes, extra_roots)
     if not traced:
         return out
     traced_params: Dict[ast.AST, Set[str]] = {}
@@ -558,7 +600,7 @@ def _code_metric_names(trees: Dict[str, ast.Module]) -> Tuple[
 
 _DOC_METRIC_RE = re.compile(
     r"`((?:serve|fleet|resil|tune|inverse|slo|load|control|mesh|adi|mg"
-    r"|perf|problem)_[a-z0-9_*]+)"
+    r"|perf|problem|ir|analysis)_[a-z0-9_*]+)"
     r"(?:\{[^`]*\})?`")
 
 
@@ -654,6 +696,8 @@ def lint_tree(root: str, rules: Optional[Iterable[str]] = None,
         trees[rel] = tree
         sources[rel] = src.splitlines()
 
+    bound = registry_bound_names(trees) if active & {"R002", "R003"} \
+        else set()
     for rel, tree in trees.items():
         scopes = _Scopes(tree)
         lines = sources[rel]
@@ -661,7 +705,8 @@ def lint_tree(root: str, rules: Optional[Iterable[str]] = None,
             findings.extend(_rule_r001(rel, tree, scopes, lines))
         if active & {"R002", "R003"}:
             findings.extend(_rule_r002_r003(rel, tree, scopes, lines,
-                                            active))
+                                            active,
+                                            extra_roots=bound))
         if "R004" in active:
             findings.extend(_rule_r004(rel, tree, scopes, lines))
         if "R006" in active:

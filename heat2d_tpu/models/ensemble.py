@@ -587,14 +587,12 @@ def _run_batch_band(u0, cxs, cys, *, steps):
             ps._check_band_vmem(bm, t, ny, u0.dtype)
             return _run_batch_window(u0, cxs, cys, steps=steps, bm=bm,
                                      m_pad=m_pad, t=t)
-    # _resolve_bands, not plan_bands: with a tuning db active the
-    # member-shape's measured bm replaces the heuristic (validated
-    # against the resource model by the hook); without one this IS
-    # plan_bands, program-identical.
-    bm, m_pad = ps._resolve_bands(nx, ny, u0.dtype, None)
-    if bm <= 2 * t:
-        t = max(1, (bm - 1) // 2)   # shallow bands: reduce sweep depth
-    ps._check_band_vmem(bm, t, ny, u0.dtype)
+    # band_plan wraps _resolve_bands, not plan_bands: with a tuning db
+    # active the member-shape's measured bm replaces the heuristic
+    # (validated against the resource model by the hook); without one
+    # this IS plan_bands, program-identical. The shared plan is also
+    # what the IR verifier checks traced strip depths against.
+    bm, m_pad, t, _ = ps.band_plan(nx, ny, u0.dtype, tsteps=t)
     u = u0
     if m_pad > nx:
         u = jnp.pad(u, ((0, 0), (0, m_pad - nx), (0, 0)))

@@ -30,6 +30,7 @@ from __future__ import annotations
 
 import functools
 import os
+from typing import NamedTuple
 
 import jax
 import jax.numpy as jnp
@@ -645,6 +646,41 @@ def band_multi_step(u, tsteps: int, cx: float, cy: float,
 #: band needs bm > 2T rows) and by diminishing returns once traffic per
 #: step is ~grid_bytes/T; 8 cuts HBM traffic ~8x.
 DEFAULT_TSTEPS = 8
+
+
+class BandPlan(NamedTuple):
+    """The gathered-strip band schedule for one (grid, halo width):
+    band height, padded row count, resolved temporal depth, and the
+    per-sweep ghost-row depth ``halo_rows = halo_width * tsteps`` the
+    strips actually ship. ONE place this geometry lives — the heat5
+    and family-generic band runners consume it, and the IR verifier
+    (analysis/ir.py) re-derives the expected strip depth from it when
+    checking a traced band program's pallas_call operand shapes."""
+
+    bm: int
+    m_pad: int
+    tsteps: int
+    halo_width: int
+
+    @property
+    def halo_rows(self) -> int:
+        return self.halo_width * self.tsteps
+
+
+def band_plan(m: int, n: int, dtype, halo_width: int = 1,
+              tsteps: int | None = None) -> BandPlan:
+    """Resolve the gathered-strip band schedule: band height from the
+    tuning db / planner (``_resolve_bands``), then the shallow-band
+    reduction — the per-sweep halo depth ``w*T`` must stay below the
+    band height, so shallow bands reduce the sweep depth to
+    ``(bm-1) // (2w)`` — then the VMEM fast-fail at the resolved
+    depth."""
+    t = DEFAULT_TSTEPS if tsteps is None else tsteps
+    bm, m_pad = _resolve_bands(m, n, dtype, None)
+    if bm <= 2 * halo_width * t:
+        t = max(1, (bm - 1) // (2 * halo_width))
+    _check_band_vmem(bm, halo_width * t, n, dtype)
+    return BandPlan(bm, m_pad, t, halo_width)
 
 
 # --------------------------------------------------------------------- #

@@ -38,6 +38,30 @@ from heat2d_tpu.utils.profiling import phase
 #: clamped to the shard size in make_local_chunk.
 DEFAULT_HALO_DEPTH = 8
 
+#: The DECLARED communication contract of every sharded halo route —
+#: what the IR verifier's collective pass (analysis/ir.py) checks each
+#: traced shard_map program against. The halo exchange is exactly 4
+#: ppermutes per chunk (2 N/S strip shifts + 2 E/W shifts of the
+#: vertically-extended edge columns — parallel/halo.py), every
+#: permutation is a nearest-neighbor non-wrapping pair, psum appears
+#: only for the convergence residual, and the gather-family
+#: collectives are categorically forbidden: an accidental all_gather
+#: turns O(halo) bytes into O(grid) bytes per step — the classic
+#: silent 100x regression this contract exists to catch.
+#: ``pbroadcast`` is modern shard_map's replication *annotation* (vma
+#: bookkeeping), not a data transfer.
+COLLECTIVE_CONTRACT = {
+    "allowed": ("ppermute", "psum", "pbroadcast"),
+    "forbidden": ("all_gather", "all_to_all", "reduce_scatter",
+                  "pgather", "psum_scatter"),
+    #: ppermutes per halo exchange; every traced exchange site must
+    #: carry a positive multiple of this.
+    "ppermutes_per_exchange": 4,
+    #: |src - dst| for every permutation pair (non-wrapping
+    #: nearest-neighbor shifts; edge shards receive zeros).
+    "neighbor_distance": 1,
+}
+
 
 def _mesh_axes(mesh: Mesh, axes=None) -> tuple[str, str, int, int]:
     """(ax, ay, gx, gy) of the SPATIAL mesh axes. For the plain 2-axis

@@ -80,6 +80,14 @@ class FamilySpec:
     #: why the non-declared methods are missing — quoted verbatim by
     #: the gates' structured errors.
     gate_reason: str
+    #: declared dtype casts the IR verifier's dtype-flow pass accepts
+    #: in this family's traced programs: (src, dst) dtype-name pairs,
+    #: the registry twin of the lint baseline's justified entries
+    #: (analysis/dtype_flow.py). Entries may be flag-dependent (x64
+    #: tracing inserts narrowings non-x64 tracing never creates), so
+    #: an entry matching nothing is valid, but a cast matching no
+    #: entry is a finding.
+    cast_allowlist: Tuple[Tuple[str, str], ...] = ()
 
     @property
     def min_grid(self) -> int:
@@ -141,7 +149,11 @@ FAMILY_SPECS = {
         time_methods=("explicit",),
         kernel_routes=("jnp",),
         abft=False, adjoint=True,
-        gate_reason=_IMPLICIT_5PT),
+        gate_reason=_IMPLICIT_5PT,
+        # Under x64 tracing the coefficient-field builder's
+        # jnp.linspace computes float64 endpoints narrowed to the f32
+        # fields; the fields themselves are f32 end-to-end.
+        cast_allowlist=(("float64", "float32"),)),
     "heat9": FamilySpec(
         name="heat9",
         title="4th-order 9-point (wide-stencil) heat",

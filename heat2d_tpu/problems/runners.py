@@ -194,13 +194,10 @@ def _run_batch_band_family(u0, cxs, cys, *, steps, family):
 
     b, nx, ny = u0.shape
     w = family.spec.halo_width
-    t = ps.DEFAULT_TSTEPS
-    bm, m_pad = ps._resolve_bands(nx, ny, u0.dtype, None)
-    # Shallow bands: the per-sweep halo depth h = w*t must stay below
-    # the band height (the heat5 shallow-band reduction scaled by w).
-    if bm <= 2 * w * t:
-        t = max(1, (bm - 1) // (2 * w))
-    ps._check_band_vmem(bm, w * t, ny, u0.dtype)
+    # The shared gathered-strip schedule (shallow-band reduction keeps
+    # the per-sweep halo depth w*t below the band height) — the same
+    # plan the IR verifier re-derives when checking traced strip depths.
+    bm, m_pad, t, _ = ps.band_plan(nx, ny, u0.dtype, halo_width=w)
     u = u0
     if m_pad > nx:
         u = jnp.pad(u, ((0, 0), (0, m_pad - nx), (0, 0)))

@@ -129,6 +129,63 @@ def test_r002_host_callbacks_exempt(tmp_path):
     assert lint.lint_tree(root, rules=["R002"]) == []
 
 
+def test_r002_registry_bound_kernels_are_traced_roots(tmp_path):
+    """Kernels reached ONLY through the problems registry dispatch
+    (``Family(step=..., step_value=..., scalars=...)`` in another
+    module) are traced scopes — a wall-clock leak inside one is
+    caught; the numpy-oracle slot (``np_step``) stays host-side."""
+    root = _tree(tmp_path, {
+        "pkg/registry.py": '''
+        from pkg import kernels as _k
+
+        def build():
+            return Family(spec=None, step=_k.fancy_step,
+                          step_value=_k.fancy_step_value,
+                          scalars=_k.fancy_scalars,
+                          np_step=_k.numpy_oracle)
+        ''',
+        "pkg/kernels.py": '''
+        import time
+
+        def fancy_step(u, cx, cy):
+            return u * time.time()          # leak: traced via registry
+
+        def fancy_step_value(u, cx, cy):
+            return _helper(u)               # fixpoint through a helper
+
+        def _helper(u):
+            return u + time.perf_counter()  # leak: traced transitively
+
+        def fancy_scalars(cx, cy):
+            return (cx, cy)
+
+        def numpy_oracle(u):
+            return u * time.time()          # host oracle: NOT traced
+        ''',
+    })
+    fs = lint.lint_tree(root, rules=["R002"])
+    ctxs = sorted(f.context for f in fs)
+    assert ctxs == ["_helper", "fancy_step"]
+
+
+def test_r005_covers_ir_and_analysis_metric_families(tmp_path):
+    root = _tree(tmp_path, {
+        "pkg/met.py": '''
+        def record(reg):
+            reg.counter("ir_findings_total")
+            reg.counter("analysis_lint_runs_total")
+            reg.gauge("ir_programs_swept", 1)
+        ''',
+        "docs/OBSERVABILITY.md":
+            "| `ir_programs_swept` | gauge | documented |\n"
+            "| `analysis_ghost_total` | counter | documented only |\n",
+    })
+    fs = lint.lint_tree(root, rules=["R005"])
+    names = sorted(f.match for f in fs)
+    assert names == ["analysis_ghost_total", "analysis_lint_runs_total",
+                     "ir_findings_total"]
+
+
 def test_r003_flags_traced_value_leaks(tmp_path):
     root = _tree(tmp_path, {"pkg/mod.py": '''
         import jax

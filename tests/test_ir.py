@@ -1,0 +1,371 @@
+"""Jaxpr IR verifier tests — ISSUE 18.
+
+Four blocks:
+
+- **repo sweep**: ``verify_all()`` over every registered program on
+  the 8-device sim mesh reports ZERO findings — the CI ``ir-gate``,
+  as a test — and the derived halo radius equals the declared
+  ``halo_width`` for all 5 families (the acceptance criterion,
+  asserted directly from the evidence rows).
+- **seeded violations** (non-vacuity, one per pass): a widened
+  stencil, an undeclared downcast, and an injected ``all_gather`` are
+  each detected and the finding NAMES the program and the responsible
+  primitive; plus the adjacent contract checks (reads mismatch,
+  underivable footprint, non-nearest-neighbor ppermute, missing
+  exchange, collective in a batch program, wrong band strip depth).
+- **abstract domain**: the offset-interval interpreter derives exact
+  per-axis offsets through slice/pad/concatenate/roll/conv/transpose
+  and the ``.at[].set`` scatter lowering.
+- **pins**: running the full verifier never perturbs a traced
+  program — solver and batch-runner jaxprs are byte-identical before
+  and after a sweep (the verifier is observation-only).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from heat2d_tpu.analysis import ir
+from heat2d_tpu.analysis.dtype_flow import census_casts, precision_card
+from heat2d_tpu.analysis.footprint import derive_footprint
+from heat2d_tpu.parallel.mesh import make_mesh, shard_map_compat
+from heat2d_tpu.parallel.sharded import COLLECTIVE_CONTRACT
+from heat2d_tpu.problems.registry import family_names, get_family
+from tests._pin import assert_jaxpr_equal, batch_runner_jaxpr, solver_jaxpr
+
+
+@pytest.fixture(scope="module")
+def report():
+    """One full sweep shared by the gate + pin tests (it traces ~17
+    programs; tracing is pure so sharing is sound)."""
+    return ir.verify_all()
+
+
+# ------------------------------------------------------------------ #
+# repo sweep: the CI gate as a test
+# ------------------------------------------------------------------ #
+
+def test_repo_sweep_zero_findings(report):
+    assert report.ok, "\n".join(f.describe() for f in report.findings)
+
+
+def test_derived_radius_matches_declared_for_all_families(report):
+    rows = {r["program"]: r for r in report.footprint_rows}
+    for name in family_names():
+        spec = get_family(name).spec
+        row = rows[f"{name}/step"]
+        assert row["derived"] == (spec.halo_width, spec.halo_width), \
+            (name, row)
+        assert row["derived_reads"] == spec.reads_per_step, (name, row)
+    # the value-form kernels the Pallas/band templates trace, too
+    for name in family_names():
+        spec = get_family(name).spec
+        if any(r in spec.kernel_routes for r in ("pallas", "band")):
+            row = rows[f"{name}/step_value"]
+            assert row["derived"] == (spec.halo_width,
+                                      spec.halo_width), (name, row)
+
+
+def test_sweep_covers_every_registered_route(report):
+    progs = {c.program for c in report.cards}
+    for name in family_names():
+        for route in get_family(name).spec.kernel_routes:
+            assert f"{name}/{route}" in progs
+    # both sharded halo routes, fixed + convergence
+    assert any(p.startswith("sharded/collective") for p in progs)
+    assert any(p.startswith("sharded/fused") for p in progs)
+    assert not report.notes, report.notes   # 8-device mesh: no skips
+
+
+def test_sharded_census_matches_contract(report):
+    rows = {r["program"]: r for r in report.collective_rows
+            if r["program"].startswith("sharded/")}
+    assert len(rows) == 4
+    for prog, row in rows.items():
+        assert row["ppermutes"] > 0 and row["ppermutes"] % 4 == 0, \
+            (prog, row)
+
+
+# ------------------------------------------------------------------ #
+# seeded violations: each pass fires and names the culprit
+# ------------------------------------------------------------------ #
+
+def _u(nx=24, ny=24):
+    return jnp.zeros((nx, ny), jnp.float32)
+
+
+def test_seeded_widened_stencil_names_program_and_primitive():
+    """A kernel whose true radius is 2 declared as halo_width 1."""
+    fam = get_family("heat9")        # genuinely radius-2
+    findings, _ = ir.check_kernel_footprint(
+        "seeded/widened", lambda v: fam.step(v, 0.1, 0.1), _u(),
+        declared_width=1)
+    assert findings, "widened stencil must be detected"
+    msg = findings[0].describe()
+    assert "seeded/widened" in msg
+    assert "derived access radius 2 != declared halo_width 1" in msg
+    assert "primitive" in msg        # the witness is named
+
+
+def test_seeded_reads_mismatch_detected():
+    fam = get_family("varcoef")      # streams u + 2 coefficient fields
+    findings, row = ir.check_kernel_footprint(
+        "seeded/reads", lambda v: fam.step(v, 0.1, 0.1), _u(),
+        declared_width=1, declared_reads=1)
+    assert row["derived_reads"] == 3
+    assert any("derived HBM reads/step 3" in f.message
+               and "declared reads_per_step 1" in f.message
+               for f in findings)
+
+
+def test_seeded_underivable_footprint_is_a_finding():
+    findings, _ = ir.check_kernel_footprint(
+        "seeded/strided", lambda v: v[::2, :], _u(),
+        declared_width=1)
+    assert any("underivable" in f.message for f in findings)
+
+
+def test_seeded_undeclared_downcast_named_and_allowlistable():
+    def kern(v):
+        return v.at[1:-1, 1:-1].set(
+            v[1:-1, 1:-1].astype(jnp.bfloat16).astype(jnp.float32))
+
+    closed = jax.make_jaxpr(kern)(_u())
+    findings, card = ir.check_dtypes("seeded/downcast", closed)
+    assert findings, "undeclared downcast must be detected"
+    msg = findings[0].describe()
+    assert "seeded/downcast" in msg
+    assert "float32" in msg and "bfloat16" in msg
+    # declaring it in the allowlist silences exactly that cast
+    allow = (("float32", "bfloat16"), ("bfloat16", "float32"))
+    findings2, _ = ir.check_dtypes("seeded/downcast", closed, allow)
+    assert findings2 == []
+    # an allowlist entry matching nothing is NOT an error
+    findings3, _ = ir.check_dtypes(
+        "seeded/downcast", closed,
+        allow + (("float64", "float16"),))
+    assert findings3 == []
+
+
+def test_integer_index_casts_are_carded_but_not_findings():
+    def kern(v):
+        idx = jnp.arange(v.shape[0], dtype=jnp.int32).astype(jnp.int64)
+        return v + idx[:, None].astype(v.dtype) * 0
+
+    closed = jax.make_jaxpr(kern)(_u())
+    findings, card = ir.check_dtypes("seeded/intcast", closed)
+    assert any(c.src == "int32" and c.dst == "int64"
+               for c in card.casts)
+    assert all("int32" not in f.message or "float" in f.message
+               for f in findings)
+    assert not any(c.src == "int32" and c.dst == "int64"
+                   for c in card.findings())
+
+
+def test_seeded_injected_all_gather_is_forbidden():
+    mesh = make_mesh(2, 4)
+    ax, ay = mesh.axis_names
+
+    def local(u):
+        g = jax.lax.all_gather(u, ax)       # the classic regression
+        return u + g.sum(axis=0)
+
+    from jax.sharding import PartitionSpec as P
+    fn = shard_map_compat(local, mesh, in_specs=(P(ax, ay),),
+                          out_specs=P(ax, ay))
+    closed = jax.make_jaxpr(fn)(jnp.zeros((8, 8), jnp.float32))
+    findings, _ = ir.check_collectives(
+        "seeded/gather", closed, COLLECTIVE_CONTRACT,
+        require_exchange=False)
+    assert any("forbidden collective" in f.message
+               and "all_gather" in f.message for f in findings)
+    assert all(f.program == "seeded/gather" for f in findings)
+
+
+def test_seeded_non_neighbor_ppermute_detected():
+    mesh = make_mesh(2, 4)
+    ax, ay = mesh.axis_names
+
+    def local(u):
+        perm = [(0, 2), (2, 0)]             # skips a neighbor
+        return sum(jax.lax.ppermute(u, ay, perm) for _ in range(4))
+
+    from jax.sharding import PartitionSpec as P
+    fn = shard_map_compat(local, mesh, in_specs=(P(ax, ay),),
+                          out_specs=P(ax, ay))
+    closed = jax.make_jaxpr(fn)(jnp.zeros((8, 8), jnp.float32))
+    findings, _ = ir.check_collectives(
+        "seeded/teleport", closed, COLLECTIVE_CONTRACT)
+    assert any("not a nearest-neighbor" in f.message for f in findings)
+
+
+def test_missing_exchange_detected():
+    mesh = make_mesh(2, 4)
+    ax, ay = mesh.axis_names
+
+    from jax.sharding import PartitionSpec as P
+    fn = shard_map_compat(lambda u: u * 2, mesh,
+                          in_specs=(P(ax, ay),), out_specs=P(ax, ay))
+    closed = jax.make_jaxpr(fn)(jnp.zeros((8, 8), jnp.float32))
+    findings, _ = ir.check_collectives(
+        "seeded/silent", closed, COLLECTIVE_CONTRACT)
+    assert any("no ppermute halo exchange" in f.message
+               for f in findings)
+
+
+def test_collective_in_batch_program_detected():
+    mesh = make_mesh(2, 4)
+    ax, ay = mesh.axis_names
+
+    def local(u):
+        return jax.lax.psum(u, ax)
+
+    from jax.sharding import PartitionSpec as P
+    fn = shard_map_compat(local, mesh, in_specs=(P(ax, ay),),
+                          out_specs=P(None, ay))
+    closed = jax.make_jaxpr(fn)(jnp.zeros((8, 8), jnp.float32))
+    findings, _ = ir.check_no_collectives("seeded/batch", closed)
+    assert any("unexpected collective" in f.message
+               and "psum" in f.message for f in findings)
+
+
+def test_seeded_wrong_band_strip_depth_detected():
+    from heat2d_tpu.ops import pallas_stencil as ps
+    from heat2d_tpu.problems.runners import fixed_runner
+
+    u0 = jnp.zeros((2, 32, 64), jnp.float32)
+    cs = jnp.full((2,), 0.1, jnp.float32)
+    plan = ps.band_plan(32, 64, u0.dtype, halo_width=1)
+    run = fixed_runner("heat5", "band")
+    closed = jax.make_jaxpr(
+        lambda a, b, c: run(a, b, c, steps=plan.tsteps))(u0, cs, cs)
+    ok = ir.check_band_strips("band/ok", closed, plan.halo_rows, 1)
+    assert ok == []
+    bad = ir.check_band_strips("band/bad", closed,
+                               2 * plan.halo_rows, 2)
+    assert bad and "ghost strip ships" in bad[0].message
+
+
+# ------------------------------------------------------------------ #
+# abstract domain: exact offsets through the covered primitives
+# ------------------------------------------------------------------ #
+
+def test_offsets_through_slice_and_pad():
+    # out[i,j] = v[i+2, j-1] where data exists: slice start (2, 0)
+    # shifts +2 on rows, the 1-col low pad shifts -1 on cols
+    fp = derive_footprint(lambda v: jnp.pad(v[2:, :-1],
+                                            ((0, 2), (1, 0))), _u())
+    assert fp.derivable
+    assert fp.lo == (2, -1) and fp.hi == (2, -1)
+    assert fp.radius(0) == 2 and fp.radius(1) == 1
+
+
+def test_offsets_through_roll():
+    # jnp.roll lowers to concatenate-of-slices; the footprint is the
+    # shift in both directions of the wraparound
+    fp = derive_footprint(lambda v: jnp.roll(v, 1, axis=0), _u())
+    assert fp.derivable
+    assert fp.radius(0) >= 1 and fp.radius(1) == 0
+
+
+def test_offsets_through_at_set_scatter():
+    def kern(v):
+        return v.at[1:-1, 1:-1].set(v[:-2, 1:-1] + v[2:, 1:-1])
+
+    fp = derive_footprint(kern, _u())
+    assert fp.derivable
+    assert fp.radii() == (1, 0)
+    assert fp.witness(0) == "scatter"
+
+
+def test_offsets_through_conv():
+    k = jnp.ones((1, 1, 5, 3), jnp.float32)
+
+    def kern(v):
+        x = v[None, None]
+        y = jax.lax.conv_general_dilated(
+            x, k, window_strides=(1, 1), padding=((2, 2), (1, 1)))
+        return y[0, 0]
+
+    fp = derive_footprint(kern, _u())
+    assert fp.derivable
+    assert fp.radii() == (2, 1)
+    assert fp.witness(0) == "conv_general_dilated"
+
+
+def test_offsets_through_transpose():
+    # the offset follows the axis through the permutation: a +2 row
+    # shift before a transpose appears on output axis 1
+    fp = derive_footprint(lambda v: (v[2:, :]).T, _u())
+    assert fp.derivable
+    assert fp.lo == (0, 2) and fp.hi == (0, 2)
+
+
+def test_elementwise_broadcast_of_dep_value_is_top():
+    # a dep value reduced then broadcast loses per-element
+    # correspondence: must be TOP, not silently radius 0
+    fp = derive_footprint(lambda v: v * v.mean(), _u())
+    assert not fp.derivable
+
+
+def test_coefficient_reads_counted_once_across_views():
+    cxf = jnp.linspace(0.1, 0.2, 24 * 24).reshape(24, 24)
+
+    def kern(v):
+        # two slices of ONE field: one coefficient read, not two
+        return v[1:-1, :] * cxf[1:-1, :] + v[:-2, :] * cxf[:-2, :]
+
+    fp = derive_footprint(kern, _u())
+    assert fp.coef_reads == 1
+
+
+# ------------------------------------------------------------------ #
+# precision cards
+# ------------------------------------------------------------------ #
+
+def test_precision_card_provenance_paths():
+    def inner(v):
+        return v.astype(jnp.float64)
+
+    def outer(v):
+        return jax.jit(inner)(v).astype(jnp.float32)
+
+    card = precision_card("prov", outer, _u())
+    paths = {c.path for c in card.casts}
+    assert any(p and p[0].startswith("pjit") for p in paths)
+    assert any(p == () for p in paths)
+
+
+def test_census_casts_aggregates_counts():
+    def kern(v):
+        a = v.astype(jnp.float64).astype(jnp.float32)
+        b = v.astype(jnp.float64).astype(jnp.float32)
+        return a + b
+
+    casts = census_casts(jax.make_jaxpr(kern)(_u()))
+    up = [c for c in casts if c.dst == "float64"]
+    assert up and up[0].count == 2
+
+
+# ------------------------------------------------------------------ #
+# pins: the verifier never perturbs a traced program
+# ------------------------------------------------------------------ #
+
+def test_verifier_leaves_traced_programs_byte_identical(report):
+    # `report` ran the FULL sweep in this process before these traces
+    before_solver = solver_jaxpr()
+    before_batch = batch_runner_jaxpr(problem="varcoef")
+    rep2 = ir.verify_all(include_sharded=False)
+    assert rep2.ok
+    assert_jaxpr_equal(before_solver, solver_jaxpr(),
+                       label="solver after IR sweep")
+    assert_jaxpr_equal(before_batch,
+                       batch_runner_jaxpr(problem="varcoef"),
+                       label="batch runner after IR sweep")
+
+
+def test_render_report_names_programs(report):
+    text = ir.render_report(report, verbose=True)
+    assert "heat9/step: declared w=2, derived radii (2, 2)" in text
+    assert "no IR findings" in text
