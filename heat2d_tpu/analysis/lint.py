@@ -87,7 +87,7 @@ METRIC_METHODS = {"counter", "gauge", "observe", "series", "timer"}
 #: are not part of the documented contract)
 METRIC_RE = re.compile(
     r"^(serve|fleet|resil|tune|inverse|slo|load|control|mesh|adi|mg"
-    r"|perf|problem|ir|analysis)_[a-z0-9_]+$")
+    r"|perf|problem|ir|analysis|autoscale)_[a-z0-9_]+$")
 
 #: keyword names whose literal string values name a metric family
 #: (e.g. ``SingleFlight(counter="fleet_coalesced_total")``)
@@ -600,7 +600,7 @@ def _code_metric_names(trees: Dict[str, ast.Module]) -> Tuple[
 
 _DOC_METRIC_RE = re.compile(
     r"`((?:serve|fleet|resil|tune|inverse|slo|load|control|mesh|adi|mg"
-    r"|perf|problem|ir|analysis)_[a-z0-9_*]+)"
+    r"|perf|problem|ir|analysis|autoscale)_[a-z0-9_*]+)"
     r"(?:\{[^`]*\})?`")
 
 

@@ -1,0 +1,34 @@
+"""Elastic capacity — the actuation layer that EXECUTES the control
+plane's advice (docs/CONTROL.md "Actuation").
+
+The control plane (heat2d_tpu/control/) has advised capacity since the
+load-model PR (``load/capacity.advise`` sizing rows, discounted by mesh
+quarantine), but nothing executed the advice — the fleet stayed the
+size it was started at. This package closes that actuation gap:
+
+- ``policy.AutoscalePolicy`` — the guardrails: min/max workers,
+  per-direction cooldowns, the scale-down hold (hysteresis), step
+  limits, drain timeout, parole passes.
+- ``actuator.Actuator`` — turns one sizing row per control tick into
+  at most a handful of concrete actions: ``FleetServer.add_worker``
+  (warm-gated scale-up — a new worker is unroutable until compiled),
+  ``FleetServer.retire_worker`` (fence-then-drain scale-down),
+  ``HealthMonitor.parole`` (verified re-admission of quarantined
+  devices), ``MeshEnsembleEngine.resize`` (voluntary mesh resize).
+  It also keeps the chip-seconds ledger the "cheaper than static
+  provisioning" verdict is computed from.
+- ``migrate`` — live migration of long-running inverse jobs off a
+  retiring worker: pause at an iteration boundary, checkpoint the
+  Adam state (``diff.inverse.AdamState`` via ``resil.snapshot``),
+  serialize it wire-style (base64 numpy, the ``fleet/wire`` idiom),
+  resume on a survivor — bitwise-identical to an unmigrated run.
+
+The CI gate (``autoscale-soak``) drives the whole loop under the
+compressed diurnal profile from ``load/synth.py`` and asserts capacity
+follows the envelope, SLOs hold through every resize, chip-hours land
+below the static baseline, and one live-migrated job finishes bitwise
+against its never-migrated oracle.
+"""
+
+from heat2d_tpu.autoscale.actuator import Actuator  # noqa: F401
+from heat2d_tpu.autoscale.policy import AutoscalePolicy  # noqa: F401

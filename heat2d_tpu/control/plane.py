@@ -46,7 +46,8 @@ class ControlPlane:
                  burn_threshold: float = 1.0, sustain: int = 3,
                  shed_watermark: float = 0.4,
                  retuner=None, capacity_fit: Optional[dict] = None,
-                 registry=None, mesh_health=None, sentinel=None):
+                 registry=None, mesh_health=None, sentinel=None,
+                 actuator=None):
         """``sentinel``: an optional ``obs.perf.AnomalySentinel`` —
         each tick evaluates one sentinel window and its findings land
         in the decision log as ``perf_anomaly`` rows beside burn,
@@ -60,8 +61,17 @@ class ControlPlane:
         ``control_quarantined_devices`` gauge tracks the count, and
         sizing advice discounts deployed units by the surviving
         capacity fraction (7 of 8 chips alive = 7/8 of the modeled
-        capacity actually serving)."""
+        capacity actually serving).
+
+        ``actuator``: an optional ``autoscale.Actuator`` — with one
+        armed, sizing advice is EXECUTED, not just recorded: every
+        tick (not only under sustained burn — trough scale-down needs
+        the quiet ticks too) feeds the advice row to the actuator,
+        which converges the worker pool toward it under its policy's
+        guardrails. Actions taken land in the decision log as
+        ``autoscale_*`` rows."""
         self.fleet = fleet
+        self.actuator = actuator
         self.mesh_health = mesh_health
         self.sentinel = sentinel
         self._last_quarantined: Optional[int] = None
@@ -227,41 +237,59 @@ class ControlPlane:
             if fresh:
                 self.retune_wanted.update(fresh)
                 self._decide("retune_wanted", signatures=fresh)
-            if self.capacity_fit:
-                from heat2d_tpu.load import capacity
-                advice = capacity.advise(
-                    self.capacity_fit, rps,
-                    len(self.fleet.sup.alive_slots()))
-                if capacity_fraction < 1.0:
-                    # quarantined chips don't serve: the deployed
-                    # units' EFFECTIVE capacity shrinks by the
-                    # surviving fraction, so the add-units gap grows
-                    advice["capacity_fraction"] = capacity_fraction
-                    advice["effective_units"] = (
-                        advice["current_units"] * capacity_fraction)
-                    need = advice.get("needed_units")
-                    if need is not None:
-                        import math
-                        advice["add_units"] = max(
-                            0, math.ceil(
-                                need - advice["effective_units"]))
-                # advice rows dedupe on state transitions (like shed/
-                # unshed): an hour-long burn must not append thousands
-                # of identical rows to the decision log. The key
-                # includes add_units so a mid-burn quarantine that
-                # shrinks effective capacity (same needed_units,
-                # bigger gap) emits the corrected advice.
+        advice = None
+        if self.capacity_fit and (sustained
+                                  or self.actuator is not None):
+            from heat2d_tpu.load import capacity
+            # with an actuator armed, "deployed" means the provisioned
+            # pool (retired slots excluded), not merely whoever is
+            # alive this instant mid-restart
+            current = (self.actuator.pool_size()
+                       if self.actuator is not None
+                       else len(self.fleet.sup.alive_slots()))
+            advice = capacity.advise(self.capacity_fit, rps, current)
+            if capacity_fraction < 1.0:
+                # quarantined chips don't serve: the deployed
+                # units' EFFECTIVE capacity shrinks by the
+                # surviving fraction, so the add-units gap grows
+                advice["capacity_fraction"] = capacity_fraction
+                advice["effective_units"] = (
+                    advice["current_units"] * capacity_fraction)
+                need = advice.get("needed_units")
+                if need is not None:
+                    import math
+                    advice["add_units"] = max(
+                        0, math.ceil(
+                            need - advice["effective_units"]))
+            # advice rows dedupe on state transitions (like shed/
+            # unshed): an hour-long burn must not append thousands
+            # of identical rows to the decision log. The key
+            # includes add_units so a mid-burn quarantine that
+            # shrinks effective capacity (same needed_units,
+            # bigger gap) emits the corrected advice. Quiet-tick
+            # advice (actuator-only) stays out of the decision log —
+            # the ACTIONS it triggers are the record.
+            if sustained:
                 advice_key = (advice.get("needed_units"),
                               advice.get("add_units"))
                 if (not self._burning
                         or advice_key != self._last_advice_units):
                     self._decide("capacity_advice", **advice)
                     self._last_advice_units = advice_key
-                if (self.registry is not None
-                        and advice.get("needed_units")):
-                    self.registry.gauge("control_capacity_needed_units",
-                                        advice["needed_units"])
+            if (self.registry is not None
+                    and advice.get("needed_units")):
+                self.registry.gauge("control_capacity_needed_units",
+                                    advice["needed_units"])
         self._burning = bool(sustained)
+
+        if self.actuator is not None:
+            # execution: the actuator converges the pool toward the
+            # advice under its guardrails; every action it takes is a
+            # decision row (autoscale_scale_up / autoscale_scale_down)
+            for row in self.actuator.observe(advice):
+                fields = {k: v for k, v in row.items()
+                          if k not in ("t", "action")}
+                self._decide(f"autoscale_{row['action']}", **fields)
 
         # no staging while a rollout is live: stage_candidate rewrites
         # candidate_path, and the rollout's promote guard would (
@@ -335,6 +363,8 @@ class ControlPlane:
         gens = self.fleet.sup.generations_snapshot()
         out.update(self.serving_invariant(gens))
         out["generation_log"] = gens
+        if self.actuator is not None:
+            out["autoscale"] = self.actuator.summary()
         return out
 
 

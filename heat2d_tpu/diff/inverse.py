@@ -121,9 +121,33 @@ def loss_grad_runner(nx: int, ny: int, steps: int, target: str,
 
 
 @dataclasses.dataclass
+class AdamState:
+    """The optimizer's complete state between two iterations — the
+    live-migration checkpoint (``autoscale/migrate.py``). Everything
+    is a HOST copy (``resil.snapshot_state(dtype=None)``: exact, no
+    dtype truncation), so the state round-trips bitwise through
+    serialization, and the host Adam update — a deterministic pure
+    function of (params, m, v, it) driven by the memoized compiled
+    ``value_and_grad`` — makes a resumed run bitwise-identical to an
+    uninterrupted one. ``iteration`` counts COMPLETED iterations: the
+    bias corrections ``1 - beta**it`` depend on the absolute index,
+    which is why it rides in the state instead of restarting at 0."""
+    iteration: int
+    params: np.ndarray
+    m: np.ndarray
+    v: np.ndarray
+    best: np.ndarray
+    best_loss: float
+    loss_history: list
+    grad_norm_history: list
+
+
+@dataclasses.dataclass
 class InverseSolution:
     """One finished inverse solve. ``params`` is the best-loss iterate
-    (host numpy), not necessarily the last."""
+    (host numpy), not necessarily the last. A PAUSED solve (the
+    live-migration checkpoint path) sets ``paused`` and carries the
+    resumable ``state`` instead of claiming convergence."""
     params: np.ndarray
     final_loss: float
     iterations: int
@@ -131,6 +155,8 @@ class InverseSolution:
     grad_norm: float
     loss_history: list
     grad_norm_history: list
+    paused: bool = False
+    state: Optional[AdamState] = None
 
 
 def adam_minimize(value_and_grad: Callable, params0, *,
@@ -139,8 +165,11 @@ def adam_minimize(value_and_grad: Callable, params0, *,
                   eps: float = 1e-8, project: Optional[Callable] = None,
                   tol: Optional[float] = None, registry=None,
                   series_labels: Optional[dict] = None,
-                  progress: Optional[Callable] = None) -> InverseSolution:
-    """Adam with optional projection and early stop.
+                  progress: Optional[Callable] = None,
+                  state: Optional[AdamState] = None,
+                  pause: Optional[Callable[[int], bool]] = None
+                  ) -> InverseSolution:
+    """Adam with optional projection, early stop, and pause/resume.
 
     ``value_and_grad(params) -> (loss, grad)`` (typically jitted);
     ``project(params) -> params`` clamps each iterate (stability box);
@@ -148,26 +177,47 @@ def adam_minimize(value_and_grad: Callable, params0, *,
     ``registry``/``series_labels`` stream the per-iteration
     ``inverse_loss`` / ``inverse_grad_norm`` series; ``progress`` is an
     optional host callback ``(iteration, loss, grad_norm)``.
-    """
+
+    ``pause(completed_iterations) -> bool`` is polled at each iteration
+    BOUNDARY (never mid-update): when it turns truthy the solve returns
+    ``paused=True`` with an ``AdamState`` checkpoint instead of a
+    verdict. ``state`` resumes from such a checkpoint; ``iterations``
+    stays the TOTAL budget, and the resumed trajectory is
+    bitwise-identical to an uninterrupted run (AdamState docstring)."""
     import jax.numpy as jnp
 
     from heat2d_tpu.resil.snapshot import snapshot_state
 
     if iterations < 1:
         raise ValueError(f"iterations must be >= 1, got {iterations}")
-    params = jnp.asarray(params0)
-    m = jnp.zeros_like(params)
-    v = jnp.zeros_like(params)
     labels = dict(series_labels or {})
-    loss_hist: list = []
-    gn_hist: list = []
-    best_loss = float("inf")
-    # dtype=None: the snapshot keeps the optimization's dtype — an f64
-    # run's best iterate must not truncate through float32.
-    best = snapshot_state(params, dtype=None)
+    if state is None:
+        params = jnp.asarray(params0)
+        m = jnp.zeros_like(params)
+        v = jnp.zeros_like(params)
+        loss_hist: list = []
+        gn_hist: list = []
+        best_loss = float("inf")
+        # dtype=None: the snapshot keeps the optimization's dtype — an
+        # f64 run's best iterate must not truncate through float32.
+        best = snapshot_state(params, dtype=None)
+        it = 0
+    else:
+        params = jnp.asarray(state.params)
+        m = jnp.asarray(state.m)
+        v = jnp.asarray(state.v)
+        loss_hist = list(state.loss_history)
+        gn_hist = list(state.grad_norm_history)
+        best_loss = float(state.best_loss)
+        best = snapshot_state(np.asarray(state.best), dtype=None)
+        it = int(state.iteration)
     converged = False
-    it = 0
-    for it in range(1, iterations + 1):
+    paused = False
+    while it < iterations:
+        if pause is not None and pause(it):
+            paused = True
+            break
+        it += 1
         loss, g = value_and_grad(params)
         loss = float(loss)
         gn = float(jnp.sqrt(jnp.sum(g * g)))
@@ -192,10 +242,22 @@ def adam_minimize(value_and_grad: Callable, params0, *,
         params = params - lr * mhat / (jnp.sqrt(vhat) + eps)
         if project is not None:
             params = project(params)
+    out_state = None
+    if paused:
+        out_state = AdamState(
+            iteration=it,
+            params=snapshot_state(params, dtype=None),
+            m=snapshot_state(m, dtype=None),
+            v=snapshot_state(v, dtype=None),
+            best=snapshot_state(np.asarray(best), dtype=None),
+            best_loss=best_loss,
+            loss_history=list(loss_hist),
+            grad_norm_history=list(gn_hist))
     return InverseSolution(
         params=best, final_loss=best_loss, iterations=it,
         converged=converged, grad_norm=gn_hist[-1] if gn_hist else 0.0,
-        loss_history=loss_hist, grad_norm_history=gn_hist)
+        loss_history=loss_hist, grad_norm_history=gn_hist,
+        paused=paused, state=out_state)
 
 
 @dataclasses.dataclass
@@ -290,9 +352,13 @@ class InverseProblem:
     def solve(self, *, iterations: int = 100, lr: float = 0.05,
               tol: Optional[float] = None, registry=None,
               series_labels: Optional[dict] = None,
-              progress: Optional[Callable] = None) -> InverseSolution:
+              progress: Optional[Callable] = None,
+              state: Optional[AdamState] = None,
+              pause: Optional[Callable[[int], bool]] = None
+              ) -> InverseSolution:
         return adam_minimize(
             self.value_and_grad(), self.initial_params(),
             iterations=iterations, lr=lr, tol=tol,
             project=self.project(), registry=registry,
-            series_labels=series_labels, progress=progress)
+            series_labels=series_labels, progress=progress,
+            state=state, pause=pause)

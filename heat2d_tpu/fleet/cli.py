@@ -134,6 +134,26 @@ def build_parser() -> argparse.ArgumentParser:
                    metavar="S",
                    help="rollout observation window (paired probes + "
                         "windowed SLO burn)")
+    p.add_argument("--autoscale", action="store_true",
+                   help="run the ELASTIC soak instead of the chaos "
+                        "soak: a compressed diurnal traffic envelope "
+                        "(load/synth 'diurnal' profile) drives the "
+                        "control plane's capacity advice through an "
+                        "autoscale.Actuator — the worker pool must "
+                        "follow the envelope both directions, SLOs "
+                        "must hold through every resize, chip-seconds "
+                        "must land below static provisioning at "
+                        "--workers, and one live-migrated inverse job "
+                        "must finish bitwise-identical to its "
+                        "unmigrated oracle (docs/CONTROL.md "
+                        "'Actuation')")
+    p.add_argument("--autoscale-util", type=float, default=0.6,
+                   metavar="F",
+                   help="target utilization: the capacity fit is "
+                        "derated to F of the calibrated per-worker "
+                        "rate, so sizing keeps 1-F headroom")
+    p.add_argument("--autoscale-seed", type=int, default=0,
+                   help="seed naming the synthesized diurnal workload")
     p.add_argument("--platform", default=None, choices=["cpu", "tpu"],
                    help="force a JAX platform for the workers "
                         "(default cpu: the soak is a logic gate, not a "
@@ -489,6 +509,404 @@ def run_soak(args, registry) -> int:
     return 1 if failures else 0
 
 
+def run_autoscale(args, registry) -> int:
+    """The elastic soak (CI's ``autoscale-soak`` job): calibrate one
+    worker's throughput, synthesize a compressed diurnal day from
+    ``load/synth``, and let the control plane + actuator run the pool —
+    then assert the closed loop actually closed (docs/CONTROL.md):
+
+    1. capacity FOLLOWS the envelope — scale-ups and scale-downs both
+       happen, and the mean pool size under the envelope's peak beats
+       the mean under its trough;
+    2. SLOs hold through every resize — nothing lost, nothing
+       rejected, no unstructured errors;
+    3. elasticity is cheaper than static provisioning — the actuator's
+       chip-seconds ledger lands below ``--workers`` workers held for
+       the whole window;
+    4. one long-running inverse job, live-migrated off a retiring
+       worker mid-optimization, finishes bitwise-identical to the
+       oracle that never moved;
+    5. (multi-device processes) mesh resize down/up, quarantine, and
+       parole all serve bitwise-identical answers with the
+       ``no_quarantined_serving`` invariant intact and the paroled
+       device back in the serving set.
+    """
+    import math
+
+    import numpy as np
+
+    from heat2d_tpu.autoscale import Actuator, AutoscalePolicy
+    from heat2d_tpu.autoscale import migrate as migrate_mod
+    from heat2d_tpu.control import ControlPlane
+    from heat2d_tpu.fleet.router import FleetServer
+    from heat2d_tpu.load import capacity
+    from heat2d_tpu.load.synth import PROFILES, synthesize
+    from heat2d_tpu.obs import MetricsRegistry
+    from heat2d_tpu.obs import slo as _slo
+    from heat2d_tpu.resil.retry import wait_for
+    from heat2d_tpu.serve.schema import Rejected, SolveRequest
+
+    failures = []
+    events = []                 # (t, "completed" | rejected-code)
+    ev_lock = AuditedLock("fleet.cli.autoscale")
+    env = {"JAX_PLATFORMS": args.platform or "cpu"}
+    profile = PROFILES["diurnal"]
+    period = profile.diurnal_period_s
+    amp = profile.diurnal_amplitude
+    soak = args.soak if args.soak is not None else 1.5 * period
+    min_w, max_w = 1, args.workers
+    submitted = 0
+
+    print(f"# autoscale soak: diurnal envelope ({period:.0f}s period, "
+          f"amplitude {amp}), {soak:.0f}s, pool [{min_w}, {max_w}]")
+    fleet = FleetServer(
+        workers=min_w, registry=registry,
+        default_timeout=args.timeout,
+        heartbeat_timeout=args.heartbeat_timeout,
+        # caches off for the same reason as the chaos soak: capacity
+        # calibration and the envelope response must measure the SOLVE
+        # path, not cache service
+        cache_size=0, worker_cache_size=0,
+        # the envelope DELIBERATELY under-provisions at the trough
+        # (that is the savings), so the rising edge queues until the
+        # scale-up absorbs it — admission must hold the backlog, not
+        # shed it: this soak's SLO gate is completion, not queue depth
+        # (router admission AND the workers' own batcher doors)
+        max_inflight=100_000, queue_depth=100_000,
+        worker_timeout=args.timeout, env=env)
+
+    def on_done(fut, _req):
+        now = time.monotonic()
+        try:
+            fut.result()
+            with ev_lock:
+                events.append((now, "completed"))
+        except Rejected as e:
+            with ev_lock:
+                events.append((now, f"rejected_{e.code}"))
+        except Exception as e:  # noqa: BLE001 — a soak reports, always
+            with ev_lock:
+                events.append((now, f"error:{e!r}"))
+
+    plane = None
+    summary: dict = {}
+    control_extra = None
+    with fleet:
+        # -- warmup: every signature compiles off the measured path -- #
+        warm = [fleet.submit(SolveRequest(
+            nx=profile.nx, ny=profile.ny, steps=profile.steps + s,
+            cx=0.9 + 0.001 * s, cy=0.1, method=profile.method))
+            for s in range(profile.signatures)]
+        for f in warm:
+            try:
+                f.result(timeout=args.timeout + 60)
+            except Exception:   # noqa: BLE001 — warmup is best-effort
+                pass
+
+        # -- calibration: one worker's sustainable rate -------------- #
+        cal_done: list = []
+        cal_conc = max(2, min(4, args.concurrency))
+        sem = threading.Semaphore(cal_conc)
+        cal_end = time.monotonic() + 3.0
+        i = 0
+        while time.monotonic() < cal_end:
+            if not sem.acquire(timeout=0.1):
+                continue
+            i += 1
+            fut = fleet.submit(SolveRequest(
+                nx=profile.nx, ny=profile.ny, steps=profile.steps,
+                cx=round(0.05 + 0.0001 * (i % 997), 6), cy=0.1,
+                method=profile.method))
+            fut.add_done_callback(
+                lambda f: (cal_done.append(time.monotonic()),
+                           sem.release()))
+        for _ in range(cal_conc):
+            sem.acquire(timeout=args.timeout + 30)
+        # steady state only: drop the first half second as ramp
+        t0c = cal_done[0] if cal_done else time.monotonic()
+        late = [t for t in cal_done if t - t0c >= 0.5]
+        span = (cal_done[-1] - t0c - 0.5) if len(late) >= 2 else 0.0
+        measured = len(late) / span if span > 0 else 0.0
+        if measured <= 0:
+            print("FAIL: calibration measured no throughput",
+                  file=sys.stderr)
+            fleet.stop()
+            return 1
+        fit = capacity.fit_capacity(
+            [{"offered_rps": measured, "achieved_rps": measured,
+              "shed_rate": 0.0, "slo_ok": True},
+             {"offered_rps": 4 * measured, "achieved_rps": measured,
+              "shed_rate": 0.5, "slo_ok": False}], units=1)
+        # derate to the target utilization: the autoscaler sizes for
+        # headroom, not the saturation knee it calibrated at
+        fit["per_unit_rps"] = round(
+            fit["per_unit_rps"] * args.autoscale_util, 4)
+        # base rate such that the envelope's PEAK needs the whole pool
+        # and its trough needs ~min_workers
+        base_rate = max_w * fit["per_unit_rps"] / (1.0 + amp)
+        print(f"# calibrated {measured:.1f} rps/worker "
+              f"(derated per-unit {fit['per_unit_rps']:.1f}); "
+              f"base rate {base_rate:.1f} rps")
+        sched = synthesize(profile, base_rate, soak,
+                           seed=args.autoscale_seed, max_arrivals=20000)
+
+        # -- arm the loop: plane -> actuator -> fleet ---------------- #
+        policy = AutoscalePolicy(
+            min_workers=min_w, max_workers=max_w,
+            up_cooldown_s=1.0, down_cooldown_s=2.0,
+            down_hold_ticks=2, max_step_up=2, max_step_down=1,
+            drain_timeout_s=args.timeout)
+        actuator = Actuator(fleet, policy, registry=registry)
+        plane = ControlPlane(
+            fleet,
+            policy=_slo.SLOPolicy(latency_p99_s=args.slo_p99 or 30.0,
+                                  error_budget=args.slo_error_budget),
+            interval=0.25, capacity_fit=fit, registry=registry,
+            actuator=actuator).start()
+
+        # -- replay the synthesized day (open loop) ------------------ #
+        t_load = time.monotonic()
+        for arr in sched.arrivals:
+            now = time.monotonic() - t_load
+            if arr.t > now:
+                time.sleep(arr.t - now)
+            submitted += 1
+            fleet.submit(SolveRequest(**arr.spec)).add_done_callback(
+                lambda f, r=arr: on_done(f, r))
+        deadline = time.monotonic() + args.timeout + 60
+        while time.monotonic() < deadline:
+            with ev_lock:
+                if len(events) >= submitted:
+                    break
+            time.sleep(0.05)
+        plane.stop()
+        control_extra = plane.summary()
+
+        # -- live-migration leg -------------------------------------- #
+        if fleet.sup.pool_size() < 2:
+            # migration needs a survivor to land on
+            fleet.add_worker()
+        mig_summary = _autoscale_migration_leg(
+            args, actuator, fleet, migrate_mod, MetricsRegistry,
+            wait_for, failures)
+
+        # -- mesh resize / parole leg (multi-device only) ------------ #
+        mesh_summary = _autoscale_mesh_leg(args, actuator, profile,
+                                           registry, failures)
+
+        auto = actuator.summary()
+        clean = fleet.stop()
+
+    # -- acceptance ----------------------------------------------------- #
+    answered = len(events)
+    completed = sum(1 for _t, o in events if o == "completed")
+    if answered != submitted:
+        failures.append(f"silent loss: {submitted} submitted but only "
+                        f"{answered} answered")
+    bad = [o for _t, o in events if o != "completed"]
+    if bad:
+        # "SLOs hold through every resize": a drain that dropped or
+        # rejected even one request is an elastic-path failure
+        failures.append(f"{len(bad)} requests not completed through "
+                        f"the resizes, e.g. {bad[0]}")
+    if auto["scale_ups"] < 1 or auto["scale_downs"] < 1:
+        failures.append(
+            f"capacity did not follow the envelope both directions "
+            f"({auto['scale_ups']} ups, {auto['scale_downs']} downs)")
+    # the pool must TRACK the envelope: mean size under the peak half
+    # vs the trough half of the sinusoid
+    peak, trough = [], []
+    for t, pool in auto["trace"]:
+        phase = math.sin(2.0 * math.pi * (t - t_load) / period)
+        if phase > 0.5:
+            peak.append(pool)
+        elif phase < -0.5:
+            trough.append(pool)
+    if peak and trough:
+        if (sum(peak) / len(peak)) <= (sum(trough) / len(trough)):
+            failures.append(
+                f"pool did not track the envelope: peak mean "
+                f"{sum(peak) / len(peak):.2f} <= trough mean "
+                f"{sum(trough) / len(trough):.2f}")
+    else:
+        failures.append("soak too short to sample both envelope "
+                        "phases")
+    if auto["chip_seconds"] >= auto["static_chip_seconds"]:
+        failures.append(
+            f"elasticity saved nothing: {auto['chip_seconds']:.1f} "
+            f"chip-seconds vs static "
+            f"{auto['static_chip_seconds']:.1f}")
+    if not clean:
+        failures.append("supervisor shutdown was not clean")
+
+    summary = {
+        "soak_s": soak, "submitted": submitted,
+        "completed": completed,
+        "calibrated_rps_per_worker": round(measured, 2),
+        "base_rate_rps": round(base_rate, 2),
+        "scale_ups": auto["scale_ups"],
+        "scale_downs": auto["scale_downs"],
+        "workers_min": auto["workers_min"],
+        "workers_max": auto["workers_max"],
+        "chip_seconds": round(auto["chip_seconds"], 1),
+        "static_chip_seconds": round(auto["static_chip_seconds"], 1),
+        "savings_fraction": round(auto["savings_fraction"], 3),
+        "migration": mig_summary,
+        "mesh": mesh_summary,
+        "clean_exit": clean,
+    }
+    print(f"# autoscale summary: {json.dumps(summary)}")
+    for f in failures:
+        print(f"FAIL: {f}", file=sys.stderr)
+    if args.metrics_out:
+        from heat2d_tpu.obs.record import write_run_jsonl
+        write_run_jsonl(
+            registry, args.metrics_out, "autoscale",
+            dict(summary, failures=failures,
+                 actions=auto["actions"],
+                 migrations=auto["migration_rows"]),
+            more=[("control", control_extra)] if control_extra else ())
+    print("autoscale soak " + ("FAILED" if failures else "passed"))
+    return 1 if failures else 0
+
+
+def _autoscale_migration_leg(args, actuator, fleet, migrate_mod,
+                             MetricsRegistry, wait_for, failures):
+    """Prove live migration end to end: a long inverse job attached to
+    the highest provisioned slot, that slot retired mid-optimization
+    (the actuator's own scale-down path), the resumed job joined and
+    compared BITWISE against an oracle that never migrated."""
+    import numpy as np
+
+    from heat2d_tpu.diff.inverse import (InverseProblem,
+                                         observation_mask,
+                                         unit_reference_init)
+
+    import jax.numpy as jnp
+    from heat2d_tpu.diff.adjoint import make_diff_solve
+
+    nx = ny = 12
+    steps, iters, lr = 10, 600, 0.05
+    u0 = unit_reference_init(nx, ny)
+    u_true = np.asarray(make_diff_solve(nx, ny, steps)(
+        jnp.asarray(u0), 0.1, 0.1))
+    prob = InverseProblem(nx=nx, ny=ny, steps=steps, target="init",
+                          obs_mask=observation_mask(nx, ny, every=1),
+                          obs_values=u_true, cx=0.1, cy=0.1)
+    # oracle FIRST: warms the memoized compile, so the live job's
+    # iteration cadence is steady when the checkpoint lands
+    oracle = migrate_mod.run_unmigrated(prob, iterations=iters, lr=lr)
+    job_reg = MetricsRegistry()
+    job = migrate_mod.InverseJob(prob, iterations=iters, lr=lr,
+                                 registry=job_reg).start()
+    victim = fleet.sup.provisioned_slots()[-1]
+    actuator.attach_job(victim, job)
+
+    def _progress() -> float:
+        return job_reg.snapshot()["counters"].get(
+            "inverse_iterations_total", 0.0)
+
+    # retire mid-flight: the job must be demonstrably PAST iteration 0
+    # and short of done when the drain takes its worker
+    wait_for(lambda: _progress() >= 50, 120.0)
+    row = actuator.retire(victim)
+    mig = row.get("migrated") or []
+    out = {"victim": victim, "clean_drain": row.get("clean"),
+           "migrated": bool(mig)}
+    if not mig or not mig[0].get("resumed"):
+        failures.append("no live migration occurred on retire "
+                        f"(row {row})")
+        return out
+    rec = mig[0]
+    out.update(iteration=rec["iteration"], dest=rec["to"],
+               wire_bytes=rec["bytes"])
+    if not 0 < rec["iteration"] < iters:
+        failures.append(f"checkpoint not mid-flight: iteration "
+                        f"{rec['iteration']} of {iters}")
+    moved = actuator.jobs_on(rec["to"])[-1]
+    try:
+        moved.join(timeout=600)
+    except Exception as e:  # noqa: BLE001 — a soak reports, always
+        failures.append(f"migrated job failed to finish: {e!r}")
+        return out
+    sol = moved.solution
+    if sol is None or sol.paused:
+        failures.append("migrated job did not run to completion")
+        return out
+    bitwise = (
+        np.asarray(sol.params).tobytes()
+        == np.asarray(oracle.params).tobytes()
+        and list(sol.loss_history) == list(oracle.loss_history))
+    out["bitwise_vs_oracle"] = bitwise
+    if not bitwise:
+        failures.append("migrated inverse job is NOT bitwise-identical "
+                        "to the unmigrated oracle")
+    return out
+
+
+def _autoscale_mesh_leg(args, actuator, profile, registry, failures):
+    """Mesh elasticity on multi-device processes: voluntary resize
+    down and back up, a quarantine, and a parole — every leg bitwise
+    vs the full-mesh baseline, the serving invariant provable
+    throughout, and the paroled device back in the serving set."""
+    import numpy as np
+
+    import jax
+
+    from heat2d_tpu.mesh.degrade import FaultPolicy, serving_invariant
+    from heat2d_tpu.mesh.engine import MeshEnsembleEngine
+    from heat2d_tpu.serve.schema import SolveRequest
+
+    nd = jax.local_device_count()
+    if nd < 2:
+        return {"skipped": f"single-device process (nd={nd})"}
+    engine = MeshEnsembleEngine(registry=registry, fault=FaultPolicy())
+    actuator.mesh_engine = engine
+    actuator.health = engine.health
+    reqs = [SolveRequest(nx=profile.nx, ny=profile.ny,
+                         steps=profile.steps,
+                         cx=round(0.07 + 0.001 * i, 6), cy=0.1,
+                         method="jnp") for i in range(2 * nd)]
+
+    def solve_bytes():
+        return [np.asarray(u).tobytes()
+                for u, _s in engine.solve_batch(reqs)]
+
+    base = solve_bytes()
+    legs = {}
+    actuator.resize_mesh(nd - 1)
+    legs["resized_down"] = solve_bytes()
+    actuator.resize_mesh(nd)
+    legs["resized_up"] = solve_bytes()
+    engine.health.quarantine(nd - 1, "probe_failure")
+    legs["degraded"] = solve_bytes()
+    parole_rows = actuator.parole_all()
+    paroled = [r for r in parole_rows if r["outcome"] == "paroled"]
+    if not paroled:
+        failures.append(f"parole denied a healthy device "
+                        f"({parole_rows})")
+    mark = len(engine.launch_log)
+    legs["paroled"] = solve_bytes()
+    for name, got in legs.items():
+        if got != base:
+            failures.append(f"mesh leg '{name}' diverged bitwise from "
+                            f"the full-mesh baseline")
+    inv = serving_invariant(engine.health, engine.launch_log)
+    if not inv["ok"]:
+        failures.append(f"no_quarantined_serving violated: "
+                        f"{inv['violations']}")
+    served_after = any(
+        (nd - 1) in ((r.get("mesh") or {}).get("devices") or ())
+        for r in engine.launch_log[mark:])
+    if paroled and not served_after:
+        failures.append("paroled device never re-entered the serving "
+                        "set")
+    return {"devices": nd, "paroled": len(paroled),
+            "resizes": len(engine.resize_log),
+            "invariant_ok": inv["ok"],
+            "paroled_device_served": served_after}
+
+
 def _start_rollout(args, plane, validated_path, candidate_path,
                    out, failures):
     """Stage a candidate for the hottest signature (simulated
@@ -624,6 +1042,8 @@ def main(argv=None) -> int:
         return 2
     from heat2d_tpu.obs import MetricsRegistry
     registry = MetricsRegistry()
+    if args.autoscale:
+        return run_autoscale(args, registry)
     if args.soak is not None:
         return run_soak(args, registry)
     print("nothing to do: pass --soak S (optionally --chaos) — the "

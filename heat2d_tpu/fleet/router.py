@@ -222,6 +222,7 @@ class FleetServer:
             on_response=self._on_response,
             on_worker_lost=self._on_worker_lost,
             on_worker_ready=self._on_worker_ready,
+            on_worker_retiring=self._on_worker_retiring,
             on_tick=self._expire_overdue)
         self._lock = AuditedLock("fleet.router")
         self._records: Dict[int, _Inflight] = {}
@@ -237,6 +238,11 @@ class FleetServer:
         self._cold: set = set()
         #: slot -> outstanding warmup rids
         self._warming: Dict[int, set] = {}
+        #: slots fenced for retirement (``_on_worker_retiring`` — fired
+        #: by the supervisor BEFORE the drain begins): never routable
+        #: again, not even under the all-cold fallback. Retired slot
+        #: indices are never reused, so the set only grows.
+        self._retiring: set = set()
         #: control-plane override of HIGH_WATERMARK (pre-emptive
         #: shedding under sustained SLO burn — docs/CONTROL.md); None
         #: means the static default
@@ -393,6 +399,25 @@ class FleetServer:
         self._dispatch(rec)
         return fut
 
+    # -- elastic capacity (heat2d_tpu/autoscale/) ----------------------- #
+
+    def add_worker(self) -> int:
+        """Scale-up actuation: grow the pool by one worker. The new
+        worker rejoins through the warm-restart machinery — its
+        ``via="scale_up"`` ready event warm-gates it
+        (``_on_worker_ready``), so until its hot-signature compiles
+        land it is unroutable and scale-up can never put client
+        traffic on an uncompiled worker."""
+        return self.sup.add_worker()
+
+    def retire_worker(self, slot: int, timeout: float = 30.0) -> bool:
+        """Scale-down actuation: drain-to-retire one worker. The
+        supervisor fences the routing table first
+        (``_on_worker_retiring``), then drains; see
+        ``Supervisor.retire_worker`` for the ordering contract.
+        Returns True iff the drain was clean."""
+        return self.sup.retire_worker(slot, timeout=timeout)
+
     # -- admission ----------------------------------------------------- #
 
     def _policy(self, tenant: str) -> TenantPolicy:
@@ -471,11 +496,13 @@ class FleetServer:
     # -- dispatch / failover ------------------------------------------- #
 
     def _routable(self) -> List[int]:
-        """Alive slots minus the still-warming ones — unless ALL alive
-        slots are cold (full-fleet restart): then a cold worker beats
-        parking."""
-        alive = self.sup.alive_slots()
+        """Alive slots minus the still-warming and the retiring ones —
+        unless ALL alive slots are cold (full-fleet restart): then a
+        cold worker beats parking. A retiring slot never routes, even
+        under that fallback: its drain is already under way."""
+        slots = self.sup.alive_slots()
         with self._lock:
+            alive = [s for s in slots if s not in self._retiring]
             warm = [s for s in alive if s not in self._cold]
         return warm or alive
 
@@ -485,7 +512,9 @@ class FleetServer:
         worker can never alias a replay's."""
         tried = set()
         while True:
-            alive = set(self.sup.alive_slots())
+            with self._lock:
+                retiring = set(self._retiring)
+            alive = set(self.sup.alive_slots()) - retiring
             pool = ([rec.slot] if rec.warmup or rec.probe
                     else [s for s in self._routable()
                           if s not in tried])
@@ -675,12 +704,30 @@ class FleetServer:
                                   from_slot=slot, replay=rec.replays)
                 self._dispatch(rec)
 
-    def _on_worker_ready(self, slot: int,
-                         restarted: bool = False) -> None:
-        if restarted:
-            # only REPLACEMENTS warm-gate: a first spawn at fleet start
-            # has no hot set worth waiting for, and gating it would
-            # race the first client dispatches
+    def _on_worker_retiring(self, slot: int) -> None:
+        """The retire fence — fired by the supervisor BEFORE the drain
+        begins (the satellite ordering fix): the slot leaves the
+        routing set here, so no request admitted mid-retire can be
+        routed onto the draining worker. In-flight records for the
+        slot deliberately stay: a clean drain flushes their answers;
+        an unclean one ends in ``_on_worker_lost``, which replays
+        them."""
+        with self._lock:
+            self._retiring.add(slot)
+            self._warming.pop(slot, None)
+            self._cold.discard(slot)
+        log.info("worker %d fenced out of routing for retirement",
+                 slot)
+
+    def _on_worker_ready(self, slot: int, restarted: bool = False,
+                         via: Optional[str] = None) -> None:
+        if restarted or via == "scale_up":
+            # Replacements AND scale-up spawns warm-gate: both join a
+            # fleet with live traffic and a hot-signature set, so they
+            # stay unroutable until their compiles land. Only the
+            # fleet-start first spawns skip the gate — they have no
+            # hot set worth waiting for, and gating them would race
+            # the first client dispatches.
             self._begin_warmup(slot)
         self._flush_parked()
 

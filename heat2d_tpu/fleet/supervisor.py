@@ -70,8 +70,17 @@ class WorkerHandle:
         self.last_seen = self.spawned
         self.ready = False
         self.dead = False
+        #: a deliberate drain (restart/retire) is in progress: the
+        #: shutdown line is — or is about to be — in the pipe. Checked
+        #: UNDER ``write_lock`` by ``_write``, which closes the
+        #: admission race structurally: stdin is FIFO, so any request
+        #: line that won the lock before the drain fence was processed
+        #: before the worker exits, and no line can land after the
+        #: shutdown line (it would be silently dropped by the exiting
+        #: worker and sit un-replayed until its deadline).
+        self.draining = False
         self.restarted = False      # a replacement, not a first spawn
-        self.via = "start"          # start | restart | rollout
+        self.via = "start"          # start | restart | rollout | scale_up
         self.overlay = None         # one-generation env overlay, if any
         self.info = None            # the worker's ready line (tune
         #                             stamp etc.), once it reports
@@ -100,6 +109,7 @@ class Supervisor:
                  on_response: Optional[Callable[[int, dict], None]] = None,
                  on_worker_lost: Optional[Callable[[int], None]] = None,
                  on_worker_ready: Optional[Callable[[int], None]] = None,
+                 on_worker_retiring: Optional[Callable[[int], None]] = None,
                  on_tick: Optional[Callable[[], None]] = None,
                  clock: Optional[Callable[[], float]] = None):
         if workers < 1:
@@ -121,6 +131,12 @@ class Supervisor:
         self.on_response = on_response
         self.on_worker_lost = on_worker_lost
         self.on_worker_ready = on_worker_ready
+        #: fires when a retirement is ADMITTED, strictly before the
+        #: drain begins — the router takes the slot out of its routing
+        #: set here, so no request admitted mid-retire can target the
+        #: draining worker (the ordering the autoscaler's scale-down
+        #: correctness rests on)
+        self.on_worker_retiring = on_worker_retiring
         self.on_tick = on_tick
         #: the dispatch-guarding deadline clock (resil.retry.wait_for
         #: convention): injectable so ready-wait scenarios are
@@ -132,6 +148,10 @@ class Supervisor:
         self._attempts = [0] * workers       # consecutive failed spawns
         self._restart_at = [None] * workers  # due time while slot dead
         self._spawn_counts = [0] * workers   # generations per slot
+        #: slots permanently removed by ``retire_worker`` — never
+        #: respawned (slot indices are not reused; a later
+        #: ``add_worker`` appends a FRESH slot instead)
+        self._retired: set = set()
         self._stop = threading.Event()
         self._monitor: Optional[threading.Thread] = None
         self.restarts = 0
@@ -179,8 +199,11 @@ class Supervisor:
                        if h is not None and not h.dead]
             self._handles = [None] * self.n
         for h in handles:
+            with h.write_lock:
+                h.draining = True
             try:
-                self._write(h, {"event": "shutdown"})
+                self._write(h, {"event": "shutdown"},
+                            during_drain=True)
             except WorkerGone:
                 pass
         deadline = time.monotonic() + timeout
@@ -216,7 +239,7 @@ class Supervisor:
     def send(self, slot: int, obj: dict) -> None:
         with self._lock:
             h = self._handles[slot]
-        if h is None or h.dead:
+        if h is None or h.dead or h.draining:
             raise WorkerGone(f"worker {slot} is not running")
         self._write(h, obj)
 
@@ -278,8 +301,15 @@ class Supervisor:
                 h.dead = True
         unclean = False
         if h is not None:
+            with h.write_lock:
+                # flip the drain fence under the pipe lock: any send
+                # that already won the lock wrote BEFORE this point
+                # (FIFO — the worker processes it before exiting), any
+                # later one is refused by _write's draining check
+                h.draining = True
             try:
-                self._write(h, {"event": "shutdown"})
+                self._write(h, {"event": "shutdown"},
+                            during_drain=True)
             except WorkerGone:
                 pass
             try:
@@ -319,6 +349,126 @@ class Supervisor:
         log.info("deliberate restart of worker %d%s", slot,
                  " (env overlay)" if env_overlay else "")
         self._spawn(slot, overlay=env_overlay, via="rollout")
+
+    # -- the autoscaler's surface (docs/CONTROL.md actuation) ----------- #
+
+    def pool_size(self) -> int:
+        """Provisioned (non-retired) slots — the unit count the
+        capacity model sizes against. Includes slots whose worker is
+        momentarily dead-awaiting-restart or still warming up: those
+        chips are still PAID FOR, which is what sizing is about."""
+        with self._lock:
+            return self.n - len(self._retired)
+
+    def provisioned_slots(self) -> List[int]:
+        """The non-retired slot indices, ascending — the autoscaler
+        picks its scale-down victims from the top of this list."""
+        with self._lock:
+            return [s for s in range(self.n) if s not in self._retired]
+
+    def add_worker(self) -> int:
+        """Scale-up actuation: append ONE fresh slot to the pool and
+        spawn its worker (``via="scale_up"``). Returns the new slot
+        index; the caller learns readiness the usual way
+        (``on_worker_ready`` / ``alive_slots``). Slot indices grow
+        monotonically — retired indices are never reused, so a slot
+        number stays an unambiguous identity across the generations
+        audit trail."""
+        with self._lock:
+            slot = self.n
+            self.n += 1
+            self._handles.append(None)
+            self._attempts.append(0)
+            self._restart_at.append(None)
+            self._spawn_counts.append(0)
+        log.info("scale-up: adding worker slot %d", slot)
+        self._spawn(slot, via="scale_up")
+        if self.registry is not None:
+            self.registry.gauge("fleet_pool_size",
+                                float(self.pool_size()))
+        return slot
+
+    def retire_worker(self, slot: int, timeout: float = 30.0) -> bool:
+        """Scale-down actuation: drain-to-retire one slot, permanently.
+
+        Ordering contract (the satellite fix this path exists for):
+        the slot is FENCED before the drain begins —
+
+        1. under ``_lock``: the slot joins ``_retired`` (no respawn,
+           ever), its backoff timer clears, and its handle goes dead
+           (``alive_slots`` stops listing it, ``send`` refuses it,
+           the monitor's death path is disarmed);
+        2. ``on_worker_retiring`` tells the router to drop the slot
+           from its routing table;
+        3. only THEN does the drain start: ``draining`` flips under
+           the pipe's ``write_lock`` and the shutdown line goes out —
+           so a request admitted mid-retire either wrote before the
+           fence (FIFO: the worker answers it before exiting) or is
+           refused with ``WorkerGone`` and re-dispatched. It can
+           never land behind the shutdown line.
+
+        In-flight work the worker already holds finishes during the
+        drain (answers flush before exit 0). A drain that outlives
+        ``timeout`` (on the supervisor's injectable ``clock``) is
+        killed and ``on_worker_lost`` replays its in-flight requests.
+        Returns True iff the drain was clean. Idempotent."""
+        with self._lock:
+            if slot in self._retired:
+                return True
+            if not 0 <= slot < self.n:
+                raise ValueError(f"no such slot {slot}")
+            h = self._handles[slot]
+            self._retired.add(slot)
+            self._restart_at[slot] = None
+            if h is not None:
+                h.dead = True   # fence: monitor, alive_slots, send
+        if self.on_worker_retiring is not None:
+            self.on_worker_retiring(slot)
+        clean = True
+        if h is not None:
+            with h.write_lock:
+                h.draining = True
+            try:
+                self._write(h, {"event": "shutdown"},
+                            during_drain=True)
+            except WorkerGone:
+                pass
+            drained = wait_for(lambda: h.proc.poll() is not None,
+                               timeout, clock=self.clock)
+            if not drained:
+                log.warning("worker %d did not drain for retirement; "
+                            "killing", slot)
+                h.proc.kill()
+                try:
+                    h.proc.wait(timeout=10)
+                except subprocess.TimeoutExpired:
+                    pass
+            clean = h.proc.returncode == 0
+            if clean:
+                # clean drain: answers were emitted before exit, but
+                # the reader may still be pulling buffered lines —
+                # close only stdin and let it run to EOF
+                try:
+                    if h.proc.stdin is not None:
+                        h.proc.stdin.close()
+                except OSError:
+                    pass
+            else:
+                self._close_pipes(h)
+        if not clean and self.on_worker_lost is not None:
+            # same rationale as restart_worker: an unclean exit may
+            # have dropped in-flight answers — the router must replay
+            self.on_worker_lost(slot)
+        log.info("worker %d retired (%s drain)", slot,
+                 "clean" if clean else "unclean")
+        if self.registry is not None:
+            self.registry.counter("fleet_worker_retirements_total",
+                                  outcome=("clean" if clean
+                                           else "unclean"))
+            self.registry.gauge("fleet_pool_size",
+                                float(self.pool_size()))
+        self._gauge_alive()
+        return clean
 
     # -- spawn / death / restart --------------------------------------- #
 
@@ -395,7 +545,8 @@ class Supervisor:
                              ", restart" if h.restarted else "")
                     if self.on_worker_ready is not None:
                         self.on_worker_ready(h.slot,
-                                             restarted=h.restarted)
+                                             restarted=h.restarted,
+                                             via=h.via)
                 elif ev == "hb":
                     pass            # last_seen update above is the point
                 elif "id" in msg and self.on_response is not None:
@@ -404,11 +555,30 @@ class Supervisor:
             pass                    # pipe torn down under the reader
         # EOF: the process is exiting; the monitor loop reaps it.
 
-    def _write(self, h: WorkerHandle, obj: dict) -> None:
+    def _write(self, h: WorkerHandle, obj: dict,
+               during_drain: bool = False) -> None:
+        """One request/control line into the worker's stdin.
+
+        The ``draining`` re-check happens UNDER ``write_lock`` — the
+        fence that makes deliberate drains race-free: ``send`` may
+        have read ``draining=False`` an instant before the drain
+        began, but it cannot WRITE after the shutdown line, because
+        the drain path flips the flag and emits the shutdown while
+        holding this same lock (``during_drain=True`` is that path's
+        own pass). A line refused here raises ``WorkerGone`` and the
+        router re-dispatches — instead of the old failure mode where
+        the line landed behind the shutdown, was dropped by the
+        exiting worker, and its request hung to deadline."""
         try:
             with h.write_lock:
+                if h.draining and not during_drain:
+                    raise WorkerGone(
+                        f"worker {h.slot} is draining for a deliberate "
+                        f"restart/retire")
                 h.proc.stdin.write(json.dumps(obj) + "\n")
                 h.proc.stdin.flush()
+        except WorkerGone:
+            raise
         except (BrokenPipeError, OSError, ValueError) as e:
             raise WorkerGone(f"worker {h.slot}: {e!r}") from None
 

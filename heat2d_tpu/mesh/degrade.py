@@ -192,7 +192,14 @@ def serving_invariant(monitor: HealthMonitor,
     launch picked its devices (rows carry the monitor's event ``seq``
     fence captured at selection time — a pure ordering check, no
     clock races). The structural twin of the control plane's
-    ``no_unvalidated_serving``."""
+    ``no_unvalidated_serving``.
+
+    Parole-aware: a device's status at a launch's fence is decided by
+    the LATEST health event at or before the fence — a conviction is
+    a violation, a ``kind="readmit"`` parole row clears it. A device
+    re-convicted after its parole violates again for later launches,
+    so the invariant stays provable through the whole quarantine →
+    parole → (maybe re-quarantine) lifecycle."""
     violations = []
     events = monitor.snapshot()["events"]
     for row in launch_log:
@@ -201,10 +208,16 @@ def serving_invariant(monitor: HealthMonitor,
         seq = mesh.get("health_seq")
         if devices is None or seq is None:
             continue
+        # events are appended in seq order: last write <= fence wins
+        status = {}
         for ev in events:
-            if ev["seq"] <= seq and ev["device"] in devices:
+            if ev["seq"] <= seq:
+                status[ev["device"]] = ev
+        for d in devices:
+            ev = status.get(d)
+            if ev is not None and ev.get("kind") != "readmit":
                 violations.append({"launch": row.get("signature"),
-                                   "device": ev["device"],
+                                   "device": d,
                                    "event": dict(ev)})
     return {"ok": not violations, "checked": len(launch_log),
             "violations": violations}

@@ -121,6 +121,55 @@ class MeshEnsembleEngine(EnsembleEngine):
         #: calls are serialized by the dispatcher (the same assumption
         #: ``_tag_launch``'s launch_log[-1] already makes).
         self._launch_perf: Optional[dict] = None
+        #: voluntary device-count target (``resize``); None = the full
+        #: attached mesh. Orthogonal to quarantine: launches form over
+        #: the SURVIVORS truncated to this target.
+        self._resize_target: Optional[int] = None
+        #: one row per ``resize`` call — the actuation audit trail the
+        #: autoscale record carries
+        self.resize_log: List[dict] = []
+
+    # -- voluntary resize ---------------------------------------------- #
+
+    def resize(self, n: int) -> dict:
+        """Voluntarily resize the serving mesh to ``n`` devices — both
+        directions (the generalization of shrink-and-requeue's forced
+        shrink). Shrinking is immediate: the next launch forms its
+        mesh over the first ``n`` survivors and the capacity ladder
+        re-pads to the new device multiple. Growing back (up to the
+        attached mesh) is just as immediate — devices were never
+        released, only unused. Results stay bitwise-identical on every
+        size (the mesh-vs-single parity contract). When a fault policy
+        is armed the row carries the health fence at decision time, so
+        the resize ordering is auditable against quarantine events."""
+        n = int(n)
+        if not 1 <= n <= self.n_devices:
+            raise ValueError(
+                f"resize target must be in [1, {self.n_devices}], "
+                f"got {n}")
+        prev = (self._resize_target if self._resize_target is not None
+                else self.n_devices)
+        self._resize_target = None if n == self.n_devices else n
+        row = {"from": prev, "to": n,
+               "health_seq": (self.health.seq()
+                              if self.health is not None else None)}
+        self.resize_log.append(row)
+        if self.registry is not None:
+            self.registry.counter(
+                "mesh_resize_total",
+                direction=("up" if n > prev
+                           else "down" if n < prev else "hold"))
+            self.registry.gauge("mesh_target_devices", float(n))
+        return row
+
+    def active_devices(self) -> Tuple[int, ...]:
+        """The device set the next launch forms its mesh over: the
+        quarantine survivors (everything attached, without a fault
+        policy) truncated to the voluntary resize target."""
+        devs = (self.health.survivors() if self.health is not None
+                else tuple(range(self.n_devices)))
+        t = self._resize_target
+        return devs if t is None else devs[:t]
 
     # -- dispatch ------------------------------------------------------ #
 
@@ -140,6 +189,17 @@ class MeshEnsembleEngine(EnsembleEngine):
                                       reason="quarantined")
             decision = dict(decision, route="batch",
                             reason="quarantined")
+            route = "batch"
+        if route == "spatial" and self._resize_target is not None:
+            # Voluntary resize: the spatial program likewise spans the
+            # whole attached mesh — while a smaller mesh is the target,
+            # the signature rides the (resizable) batch route instead,
+            # bitwise-identically (same contract as the quarantine
+            # reroute above).
+            if self.registry is not None:
+                self.registry.counter("mesh_fallback_total",
+                                      reason="resized")
+            decision = dict(decision, route="batch", reason="resized")
             route = "batch"
         if route == "batch":
             return self._solve_batch_mesh(requests, decision)
@@ -203,9 +263,16 @@ class MeshEnsembleEngine(EnsembleEngine):
         tuned = self._preresolve_tuned(req0)
         n = len(requests)
         if self.degrader is None:
+            # voluntary resize applies on the unguarded route too: an
+            # explicit device subset when a target is set, the full
+            # attached mesh (the byte-identical PR 13 path) otherwise
+            active = self.active_devices()
+            subset = (None if len(active) == self.n_devices
+                      else active)
             u, steps_done, capacity, _ab = self._launch_batch(
-                requests, None, False)
-            self._account(req0, n, capacity, tuned, decision)
+                requests, subset, False)
+            self._account(req0, n, capacity, tuned, decision,
+                          devices=subset)
             return [(u[i], steps_done[i]) for i in range(n)]
         return self._solve_batch_guarded(requests, decision, tuned)
 
@@ -343,7 +410,8 @@ class MeshEnsembleEngine(EnsembleEngine):
 
         while True:
             seq = self.health.seq()
-            devices = self.health.survivors()
+            # survivors truncated to the voluntary resize target
+            devices = self.active_devices()
             if not devices:
                 raise Rejected(
                     "mesh_degraded",

@@ -54,6 +54,11 @@ PROBE_DEADLINE_S = 5.0
 QUARANTINE_REASONS = ("probe_failure", "device_fail", "mesh_stall",
                      "silent_corruption")
 
+#: consecutive verified probe passes a quarantined device must string
+#: together before ``HealthMonitor.parole`` re-admits it — one lucky
+#: probe is not evidence of health, N in a row is
+PAROLE_PASSES = 3
+
 
 class MeshStallError(RuntimeError):
     """A mesh launch outlived its stall deadline — the structured form
@@ -157,10 +162,10 @@ class HealthMonitor:
 
     def quarantine(self, index: int, reason: str) -> bool:
         """Quarantine ``index`` (idempotent; False = already out).
-        Quarantine is one-way for the life of the process: a device
-        that failed once does not get re-trusted by the layer that
-        caught it — re-admission is an operator decision, not a
-        retry."""
+        Quarantine is one-way by default: a device that failed once
+        does not get re-trusted by the layer that caught it —
+        re-admission is an operator decision (``parole``, which
+        demands consecutive verified probe passes), not a retry."""
         if reason not in QUARANTINE_REASONS:
             raise ValueError(
                 f"reason must be one of {QUARANTINE_REASONS}, got "
@@ -209,6 +214,58 @@ class HealthMonitor:
                     self.registry.counter("mesh_probe_failures_total")
                 self.quarantine(i, reason)
         return out
+
+    def parole(self, index: int, passes: int = PAROLE_PASSES,
+               probe: Optional[Callable[[int], bool]] = None) -> bool:
+        """Re-admit a quarantined device after ``passes`` CONSECUTIVE
+        verified probe passes (the operator decision ``quarantine``'s
+        docstring defers to — quarantine stays one-way unless somebody
+        explicitly asks for parole).
+
+        Each probe is the full place-compute-readback round trip under
+        the stall watchdog; ONE failure (or hang) ends the hearing and
+        the device stays out. Success appends a seq-fenced ``readmit``
+        event — ``kind="readmit"`` — so the serving invariant
+        (mesh/degrade.py) stays a pure integer-ordinal question: a
+        launch fenced AFTER the readmit may use the device, a launch
+        fenced before it may not. Returns True iff re-admitted.
+        ``probe`` is injectable for deterministic tests (defaults to
+        the real ``probe_device``)."""
+        if passes < 1:
+            raise ValueError(f"passes must be >= 1, got {passes}")
+        if not 0 <= index < self.n_devices:
+            raise ValueError(
+                f"device index {index} outside the "
+                f"{self.n_devices}-device mesh")
+        if not self.is_quarantined(index):
+            return False            # nothing to parole
+        probe_fn = probe_device if probe is None else probe
+        for _ in range(passes):
+            try:
+                ok = guarded_call(lambda: probe_fn(index),
+                                  PROBE_DEADLINE_S)
+            except MeshStallError:
+                ok = False
+            if not ok:
+                if self.registry is not None:
+                    self.registry.counter("mesh_parole_total",
+                                          outcome="denied")
+                return False
+        with self._lock:
+            if index not in self._quarantined:
+                return False        # a racing parole already won
+            self._seq += 1
+            row = {"seq": self._seq, "t": self.clock(),
+                   "device": index, "reason": "parole",
+                   "kind": "readmit", "passes": passes}
+            del self._quarantined[index]
+            self.events.append(row)
+            live = self.n_devices - len(self._quarantined)
+        if self.registry is not None:
+            self.registry.counter("mesh_parole_total", outcome="paroled")
+            self.registry.gauge("mesh_quarantined_devices",
+                                float(self.n_devices - live))
+        return True
 
 
 def guarded_call(fn: Callable[[], object],

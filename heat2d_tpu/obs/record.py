@@ -69,10 +69,18 @@ RECORD_SCHEMA = "heat2d-tpu/run-record/v1"
 #: against the analytic roofline models), roofline rows per signature
 #: (achieved vs bound Mcells/s, bytes/cell-step, Mcells-per-HBM-byte),
 #: duty-cycle summary, and the anomaly sentinel's findings beside the
-#: soak verdict — heat2d_tpu/obs/perf.py, docs/OBSERVABILITY.md).
+#: soak verdict — heat2d_tpu/obs/perf.py, docs/OBSERVABILITY.md),
+#: "autoscale" (heat2d-tpu-fleet --autoscale: the elastic soak — the
+#: actuator's action audit trail (scale-ups/downs with victim slots
+#: and drain cleanliness, paroles, mesh resizes), the pool-size trace
+#: against the diurnal envelope, the chip-seconds ledger vs the
+#: static-provisioning baseline with the savings fraction, and the
+#: live-migration rows (checkpoint iteration, wire bytes, destination
+#: slot, bitwise-vs-oracle verdict) beside the autoscale_* metric
+#: families — heat2d_tpu/autoscale/, docs/CONTROL.md "Actuation").
 RECORD_KINDS = ("run", "ensemble", "bench", "sweep", "serve", "tune",
                 "fleet", "inverse", "multichip", "load", "control",
-                "mesh_chaos", "perf")
+                "mesh_chaos", "perf", "autoscale")
 
 
 def run_context() -> dict:

@@ -1,0 +1,629 @@
+"""Elastic capacity (heat2d_tpu/autoscale/, ISSUE 19): the actuator
+that EXECUTES the control plane's sizing advice.
+
+Four tiers, mirroring the subsystem's layers:
+
+- **parole + resize** (mesh/health.py, mesh/engine.py): quarantine
+  parole demands N consecutive verified probe passes, one failure
+  denies; re-admission is a seq-fenced ``readmit`` event the serving
+  invariant stays provable through (including a re-conviction AFTER
+  parole — the mid-parole kill-storm case); voluntary resize validates
+  its bounds and truncates the next launch's device set.
+- **live migration** (autoscale/migrate.py): the Adam state + problem
+  spec round-trip bitwise through the JSON wire ticket, and a solve
+  paused mid-flight and resumed elsewhere is BITWISE-identical to one
+  that never paused — params and every loss in the history.
+- **actuator decisions** (autoscale/actuator.py): cooldowns, the
+  scale-down hold, clamping, step limits, victim selection, the
+  chip-seconds ledger — all on a fake fleet with an injected clock.
+- **drain-to-retire** (fleet/supervisor.py + router.py): the
+  retirement-ordering contract — fence BEFORE drain — at the router
+  level (a fenced slot is unroutable, its in-flight work flushes or
+  replays) and end to end with real worker subprocesses, including the
+  drain-timeout kill + replay leg on an injected clock, and the
+  kill-storm-mid-scale-up case where the only surviving worker is
+  still cold (uncompiled) and must never see client traffic.
+"""
+
+import json
+import time
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from heat2d_tpu.autoscale import Actuator, AutoscalePolicy
+from heat2d_tpu.autoscale import migrate
+from heat2d_tpu.diff.adjoint import make_diff_solve
+from heat2d_tpu.diff.inverse import (AdamState, InverseProblem,
+                                     observation_mask,
+                                     unit_reference_init)
+from heat2d_tpu.fleet import WorkerGone
+from heat2d_tpu.mesh import FaultPolicy, HealthMonitor, MeshEnsembleEngine
+from heat2d_tpu.mesh import health as health_mod
+from heat2d_tpu.mesh.degrade import serving_invariant
+from heat2d_tpu.mesh.health import PAROLE_PASSES
+from heat2d_tpu.obs import MetricsRegistry
+from heat2d_tpu.resil.retry import wait_for
+from tests.test_fleet import STEPS, answer, fleet, make_router
+from tests.test_fleet import req as freq
+
+
+def counters(reg):
+    return reg.snapshot()["counters"]
+
+
+# --------------------------------------------------------------------- #
+# parole — quarantine gains a way back (mesh/health.py)
+# --------------------------------------------------------------------- #
+
+def test_parole_readmits_with_seq_fenced_event():
+    reg = MetricsRegistry()
+    m = HealthMonitor(n_devices=4, registry=reg)
+    m.quarantine(2, "probe_failure")
+    assert m.capacity_fraction() == 0.75
+    fence_before = m.seq()
+    calls = []
+    assert m.parole(2, passes=2, probe=lambda i: calls.append(i) or True)
+    assert calls == [2, 2]              # exactly ``passes`` probes ran
+    assert not m.is_quarantined(2)
+    assert m.capacity_fraction() == 1.0
+    ev = m.snapshot()["events"][-1]
+    assert ev["kind"] == "readmit" and ev["device"] == 2
+    assert ev["passes"] == 2 and ev["seq"] == fence_before + 1
+    assert counters(reg)["mesh_parole_total{outcome=paroled}"] == 1
+
+
+def test_parole_denied_on_any_failure_stays_quarantined():
+    reg = MetricsRegistry()
+    m = HealthMonitor(n_devices=2, registry=reg)
+    m.quarantine(1, "device_fail")
+    calls = []
+
+    def flaky(i):                       # second pass fails
+        calls.append(i)
+        return len(calls) < 2
+
+    assert not m.parole(1, passes=3, probe=flaky)
+    assert calls == [1, 1]              # the hearing ended AT the failure
+    assert m.is_quarantined(1)
+    # a denial leaves no event: the audit trail still reads "convicted"
+    assert all(e.get("kind") != "readmit"
+               for e in m.snapshot()["events"])
+    assert counters(reg)["mesh_parole_total{outcome=denied}"] == 1
+
+
+def test_parole_validation():
+    m = HealthMonitor(n_devices=2)
+    assert not m.parole(0)              # not quarantined: nothing to do
+    with pytest.raises(ValueError):
+        m.parole(0, passes=0)
+    with pytest.raises(ValueError):
+        m.parole(9)
+    assert PAROLE_PASSES >= 2           # a single pass is not a hearing
+
+
+def test_serving_invariant_through_parole_lifecycle():
+    """quarantine -> (violating launch) -> parole -> (clean launch) ->
+    re-conviction mid-serving -> (violating launch): the seq fence
+    keeps every verdict a pure ordinal comparison — the chaos case a
+    kill storm landing mid-parole must stay provable through."""
+    m = HealthMonitor(n_devices=4)
+    log = []
+
+    def launch(devs):
+        log.append({"signature": f"L{len(log)}",
+                    "mesh": {"devices": list(devs),
+                             "health_seq": m.seq()}})
+
+    launch((0, 1, 2, 3))                        # L0: healthy
+    m.quarantine(3, "probe_failure")
+    launch((0, 1, 2))                           # L1: correctly excludes 3
+    launch((0, 1, 3))                           # L2: VIOLATION
+    assert m.parole(3, passes=2, probe=lambda i: True)
+    launch((0, 1, 2, 3))                        # L3: ok — fenced after readmit
+    m.quarantine(3, "mesh_stall")               # the storm re-convicts
+    launch((0, 1, 2, 3))                        # L4: VIOLATION again
+    rep = serving_invariant(m, log)
+    assert not rep["ok"] and rep["checked"] == 5
+    assert sorted(v["launch"] for v in rep["violations"]) == ["L2", "L4"]
+    # the L4 conviction is the re-quarantine, not the original one
+    assert rep["violations"][1]["event"]["reason"] == "mesh_stall"
+
+
+# --------------------------------------------------------------------- #
+# voluntary resize (mesh/engine.py)
+# --------------------------------------------------------------------- #
+
+def test_resize_validates_bounds_and_truncates_launch_set():
+    reg = MetricsRegistry()
+    eng = MeshEnsembleEngine(registry=reg, fault=FaultPolicy())
+    nd = eng.n_devices
+    with pytest.raises(ValueError):
+        eng.resize(0)
+    with pytest.raises(ValueError):
+        eng.resize(nd + 1)
+    row = eng.resize(1)
+    assert row["from"] == nd and row["to"] == 1
+    assert row["health_seq"] == eng.health.seq()
+    assert eng.active_devices() == (0,)
+    back = eng.resize(nd)                       # grow back: devices were
+    assert back["from"] == 1 and back["to"] == nd   # never released
+    assert eng.active_devices() == tuple(range(nd))
+    assert eng.resize_log == [row, back]
+    c = counters(reg)
+    assert c["mesh_resize_total{direction=down}"] == 1
+    assert c["mesh_resize_total{direction=up}"] == 1
+    assert reg.snapshot()["gauges"]["mesh_target_devices"] == nd
+
+
+def test_resize_target_composes_with_quarantine():
+    eng = MeshEnsembleEngine(registry=MetricsRegistry(),
+                             fault=FaultPolicy())
+    nd = eng.n_devices
+    if nd < 3:
+        pytest.skip("needs >= 3 devices to compose resize + quarantine")
+    eng.resize(nd - 1)
+    eng.health.quarantine(0, "device_fail")
+    # survivors first, THEN the voluntary truncation
+    assert eng.active_devices() == tuple(range(1, nd))[:nd - 1]
+    assert 0 not in eng.active_devices()
+
+
+# --------------------------------------------------------------------- #
+# live migration — the wire ticket and the bitwise resume contract
+# --------------------------------------------------------------------- #
+
+NXI = NYI = 8
+ISTEPS, ITERS, PAUSE_AT, LR = 5, 24, 7, 0.05
+
+
+@pytest.fixture(scope="module")
+def tiny_problem():
+    u0 = unit_reference_init(NXI, NYI)
+    u_true = np.asarray(make_diff_solve(NXI, NYI, ISTEPS)(
+        jnp.asarray(u0), 0.1, 0.1))
+    return InverseProblem(nx=NXI, ny=NYI, steps=ISTEPS, target="init",
+                          obs_mask=observation_mask(NXI, NYI, every=1),
+                          obs_values=u_true, cx=0.1, cy=0.1)
+
+
+@pytest.fixture(scope="module")
+def tiny_oracle(tiny_problem):
+    """The unmigrated run every migrated trajectory must match."""
+    return migrate.run_unmigrated(tiny_problem, iterations=ITERS, lr=LR)
+
+
+@pytest.fixture(scope="module")
+def tiny_ticket(tiny_problem):
+    """A checkpoint taken DETERMINISTICALLY at iteration PAUSE_AT."""
+    sol = tiny_problem.solve(iterations=ITERS, lr=LR,
+                             pause=lambda it: it >= PAUSE_AT)
+    assert sol.paused and sol.state.iteration == PAUSE_AT
+    assert len(sol.loss_history) == PAUSE_AT
+    return migrate.encode_ticket(tiny_problem, sol.state,
+                                 iterations=ITERS, lr=LR)
+
+
+def test_adam_state_wire_roundtrip_bitwise():
+    rng = np.random.default_rng(7)
+
+    def arr():
+        return rng.standard_normal((NXI, NYI))
+
+    st = AdamState(iteration=17, params=arr(), m=arr(), v=arr(),
+                   best=arr(), best_loss=0.123456789,
+                   loss_history=[1.0, 0.5],
+                   grad_norm_history=[2.0, 1.25])
+    back = migrate.decode_state(
+        json.loads(json.dumps(migrate.encode_state(st))))
+    for f in ("params", "m", "v", "best"):
+        assert getattr(back, f).dtype == getattr(st, f).dtype
+        assert getattr(back, f).tobytes() == getattr(st, f).tobytes()
+    assert back.iteration == 17
+    assert back.best_loss == st.best_loss
+    assert back.loss_history == st.loss_history
+
+
+def test_ticket_schema_is_validated(tiny_ticket):
+    assert migrate.decode_ticket(json.dumps(tiny_ticket)) == \
+        migrate.decode_ticket(tiny_ticket)
+    with pytest.raises(ValueError):
+        migrate.decode_ticket({"schema": "heat2d-tpu/other/v9"})
+
+
+def test_problem_spec_roundtrip(tiny_problem, tiny_ticket):
+    prob = migrate.problem_from_spec(tiny_ticket["problem"])
+    assert (prob.nx, prob.ny, prob.steps) == (NXI, NYI, ISTEPS)
+    assert prob.target == "init" and prob.method == tiny_problem.method
+    assert np.asarray(prob.obs_values).tobytes() == \
+        np.asarray(tiny_problem.obs_values).tobytes()
+    assert np.array_equal(np.asarray(prob.obs_mask),
+                          np.asarray(tiny_problem.obs_mask))
+
+
+def test_pause_resume_is_bitwise_vs_unmigrated(tiny_ticket, tiny_oracle):
+    """The headline contract: ship the mid-flight ticket over a JSON
+    wire line, resume on 'another worker', and the finished trajectory
+    is indistinguishable from one that never moved."""
+    job = migrate.resume_job(json.dumps(tiny_ticket))
+    job.join(timeout=300)
+    sol = job.solution
+    assert not sol.paused and sol.iterations == ITERS
+    assert np.asarray(sol.params).tobytes() == \
+        np.asarray(tiny_oracle.params).tobytes()
+    assert sol.loss_history == list(tiny_oracle.loss_history)
+    assert sol.grad_norm_history == list(tiny_oracle.grad_norm_history)
+
+
+def test_inverse_job_threaded_checkpoint_resume(tiny_problem):
+    """The actuator's actual path: a RUNNING job is paused at whatever
+    iteration boundary the drain catches it, and the resumed run still
+    lands bitwise on the never-paused oracle."""
+    budget = 5000   # big enough that the pause always lands mid-flight
+    reg = MetricsRegistry()
+    job = migrate.InverseJob(tiny_problem, iterations=budget, lr=LR,
+                             registry=reg).start()
+    assert wait_for(
+        lambda: counters(reg).get("inverse_iterations_total", 0) >= 5,
+        120.0)
+    ticket = job.checkpoint()
+    assert ticket is not None
+    it0 = ticket["state"]["iteration"]
+    assert 0 < it0 < budget
+    resumed = migrate.resume_job(json.dumps(ticket))
+    resumed.join(timeout=300)
+    oracle = migrate.run_unmigrated(ticket)     # budget from the ticket
+    assert np.asarray(resumed.solution.params).tobytes() == \
+        np.asarray(oracle.params).tobytes()
+    assert resumed.solution.loss_history == list(oracle.loss_history)
+
+
+def test_finished_job_checkpoints_to_none(tiny_problem):
+    job = migrate.InverseJob(tiny_problem, iterations=3, lr=LR).start()
+    job.join(timeout=120)
+    assert job.done() and job.completed_iterations() == 3
+    assert job.checkpoint() is None     # nothing to migrate
+
+
+# --------------------------------------------------------------------- #
+# actuator decisions — fake fleet, injected clock
+# --------------------------------------------------------------------- #
+
+class FakeClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+
+class FakeFleet:
+    """The FleetServer surface the actuator drives, minus processes."""
+
+    def __init__(self, n=1):
+        self.sup = self
+        self._slots = list(range(n))
+        self._next = n
+        self.retired = []
+
+    def pool_size(self):
+        return len(self._slots)
+
+    def provisioned_slots(self):
+        return list(self._slots)
+
+    def add_worker(self):
+        slot = self._next
+        self._next += 1
+        self._slots.append(slot)
+        return slot
+
+    def retire_worker(self, slot, timeout=30.0):
+        self._slots.remove(slot)
+        self.retired.append(slot)
+        return True
+
+
+POL = AutoscalePolicy(min_workers=1, max_workers=4, up_cooldown_s=10.0,
+                      down_cooldown_s=10.0, down_hold_ticks=3,
+                      max_step_up=2, max_step_down=1)
+
+
+def test_policy_validation():
+    with pytest.raises(ValueError):
+        AutoscalePolicy(min_workers=0)
+    with pytest.raises(ValueError):
+        AutoscalePolicy(min_workers=3, max_workers=2)
+    with pytest.raises(ValueError):
+        AutoscalePolicy(down_hold_ticks=0)
+    with pytest.raises(ValueError):
+        AutoscalePolicy(drain_timeout_s=0.0)
+
+
+def test_actuator_scale_up_steps_cooldown_and_clamp():
+    fl, clk, reg = FakeFleet(1), FakeClock(), MetricsRegistry()
+    act = Actuator(fl, POL, registry=reg, clock=clk)
+    rows = act.observe({"needed_units": 10})    # clamped to max 4
+    assert [r["action"] for r in rows] == ["scale_up"]
+    assert rows[0]["slots"] == [1, 2]           # max_step_up bounds it
+    assert fl.pool_size() == 3 and rows[0]["target"] == 4
+    clk.t = 5.0
+    assert act.observe({"needed_units": 10}) == []      # up cooldown
+    assert fl.pool_size() == 3
+    clk.t = 12.0
+    act.observe({"needed_units": 10})
+    assert fl.pool_size() == 4                  # converged to the clamp
+    clk.t = 24.0
+    assert act.observe({"needed_units": 9}) == []       # at target
+    c = counters(reg)
+    assert c["autoscale_actions_total{action=scale_up}"] == 2
+    assert reg.snapshot()["gauges"]["autoscale_workers"] == 4.0
+
+
+def test_actuator_scale_down_hold_cooldown_and_victims():
+    fl, clk = FakeFleet(3), FakeClock()
+    act = Actuator(fl, POL, clock=clk)
+    clk.t = 1.0
+    assert act.observe({"needed_units": 1}) == []       # hold 1
+    clk.t = 2.0
+    assert act.observe({"needed_units": 1}) == []       # hold 2
+    clk.t = 3.0
+    rows = act.observe({"needed_units": 1})             # hold met
+    assert [r["action"] for r in rows] == ["scale_down"]
+    assert rows[0]["slot"] == 2 and fl.retired == [2]   # newest first
+    assert fl.pool_size() == 2                  # max_step_down bounds it
+    # hold resets after an action; the cooldown then gates the next one
+    clk.t = 4.0
+    assert act.observe({"needed_units": 1}) == []
+    clk.t = 5.0
+    assert act.observe({"needed_units": 1}) == []
+    clk.t = 6.0
+    assert act.observe({"needed_units": 1}) == []       # held by cooldown
+    assert fl.pool_size() == 2
+    clk.t = 14.0
+    act.observe({"needed_units": 1})
+    assert fl.pool_size() == 1 and fl.retired == [2, 1]
+    # min_workers floor: advice 0 clamps to 1 == current, never below
+    clk.t = 30.0
+    assert act.observe({"needed_units": 0}) == []
+    assert fl.pool_size() == 1
+
+
+def test_actuator_equal_advice_resets_the_hold():
+    fl, clk = FakeFleet(2), FakeClock()
+    act = Actuator(fl, POL, clock=clk)
+    clk.t = 1.0
+    act.observe({"needed_units": 1})            # hold 1
+    clk.t = 2.0
+    act.observe({"needed_units": 2})            # equal: hold resets
+    clk.t = 3.0
+    act.observe({"needed_units": 1})            # hold 1 again
+    clk.t = 4.0
+    act.observe({"needed_units": 1})            # hold 2
+    assert fl.pool_size() == 2                  # still no retire
+    clk.t = 5.0
+    rows = act.observe({"needed_units": 1})     # hold 3: NOW
+    assert rows and fl.pool_size() == 1
+
+
+def test_actuator_chip_seconds_ledger():
+    fl, clk = FakeFleet(2), FakeClock()
+    act = Actuator(fl, POL, clock=clk)
+    act.observe(None)                           # arms the ledger at t=0
+    clk.t = 1.0
+    act.observe(None)                           # + 1s x 2 workers
+    clk.t = 3.0
+    act.observe(None)                           # + 2s x 2 workers
+    s = act.summary()
+    assert s["chip_seconds"] == pytest.approx(6.0)
+    assert s["static_chip_seconds"] == pytest.approx(3.0 * 4)
+    assert s["savings_fraction"] == pytest.approx(0.5)
+    assert s["workers_min"] == s["workers_max"] == 2
+    assert s["trace"] == [(0.0, 2), (1.0, 2), (3.0, 2)]
+
+
+def test_actuator_live_migrates_jobs_on_retire(tiny_ticket, tiny_oracle):
+    """Scale-down with an attached long-running job: checkpoint, JSON
+    wire trip, resume on the lowest surviving slot — then the moved
+    job finishes bitwise on the oracle."""
+
+    class StubJob:
+        def checkpoint(self, timeout=120.0):
+            return tiny_ticket
+
+        def completed_iterations(self):
+            return PAUSE_AT
+
+    fl, reg = FakeFleet(2), MetricsRegistry()
+    act = Actuator(fl, AutoscalePolicy(), registry=reg,
+                   clock=FakeClock())
+    act.attach_job(1, StubJob())
+    row = act.retire(1)
+    assert row["clean"] is True and fl.retired == [1]
+    mig = row["migrated"]
+    assert len(mig) == 1 and mig[0]["resumed"] is True
+    assert mig[0]["from"] == 1 and mig[0]["to"] == 0
+    assert mig[0]["iteration"] == PAUSE_AT and mig[0]["bytes"] > 0
+    moved = act.jobs_on(0)[-1]
+    moved.join(timeout=300)
+    sol = moved.solution
+    assert not sol.paused and sol.iterations == ITERS
+    assert np.asarray(sol.params).tobytes() == \
+        np.asarray(tiny_oracle.params).tobytes()
+    assert sol.loss_history == list(tiny_oracle.loss_history)
+    assert counters(reg)["autoscale_migrations_total"] == 1
+
+
+def test_actuator_finished_job_is_not_migrated():
+    class DoneJob:
+        def checkpoint(self, timeout=120.0):
+            return None                 # finished before the pause
+
+        def completed_iterations(self):
+            return 42
+
+    fl = FakeFleet(2)
+    act = Actuator(fl, AutoscalePolicy(), clock=FakeClock())
+    act.attach_job(1, DoneJob())
+    row = act.retire(1)
+    assert row["migrated"] == [{"from": 1, "to": None,
+                                "iteration": 42, "resumed": False}]
+    assert act.migrations == [] and act.jobs_on(0) == []
+
+
+def test_actuator_parole_all_and_resize(monkeypatch):
+    reg = MetricsRegistry()
+    m = HealthMonitor(n_devices=4, registry=reg)
+    m.quarantine(1, "probe_failure")
+    m.quarantine(3, "device_fail")
+    monkeypatch.setattr(health_mod, "probe_device", lambda i: i == 1)
+    act = Actuator(FakeFleet(1), AutoscalePolicy(parole_passes=2),
+                   registry=reg, health=m, clock=FakeClock())
+    rows = act.parole_all()
+    assert [(r["device"], r["outcome"]) for r in rows] == \
+        [(1, "paroled"), (3, "denied")]
+    assert m.quarantined() == (3,)
+    c = counters(reg)
+    assert c["autoscale_actions_total{action=parole}"] == 2
+    # and the mesh-resize action funnels through the same audit trail
+    eng = MeshEnsembleEngine(registry=reg, fault=FaultPolicy())
+    act.mesh_engine = eng
+    row = act.resize_mesh(1)
+    assert row["action"] == "mesh_resize" and row["to"] == 1
+    assert eng.active_devices() == (0,)
+    act.resize_mesh(eng.n_devices)
+    assert counters(reg)["autoscale_actions_total{action=mesh_resize}"] \
+        == 2
+
+
+# --------------------------------------------------------------------- #
+# drain-to-retire — the router-level ordering contract (fake sup)
+# --------------------------------------------------------------------- #
+
+def test_retiring_fence_blocks_routing_and_unclean_drain_replays():
+    """The satellite ordering fix, observable at the router: once a
+    slot is fenced for retirement, NO new request routes to it; its
+    in-flight work stays recorded and replays on an unclean drain."""
+    fs = make_router()
+    fut = msg = None
+    for i in range(16):                 # land an in-flight on slot 1
+        f = fs.submit(freq(cx=0.4, steps=STEPS + i))
+        s, m = fs.sup.sent[-1]
+        if s == 1:
+            fut, msg = f, m
+            break
+        answer(fs, s, m)
+        f.result(timeout=5)
+    assert fut is not None, "no signature routed to slot 1"
+    fs._on_worker_retiring(1)           # the fence, BEFORE any drain
+    n0 = len(fs.sup.sent)
+    others = [fs.submit(freq(cx=0.5, steps=STEPS + 20 + i))
+              for i in range(4)]
+    assert [s for s, _ in fs.sup.sent[n0:]] == [0, 0, 0, 0]
+    # unclean drain: the fenced slot's in-flight replays to a survivor
+    fs.sup.alive.remove(1)
+    fs._on_worker_lost(1)
+    rs, rm = fs.sup.sent[-1]
+    assert rs == 0 and rm["req"]["steps"] == msg["req"]["steps"]
+    answer(fs, rs, rm)
+    assert fut.result(timeout=5).steps_done == msg["req"]["steps"]
+    for f2, (s, m) in zip(others, fs.sup.sent[n0:n0 + 4]):
+        answer(fs, s, m)
+        f2.result(timeout=5)
+
+
+def test_kill_storm_mid_scale_up_cold_worker_is_fenced():
+    """Chaos coverage (satellite): warm workers die while a scale-up
+    spawn is still compiling. While ANY warm worker survives, the cold
+    spawn never sees a client request; when the storm takes the LAST
+    warm worker, the router's availability fallback replays the
+    in-flight work onto the cold worker rather than stranding it —
+    every request is still answered."""
+    fs = make_router()
+    f = fs.submit(freq(cx=0.2))
+    slot0, msg0 = fs.sup.sent[-1]
+    answer(fs, slot0, msg0)
+    f.result(timeout=5)                 # hot set established
+    fs.sup.alive.append(2)
+    fs._on_worker_ready(2, via="scale_up")      # the scale-up spawn
+    assert 2 in fs._cold
+    warmups = [(s, m) for s, m in fs.sup.sent
+               if m.get("event") == "warmup"]
+    assert len(warmups) == 1 and warmups[0][0] == 2
+    fs.sup.alive.remove(1)              # the storm's first hit
+    fs._on_worker_lost(1)
+    n0 = len(fs.sup.sent)
+    pairs = [(fs.submit(freq(cx=0.3, steps=STEPS + i)), STEPS + i)
+             for i in range(3)]
+    storm_sent = fs.sup.sent[n0:]
+    # a warm worker survives: ALL storm traffic lands on it — the
+    # uncompiled scale-up spawn serves nothing
+    assert len(storm_sent) == 3
+    assert all(s == 0 for s, _ in storm_sent)
+    fs.sup.alive.remove(0)              # the storm takes the last one
+    fs._on_worker_lost(0)
+    replayed = fs.sup.sent[-3:]
+    # whole fleet cold: availability beats the gate, nothing is lost
+    assert all(s == 2 for s, _ in replayed)
+    for s, m in replayed:
+        answer(fs, s, m)
+    for f2, want in pairs:
+        assert f2.result(timeout=5).steps_done == want
+    wslot, wmsg = warmups[0]            # the warm answer readmits it
+    fs._on_response(wslot, {"id": wmsg["id"], "ok": True, "warm": True})
+    assert 2 not in fs._cold
+
+
+# --------------------------------------------------------------------- #
+# drain-to-retire — end to end with real worker subprocesses
+# --------------------------------------------------------------------- #
+
+def test_retire_worker_end_to_end_clean_drain():
+    reg = MetricsRegistry()
+    with fleet(workers=2, registry=reg) as fs:
+        assert fs.solve(freq(cx=0.11), timeout=120).steps_done == STEPS
+        victim = fs.sup.provisioned_slots()[-1]
+        assert fs.retire_worker(victim, timeout=30.0) is True
+        assert fs.sup.pool_size() == 1
+        assert victim not in fs.sup.alive_slots()
+        assert victim not in fs.sup.provisioned_slots()
+        with pytest.raises(WorkerGone):
+            fs.sup.send(victim, {"event": "ping"})
+        assert fs.retire_worker(victim) is True     # idempotent
+        # the survivor still serves, and shutdown stays clean
+        assert fs.solve(freq(cx=0.12, steps=STEPS + 1),
+                        timeout=120).steps_done == STEPS + 1
+        assert fs.stop()
+    c = counters(reg)
+    assert c["fleet_worker_retirements_total{outcome=clean}"] == 1
+    assert reg.snapshot()["gauges"]["fleet_pool_size"] == 1.0
+
+
+def test_retire_drain_timeout_kills_and_replays_injected_clock():
+    """The drain deadline on the supervisor's injectable clock: a
+    worker pinned mid-compile cannot drain, the advanced clock expires
+    the wait deterministically (no wall-clock flake), the worker is
+    killed, and its in-flight request replays to the scale-up spawn —
+    nothing lost."""
+    reg = MetricsRegistry()
+    with fleet(workers=1, registry=reg, max_replays=5) as fs:
+        fut = fs.submit(freq(cx=0.21, steps=STEPS + 2))     # -> slot 0
+        assert fs.add_worker() == 1     # the survivor-to-be
+        time.sleep(0.2)                 # the dispatch is in the pipe
+        t = [0.0]
+
+        def clk():
+            t[0] += 1000.0
+            return t[0]
+
+        fs.sup.clock = clk              # every wait expires immediately
+        clean = fs.retire_worker(0, timeout=5.0)
+        fs.sup.clock = None
+        assert clean is False           # drain timed out -> killed
+        res = fut.result(timeout=120)   # replayed, answered elsewhere
+        assert res.steps_done == STEPS + 2
+        assert fs.stop()
+    c = counters(reg)
+    assert c["fleet_worker_retirements_total{outcome=unclean}"] == 1
