@@ -45,8 +45,12 @@ from typing import Dict, Iterable, List, Optional, Set, Tuple
 ALL_RULES = ("R001", "R002", "R003", "R004", "R005", "R006")
 
 #: directory names never scanned
-SKIP_DIRS = {"tests", "__pycache__", ".git", "build", "dist",
-             ".claude", "benchmarks"}
+SKIP_DIRS = {"tests", "__pycache__", ".git", ".claude", "benchmarks"}
+
+#: skipped only when they are NOT python packages: "dist"/"build" name
+#: setuptools output at the repo root, but heat2d_tpu/dist/ (the pod
+#: runtime) is source — the __init__.py is the tiebreaker
+ARTIFACT_DIRS = {"build", "dist"}
 
 #: callees whose function-valued arguments become traced scopes
 TRACER_CALLS = {
@@ -87,7 +91,7 @@ METRIC_METHODS = {"counter", "gauge", "observe", "series", "timer"}
 #: are not part of the documented contract)
 METRIC_RE = re.compile(
     r"^(serve|fleet|resil|tune|inverse|slo|load|control|mesh|adi|mg"
-    r"|perf|problem|ir|analysis|autoscale)_[a-z0-9_]+$")
+    r"|perf|problem|ir|analysis|autoscale|dist)_[a-z0-9_]+$")
 
 #: keyword names whose literal string values name a metric family
 #: (e.g. ``SingleFlight(counter="fleet_coalesced_total")``)
@@ -356,7 +360,11 @@ def _param_names(fn: ast.AST) -> Set[str]:
 
 def _iter_py_files(root: str) -> Iterable[str]:
     for dirpath, dirnames, filenames in os.walk(root):
-        dirnames[:] = sorted(d for d in dirnames if d not in SKIP_DIRS)
+        dirnames[:] = sorted(
+            d for d in dirnames
+            if d not in SKIP_DIRS
+            and (d not in ARTIFACT_DIRS or os.path.isfile(
+                os.path.join(dirpath, d, "__init__.py"))))
         for f in sorted(filenames):
             if f.endswith(".py"):
                 yield os.path.join(dirpath, f)
@@ -600,7 +608,7 @@ def _code_metric_names(trees: Dict[str, ast.Module]) -> Tuple[
 
 _DOC_METRIC_RE = re.compile(
     r"`((?:serve|fleet|resil|tune|inverse|slo|load|control|mesh|adi|mg"
-    r"|perf|problem|ir|analysis|autoscale)_[a-z0-9_*]+)"
+    r"|perf|problem|ir|analysis|autoscale|dist)_[a-z0-9_*]+)"
     r"(?:\{[^`]*\})?`")
 
 

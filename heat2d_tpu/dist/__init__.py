@@ -1,0 +1,36 @@
+"""Multihost pod runtime — N processes (one per host), one logical
+mesh, one serving surface (ROADMAP item 3; docs/DISTRIBUTED.md).
+
+Layers (each a module, each consultable on its own):
+
+- ``runtime``  — bring-up: ``jax.distributed`` rendezvous, the
+  ``DistWorld`` topology object, KV-backed bounded barriers and
+  heartbeats that turn a dead peer into a named ``HostLostError``
+  instead of a hang.
+- ``exchange`` — the host-mediated DCN halo route: per-process row
+  slabs with T-deep halos over the coordination-service KV store,
+  bitwise-equal to the single-process program (the route CI proves
+  with real 2-process CPU runs, where cross-process XLA collectives
+  are unavailable).
+- ``mesh``     — the global 2-axis ('batch', 'xy') device arrangement
+  spanning hosts: host-major ordering that keeps the spatial axis
+  intra-host, and the DCN-seam profile the scheduler prices.
+- ``topology`` — the failure-domain bridge: a host loss presents to
+  the fleet supervisor as a process death AND to the mesh scheduler
+  as that host's devices quarantined (seq-fenced, reusing
+  ``mesh/health.py``), recovered in one transaction under the
+  existing ``serving_invariant``.
+- ``harness``  — the reusable multi-process spawn/rendezvous/collect
+  test harness (the promoted ``test_multihost.py`` capability probe).
+- ``cli``      — ``heat2d-tpu-dist``: mpiexec-style worker launch plus
+  the ``--selftest`` bitwise-parity and ``--soak --kill-host`` legs
+  CI's dist-gate runs.
+"""
+
+from heat2d_tpu.dist.runtime import (     # noqa: F401
+    DistWorld, Heartbeat, HostLostError, KVBarrier, bring_up,
+    elect_recovery_owner, kv_client)
+from heat2d_tpu.dist.exchange import (    # noqa: F401
+    DcnHaloExchanger, run_process_slab, slab_split)
+from heat2d_tpu.dist.topology import (    # noqa: F401
+    FailureDomainBridge, PodTopology, pod_monitor)

@@ -1,0 +1,536 @@
+"""``heat2d-tpu-dist`` — the mpiexec-style multihost launch surface.
+
+Three shapes, one binary (the reference's ``mpiexec -np N ./heat``
+launch line, SURVEY.md §2.4, with the driver legs CI's dist-gate
+runs bolted on):
+
+- **worker** (``--process-id`` given, or ``--num-processes 1``): one
+  process of the pod. Rendezvous, heartbeats, the DCN slab route
+  (dist/exchange.py), collective KV-gathered checkpoints, and — on a
+  ``HostLostError`` — the unified shrink+failover transaction
+  (dist/topology.py) finishing the job from the last committed
+  checkpoint, all under the seq-fenced ``serving_invariant``.
+- **``--selftest``**: spawns its own 2-process world, then asserts
+  the final grid is BITWISE identical to the single-process program
+  on the same grid — the correctness anchor.
+- **``--soak --kill-host``**: spawns a paced 2-process soak, SIGKILLs
+  the non-coordinator host after the first committed checkpoint, and
+  asserts the survivor recovered through the coordinated
+  shrink+failover path: bitwise final parity AND
+  ``serving_invariant.ok`` in the kind="dist" run record.
+
+Post-loss exits use ``os._exit``: jax's atexit shutdown would block
+waiting for the dead peer to disconnect — a survivor that already
+wrote and fsynced its outputs owes the corpse nothing.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import sys
+import tempfile
+import time
+
+import numpy as np
+
+from heat2d_tpu.dist.exchange import (
+    DcnHaloExchanger, run_process_slab, slab_split)
+from heat2d_tpu.dist.runtime import (
+    KV_NS, Heartbeat, HostLostError, KVBarrier, bring_up,
+    elect_recovery_owner, kv_client, kv_get_bytes)
+from heat2d_tpu.dist.topology import (
+    FailureDomainBridge, PodTopology, pod_monitor)
+
+
+def _args(argv=None):
+    p = argparse.ArgumentParser(
+        prog="heat2d-tpu-dist",
+        description="multihost pod runtime (docs/DISTRIBUTED.md)")
+    w = p.add_argument_group("world (mpiexec-style)")
+    w.add_argument("--coordinator", default=None,
+                   help="host:port of process 0's coordination service")
+    w.add_argument("--num-processes", type=int, default=1)
+    w.add_argument("--process-id", type=int, default=None)
+    g = p.add_argument_group("problem")
+    g.add_argument("--nx", type=int, default=48)
+    g.add_argument("--ny", type=int, default=32)
+    g.add_argument("--steps", type=int, default=16)
+    g.add_argument("--segment", type=int, default=4,
+                   help="halo depth = steps per exchange segment")
+    g.add_argument("--cx", type=float, default=0.1)
+    g.add_argument("--cy", type=float, default=0.1)
+    s = p.add_argument_group("state")
+    s.add_argument("--checkpoint", default=None,
+                   help="collective checkpoint path (KV-gathered, "
+                        "committed crash-consistently by process 0)")
+    s.add_argument("--checkpoint-every", type=int, default=0,
+                   help="steps between checkpoints (0 = off)")
+    s.add_argument("--resume", default=None,
+                   help="checkpoint to resume from (any saving "
+                        "process count — reshard is a slice)")
+    s.add_argument("--out", default=None,
+                   help="final full-grid raw f32 (written by the "
+                        "recovery owner / process 0)")
+    s.add_argument("--run-record", default=None)
+    t = p.add_argument_group("liveness")
+    t.add_argument("--halo-timeout", type=float, default=60.0,
+                   help="bounded wait for a peer's strip/shard "
+                        "before declaring it lost")
+    t.add_argument("--heartbeat", type=float, default=0.0,
+                   help="beacon interval seconds (0 = off)")
+    t.add_argument("--pace", type=float, default=0.0,
+                   help="sleep per segment (soak windowing)")
+    t.add_argument("--marker", default=None,
+                   help="file process 0 touches after the first "
+                        "committed checkpoint (soak kill window)")
+    d = p.add_argument_group("driver legs (spawn their own world)")
+    d.add_argument("--selftest", action="store_true",
+                   help="2-process vs single-process bitwise parity")
+    d.add_argument("--soak", action="store_true")
+    d.add_argument("--kill-host", action="store_true",
+                   help="SIGKILL the non-coordinator host mid-soak")
+    d.add_argument("--outdir", default=None)
+    return p.parse_args(argv)
+
+
+def _say(world, msg: str) -> None:
+    print(f"[dist p{world.process_index}/{world.process_count}] {msg}",
+          flush=True)
+
+
+def _metric_totals(reg) -> dict:
+    """The dist_* families as plain numbers for the run record."""
+    out = {}
+    for name in ("dist_halo_bytes_total", "dist_host_lost_total",
+                 "dist_checkpoint_gather_bytes_total"):
+        vals = reg.find_counters(name)
+        if vals:
+            out[name] = float(sum(vals.values()))
+    for name in ("dist_rendezvous_s", "dist_heartbeat_age_s"):
+        vals = reg.find_gauges(name)
+        if vals:
+            out[name] = {("" if not k else str(dict(k))): v
+                         for k, v in vals.items()}
+    return out
+
+
+def _write_record(path, extra: dict) -> None:
+    from heat2d_tpu.io.binary import write_text_atomic
+    from heat2d_tpu.obs.record import build_record
+
+    rec = build_record("dist", extra=extra)
+    write_text_atomic(json.dumps(rec, indent=2, default=str,
+                                 sort_keys=True), path)
+
+
+# ------------------------------------------------------------------ #
+# worker
+# ------------------------------------------------------------------ #
+
+def _load_state(args):
+    """(full grid at start, start step) — resume is process-count
+    agnostic: every process loads the FULL committed grid and slices
+    its own slab (the N-save → M-restore reshard contract)."""
+    from heat2d_tpu.io import load_checkpoint
+    from heat2d_tpu.ops import inidat
+
+    if args.resume:
+        grid, step, _ = load_checkpoint(args.resume)
+        return np.asarray(grid, np.float32), int(step)
+    return np.asarray(inidat(args.nx, args.ny), np.float32), 0
+
+
+def _save_collective(args, world, barrier, owned, step, reg) -> None:
+    """N-process checkpoint: every process publishes its OWNED slab
+    to the KV store; process 0 assembles the full grid and commits it
+    through the crash-consistent single-file path (io/binary.py), and
+    the closing barrier keeps every rank behind the commit — the same
+    no-rank-outruns-the-commit rule write_binary_sharded enforces."""
+    from heat2d_tpu.io import save_checkpoint
+
+    cfg = {"nx": args.nx, "ny": args.ny, "steps": args.steps,
+           "segment": args.segment, "cx": args.cx, "cy": args.cy,
+           "processes": world.process_count}
+    if world.process_count == 1:
+        save_checkpoint(owned, step, cfg, args.checkpoint)
+        return
+    client = kv_client()
+    client.key_value_set_bytes(
+        f"{KV_NS}ck/{step}/{world.process_index}", owned.tobytes())
+    reg.counter("dist_checkpoint_gather_bytes_total",
+                float(owned.nbytes))
+    if world.process_index == 0:
+        slabs = []
+        for pr, (lo, hi) in enumerate(
+                slab_split(args.nx, world.process_count)):
+            buf = kv_get_bytes(
+                client, f"{KV_NS}ck/{step}/{pr}", args.halo_timeout,
+                lost_host=pr, phase=f"checkpoint:{step}")
+            slabs.append(np.frombuffer(buf, np.float32)
+                         .reshape(hi - lo, args.ny))
+        save_checkpoint(np.concatenate(slabs, axis=0), step, cfg,
+                        args.checkpoint)
+    barrier.wait(f"ck{step}", timeout_s=args.halo_timeout)
+    if world.process_index == 0:
+        client.key_value_delete(f"{KV_NS}ck/{step}/")
+
+
+def _gather_final(args, world, owned) -> np.ndarray:
+    """Process 0 assembles the final grid from every rank's owned
+    slab (peers publish and exit; the KV store outlives them)."""
+    if world.process_count == 1:
+        return owned
+    client = kv_client()
+    me = world.process_index
+    if me != 0:
+        client.key_value_set_bytes(f"{KV_NS}final/{me}",
+                                   owned.tobytes())
+        return owned
+    slabs = [owned]
+    for pr, (lo, hi) in list(enumerate(
+            slab_split(args.nx, world.process_count)))[1:]:
+        buf = kv_get_bytes(
+            client, f"{KV_NS}final/{pr}", args.halo_timeout,
+            lost_host=pr, phase="final_gather")
+        slabs.append(np.frombuffer(buf, np.float32)
+                     .reshape(hi - lo, args.ny))
+    return np.concatenate(slabs, axis=0)
+
+
+def _worker(args) -> int:
+    from heat2d_tpu.mesh.degrade import serving_invariant
+    from heat2d_tpu.obs.metrics import MetricsRegistry
+
+    reg = MetricsRegistry()
+    world = bring_up(args.coordinator, args.num_processes,
+                     args.process_id, registry=reg)
+    _say(world, f"world up: {world.summary()}")
+    barrier = KVBarrier(world, registry=reg)
+    hb = None
+    if args.heartbeat > 0 and world.process_count > 1:
+        hb = Heartbeat(world, interval_s=args.heartbeat, registry=reg)
+        hb.start()
+
+    topology = PodTopology.from_world(world)
+    monitor = pod_monitor(topology.n_devices, registry=reg)
+    bridge = FailureDomainBridge(topology, monitor, registry=reg)
+    sig = f"dist:{args.nx}x{args.ny}:s{args.steps}"
+    launch_log = [{"signature": sig,
+                   "mesh": {"devices": list(range(topology.n_devices)),
+                            "health_seq": monitor.seq()}}]
+
+    u0, start = _load_state(args)
+    exchanger = None
+    if world.process_count > 1:
+        exchanger = DcnHaloExchanger(
+            world, args.segment, timeout_s=args.halo_timeout,
+            registry=reg)
+
+    state = {"last_ck": start if args.resume else None}
+
+    def on_segment(step, owned):
+        if args.pace > 0:
+            time.sleep(args.pace)
+        if hb is not None:
+            hb.ages()     # sample dist_heartbeat_age_s each segment
+        due = (args.checkpoint and args.checkpoint_every
+               and step % args.checkpoint_every == 0)
+        if due:
+            _save_collective(args, world, barrier, owned, step, reg)
+            state["last_ck"] = step
+            if args.marker and world.process_index == 0 \
+                    and not os.path.exists(args.marker):
+                from heat2d_tpu.io.binary import write_text_atomic
+                write_text_atomic(str(step), args.marker)
+
+    try:
+        barrier.wait("world-up", timeout_s=args.halo_timeout)
+        owned, step = run_process_slab(
+            args.nx, args.ny, args.steps, cx=args.cx, cy=args.cy,
+            depth=args.segment, process_index=world.process_index,
+            process_count=world.process_count, exchanger=exchanger,
+            u0=u0, start_step=start, on_segment=on_segment)
+        full = _gather_final(args, world, owned)
+        if world.process_index == 0:
+            if args.out:
+                from heat2d_tpu.io import write_binary
+                write_binary(full, args.out)
+            if args.run_record:
+                _write_record(args.run_record, {
+                    "leg": "run", "world": world.summary(),
+                    "steps_done": step, "resume_from_step": start,
+                    "last_checkpoint_step": state["last_ck"],
+                    "launch_log": launch_log,
+                    "serving_invariant":
+                        serving_invariant(monitor, launch_log),
+                    "bridge": bridge.snapshot(),
+                    "metrics": _metric_totals(reg),
+                })
+            _say(world, f"done: steps={step}")
+        barrier.wait("done", timeout_s=args.halo_timeout)
+        if hb is not None:
+            hb.stop()
+        return 0
+    except HostLostError as e:
+        return _recover(args, world, e, bridge, monitor, launch_log,
+                        hb, reg, sig)
+
+
+def _recover(args, world, e, bridge, monitor, launch_log, hb, reg,
+             sig) -> int:
+    """The unified shrink+failover transaction, run by the elected
+    recovery owner; standby survivors exit clean. Never returns on
+    the owner path — outputs are flushed and the process leaves via
+    ``os._exit`` (module docstring)."""
+    from heat2d_tpu.mesh.degrade import serving_invariant
+
+    lost = set(e.hosts)
+    survivors = [p for p in range(world.process_count)
+                 if p not in lost]
+    _say(world, f"HOST LOST: {e}")
+    ages = {}
+    if hb is not None:
+        try:
+            ages = hb.ages()
+        except Exception:      # noqa: BLE001 — service may be gone
+            pass
+        hb.stop()
+    owner = elect_recovery_owner(survivors)
+    if world.process_index != owner:
+        _say(world, f"standby survivor; p{owner} owns recovery")
+        sys.stdout.flush()
+        os._exit(0)
+
+    def failover() -> dict:
+        fence = monitor.seq()
+        surv_devices = monitor.survivors()
+        u0, ck_step = _load_state(argparse.Namespace(
+            resume=(args.checkpoint
+                    if args.checkpoint
+                    and os.path.exists(str(args.checkpoint)
+                                       + ".meta.json")
+                    else None),
+            nx=args.nx, ny=args.ny))
+        owned, step = run_process_slab(
+            args.nx, args.ny, args.steps, cx=args.cx, cy=args.cy,
+            depth=args.segment, u0=u0, start_step=ck_step)
+        launch_log.append({"signature": sig,
+                           "mesh": {"devices": list(surv_devices),
+                                    "health_seq": fence}})
+        if args.out:
+            from heat2d_tpu.io import write_binary
+            write_binary(owned, args.out)
+        return {"resume_step": ck_step, "steps_done": step,
+                "survivor_devices": list(surv_devices)}
+
+    for i, host in enumerate(sorted(lost)):
+        last = i == len(lost) - 1
+        txn = bridge.on_host_lost(
+            host, failover=failover if last else None)
+    inv = serving_invariant(monitor, launch_log)
+    if args.run_record:
+        _write_record(args.run_record, {
+            "leg": "host_loss_recovery", "world": world.summary(),
+            "lost_hosts": sorted(lost), "phase": e.phase,
+            "error": str(e), "heartbeat_ages": ages,
+            "transaction": txn, "launch_log": launch_log,
+            "serving_invariant": inv,
+            "bridge": bridge.snapshot(),
+            "metrics": _metric_totals(reg),
+        })
+    _say(world, f"recovered through shrink+failover: {txn['failover']}"
+                f" serving_invariant_ok={inv['ok']}")
+    sys.stdout.flush()
+    os._exit(0 if inv["ok"] else 4)
+
+
+# ------------------------------------------------------------------ #
+# driver legs
+# ------------------------------------------------------------------ #
+
+def _reference(args) -> np.ndarray:
+    """The single-process program on the same global grid — the
+    bitwise anchor both driver legs compare against."""
+    ref, _ = run_process_slab(args.nx, args.ny, args.steps,
+                              cx=args.cx, cy=args.cy,
+                              depth=args.segment)
+    return np.asarray(ref, np.float32)
+
+
+def _plain_loop(args) -> np.ndarray:
+    """The UN-segmented single-process program: one COMPILED
+    ``stencil_step`` per step, no segment chunking — proves the
+    segment fori_loop itself changes nothing. Jitted because every
+    engine in this repo serves compiled programs (eager dispatch is
+    not bitwise-comparable: XLA's jit pipeline contracts mul+add
+    into fma on CPU, a different — not wrong — f32 rounding)."""
+    import jax
+
+    from heat2d_tpu.ops import inidat, stencil_step
+
+    step = jax.jit(stencil_step)
+    u = inidat(args.nx, args.ny)
+    for _ in range(args.steps):
+        u = step(u, args.cx, args.cy)
+    return np.asarray(u, np.float32)
+
+
+def _worker_argv(args, outdir, extra):
+    def argv_fn(i, coordinator):
+        return [sys.executable, "-m", "heat2d_tpu.dist.cli",
+                "--coordinator", coordinator,
+                "--num-processes", "2", "--process-id", str(i),
+                "--nx", str(args.nx), "--ny", str(args.ny),
+                "--steps", str(args.steps),
+                "--segment", str(args.segment),
+                "--cx", str(args.cx), "--cy", str(args.cy),
+                "--out", os.path.join(outdir, "dist_final.bin"),
+                "--run-record",
+                os.path.join(outdir, "worker_record.json"),
+                "--heartbeat", "0.5"] + extra
+    return argv_fn
+
+
+def _selftest(args) -> int:
+    from heat2d_tpu.dist.harness import clean_env, spawn_world
+
+    # jax initializes its backend lazily: pinning cpu before the
+    # in-process reference keeps driver and (cpu-forced) children on
+    # the same arithmetic
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    outdir = args.outdir or tempfile.mkdtemp(prefix="heat2d-dist-")
+    os.makedirs(outdir, exist_ok=True)
+    results = spawn_world(
+        2, _worker_argv(args, outdir, []),
+        env=clean_env({"JAX_PLATFORMS": "cpu"}), timeout=300)
+    if not all(r.ok for r in results):
+        for r in results:
+            print(f"--- process {r.process_id} "
+                  f"(rc={r.returncode}) ---\n{r.output}")
+        print("DIST SELFTEST FAILED: world did not complete")
+        return 1
+    got = np.fromfile(os.path.join(outdir, "dist_final.bin"),
+                      np.float32).reshape(args.nx, args.ny)
+    ref = _reference(args)
+    plain = _plain_loop(args)
+    bitwise = got.tobytes() == ref.tobytes()
+    bitwise_plain = got.tobytes() == plain.tobytes()
+    _write_record(
+        args.run_record
+        or os.path.join(outdir, "selftest_record.json"),
+        {"leg": "selftest",
+         "config": {"nx": args.nx, "ny": args.ny,
+                    "steps": args.steps,
+                    "segment": args.segment},
+         "bitwise_equal": bitwise,
+         "bitwise_vs_plain_loop": bitwise_plain,
+         "outdir": outdir})
+    print(f"DIST SELFTEST nx={args.nx} ny={args.ny} "
+          f"steps={args.steps} segment={args.segment} "
+          f"bitwise_equal={bitwise} "
+          f"bitwise_vs_plain_loop={bitwise_plain}")
+    return 0 if bitwise and bitwise_plain else 1
+
+
+def _soak_kill_host(args) -> int:
+    import subprocess
+
+    from heat2d_tpu.dist.harness import clean_env, free_port
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    outdir = args.outdir or tempfile.mkdtemp(prefix="heat2d-dist-")
+    os.makedirs(outdir, exist_ok=True)
+    ck = os.path.join(outdir, "ck.bin")
+    marker = os.path.join(outdir, "marker")
+    wrec = os.path.join(outdir, "worker_record.json")
+    coordinator = f"localhost:{free_port()}"
+    env = clean_env({"JAX_PLATFORMS": "cpu"})
+    argv_fn = _worker_argv(
+        args, outdir,
+        ["--checkpoint", ck,
+         "--checkpoint-every", str(args.checkpoint_every or 8),
+         "--pace", str(args.pace or 0.4),
+         "--marker", marker,
+         "--halo-timeout", str(min(args.halo_timeout, 8.0))])
+    procs = [subprocess.Popen(
+        argv_fn(i, coordinator), env=env, text=True,
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+        for i in range(2)]
+
+    def fail(why: str) -> int:
+        for q in procs:
+            if q.poll() is None:
+                q.kill()
+        outs = [q.communicate()[0] for q in procs]
+        for i, o in enumerate(outs):
+            print(f"--- process {i} ---\n{o}")
+        print(f"DIST SOAK FAILED: {why}")
+        return 1
+
+    deadline = time.monotonic() + 180
+    while not os.path.exists(marker):
+        if time.monotonic() > deadline:
+            return fail("no checkpoint marker within 180s")
+        if any(q.poll() is not None for q in procs):
+            return fail("a worker exited before the kill window")
+        time.sleep(0.02)
+    victim = procs[1]                 # NON-coordinator: the service
+    if victim.poll() is not None:     # lives inside process 0
+        return fail("victim finished before the kill")
+    os.kill(victim.pid, signal.SIGKILL)
+    kill_t = time.monotonic()
+    print(f"killed host 1 (pid {victim.pid}) after marker "
+          f"{marker}", flush=True)
+    victim.communicate()
+    try:
+        out0 = procs[0].communicate(timeout=300)[0]
+    except subprocess.TimeoutExpired:
+        return fail("survivor did not finish within 300s of the kill")
+    print(f"--- survivor (host 0) ---\n{out0}")
+    if procs[0].returncode != 0:
+        return fail(f"survivor exited {procs[0].returncode}")
+    recovery_wall = time.monotonic() - kill_t
+
+    rec = json.load(open(wrec))
+    inv = rec.get("serving_invariant") or {}
+    got = np.fromfile(os.path.join(outdir, "dist_final.bin"),
+                      np.float32).reshape(args.nx, args.ny)
+    ref = _reference(args)
+    bitwise = got.tobytes() == ref.tobytes()
+    ok = (bitwise and rec.get("leg") == "host_loss_recovery"
+          and bool(inv.get("ok"))
+          and rec.get("lost_hosts") == [1])
+    _write_record(
+        args.run_record or os.path.join(outdir, "soak_record.json"),
+        {"leg": "soak_kill_host", "bitwise_equal": bitwise,
+         "recovery_wall_s": recovery_wall,
+         "worker_record": rec, "verdict_ok": ok, "outdir": outdir})
+    print(f"DIST SOAK kill-host recovered={rec.get('leg')} "
+          f"serving_invariant_ok={inv.get('ok')} "
+          f"bitwise_equal={bitwise} ok={ok}")
+    return 0 if ok else 1
+
+
+def main(argv=None) -> int:
+    args = _args(argv)
+    if args.selftest:
+        return _selftest(args)
+    if args.soak:
+        if not args.kill_host:
+            print("--soak requires --kill-host (the one soak shape "
+                  "so far)")
+            return 2
+        return _soak_kill_host(args)
+    if args.num_processes > 1 and (args.coordinator is None
+                                   or args.process_id is None):
+        print("multi-process worker needs --coordinator and "
+              "--process-id (mpiexec-style)")
+        return 2
+    return _worker(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,189 @@
+"""The host-mediated DCN halo route: row slabs + T-deep halos over
+the coordination-service KV store, bitwise-equal to the single-process
+program.
+
+Why this route exists: the global-mesh route (dist/mesh.py) needs
+cross-process XLA collectives, which some harnesses — including this
+repo's CI CPU backend — cannot run ("Multiprocess computations aren't
+implemented on the CPU backend", the exact line the multihost tests
+skip with). Rendezvous, KV, and barriers DO work there, so this
+module carries the correctness anchor with REAL processes: each
+process owns a contiguous row slab, extends it with a T-deep halo of
+its neighbors' OWNED rows, runs ``t <= T`` plain ``stencil_step``
+steps on the extended array, and re-exchanges. Held (clamped) rows at
+a fake slab edge contaminate at one row per step, so after ``t``
+steps every owned row — at distance >= T from any fake edge — is
+BITWISE what the single-process program computes (the same
+elementwise f32 arithmetic on a sliced array; no reductions, no
+reassociation). The same overlap-halo argument as the fused ICI
+route (PR 7), executed over DCN with the host as the DMA engine.
+
+Strips travel as raw f32 bytes under unique per-step keys (the KV
+store forbids overwrite); the consumer deletes what it read, so the
+store stays bounded. A neighbor that never publishes is a
+``HostLostError`` naming that host — detection, not diagnosis;
+recovery is dist/topology.py's job.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Callable, List, Optional, Tuple
+
+import jax
+import numpy as np
+
+from heat2d_tpu.dist.runtime import (
+    KV_NS, DistWorld, kv_client, kv_get_bytes)
+
+
+def slab_split(nx: int, processes: int) -> List[Tuple[int, int]]:
+    """Row ranges [lo, hi) per process: near-even, order-preserving,
+    exactly partitioning — the reference's MPI row decomposition
+    (mpi_heat2Dn.c distributes rows the same way)."""
+    if processes < 1:
+        raise ValueError(f"processes must be >= 1, got {processes}")
+    if nx < processes:
+        raise ValueError(
+            f"cannot split {nx} rows over {processes} processes")
+    return [(i * nx // processes, (i + 1) * nx // processes)
+            for i in range(processes)]
+
+
+@functools.partial(jax.jit, static_argnames=("t",))
+def _segment_steps(u, t: int, cx, cy):
+    """``t`` golden stencil steps (ops/stencil.py) on an extended
+    slab — the ONE compiled program both the distributed slabs and
+    the single-process reference run, so parity is a statement about
+    slicing, not about two compilers agreeing."""
+    from jax import lax
+
+    from heat2d_tpu.ops import stencil_step
+
+    return lax.fori_loop(
+        0, t, lambda i, v: stencil_step(v, cx, cy), u)
+
+
+class DcnHaloExchanger:
+    """Publishes this process's boundary strips and fetches its
+    neighbors' — one exchange per segment, keyed by step so keys are
+    write-once. Counts ``dist_halo_bytes_total`` (bytes moved, both
+    directions) per exchange."""
+
+    def __init__(self, world: DistWorld, depth: int, client=None, *,
+                 timeout_s: float = 60.0, registry=None):
+        if depth < 1:
+            raise ValueError(f"halo depth must be >= 1, got {depth}")
+        self.world = world
+        self.depth = depth
+        self._client = client
+        self.timeout_s = timeout_s
+        self.registry = registry
+
+    def _kv(self):
+        if self._client is None:
+            self._client = kv_client()
+        return self._client
+
+    def _key(self, tag: str, src: int, dst: int) -> str:
+        return f"{KV_NS}halo/{tag}/{src}-{dst}"
+
+    def exchange(self, tag: str, top: np.ndarray,
+                 bottom: np.ndarray) -> Tuple[Optional[np.ndarray],
+                                              Optional[np.ndarray]]:
+        """Send my top/bottom OWNED strips to my row neighbors; return
+        (rows_above, rows_below) — None at a true global boundary.
+        ``top``/``bottom`` are (depth, ny) f32 arrays."""
+        client = self._kv()
+        me = self.world.process_index
+        count = self.world.process_count
+        up = me - 1 if me > 0 else None
+        down = me + 1 if me < count - 1 else None
+        moved = 0
+        # publish before fetching: both neighbors can then progress
+        # regardless of arrival order
+        if up is not None:
+            client.key_value_set_bytes(
+                self._key(tag, me, up), np.ascontiguousarray(top)
+                .tobytes())
+            moved += top.nbytes
+        if down is not None:
+            client.key_value_set_bytes(
+                self._key(tag, me, down), np.ascontiguousarray(bottom)
+                .tobytes())
+            moved += bottom.nbytes
+
+        def fetch(src: int, like: np.ndarray) -> np.ndarray:
+            key = self._key(tag, src, me)
+            buf = kv_get_bytes(client, key, self.timeout_s,
+                               lost_host=src, phase=f"halo:{tag}")
+            client.key_value_delete(key)   # consumed: bound the store
+            return np.frombuffer(buf, dtype=np.float32).reshape(
+                like.shape)
+
+        above = fetch(up, top) if up is not None else None
+        below = fetch(down, bottom) if down is not None else None
+        moved += sum(a.nbytes for a in (above, below) if a is not None)
+        if self.registry is not None:
+            self.registry.counter("dist_halo_bytes_total", float(moved))
+        return above, below
+
+
+def run_process_slab(nx: int, ny: int, steps: int, *,
+                     cx: float = 0.1, cy: float = 0.1,
+                     depth: int = 4,
+                     process_index: int = 0, process_count: int = 1,
+                     exchanger: Optional[DcnHaloExchanger] = None,
+                     u0: Optional[np.ndarray] = None,
+                     start_step: int = 0,
+                     on_segment: Optional[Callable] = None
+                     ) -> Tuple[np.ndarray, int]:
+    """Run this process's slab from ``start_step`` to ``steps``;
+    returns (owned rows as f32 numpy, final step).
+
+    ``u0`` is the FULL grid at ``start_step`` (default: the golden
+    initial condition) — every process slices its own extension from
+    it, so a resume at any step count resharding to any process count
+    is just "load the checkpoint, call this" (the N-save → M-restore
+    contract tests/test_dist_reshard.py pins bitwise).
+    ``on_segment(step, owned)`` fires after every segment — the
+    checkpoint hook."""
+    import jax.numpy as jnp
+
+    from heat2d_tpu.ops import inidat
+
+    if process_count > 1 and exchanger is None:
+        raise ValueError("multi-process slabs need an exchanger")
+    if not 0 <= process_index < process_count:
+        raise ValueError(
+            f"process_index {process_index} outside world of "
+            f"{process_count}")
+    lo, hi = slab_split(nx, process_count)[process_index]
+    if process_count > 1 and min(
+            h - l for l, h in slab_split(nx, process_count)) < depth:
+        raise ValueError(
+            f"slab of {nx} rows over {process_count} processes is "
+            f"shallower than the depth-{depth} halo — a neighbor's "
+            "halo would have to span TWO hosts")
+    full = jnp.asarray(inidat(nx, ny) if u0 is None else u0,
+                       dtype=jnp.float32)
+    if full.shape != (nx, ny):
+        raise ValueError(
+            f"u0 shape {full.shape} does not match grid ({nx}, {ny})")
+    elo = max(0, lo - depth)
+    ehi = min(nx, hi + depth)
+    u_ext = full[elo:ehi]
+    step = start_step
+    while step < steps:
+        t = min(depth, steps - step)
+        if process_count > 1:
+            owned = np.asarray(u_ext[lo - elo:hi - elo])
+            above, below = exchanger.exchange(
+                f"s{step}", owned[:depth], owned[-depth:])
+            parts = [p for p in (above, owned, below) if p is not None]
+            u_ext = jnp.asarray(np.concatenate(parts, axis=0))
+        u_ext = _segment_steps(u_ext, t, cx, cy)
+        step += t
+        if on_segment is not None:
+            on_segment(step, np.asarray(u_ext[lo - elo:hi - elo]))
+    return np.asarray(u_ext[lo - elo:hi - elo]), step

@@ -1,0 +1,184 @@
+"""The reusable multi-process spawn/rendezvous/collect harness — the
+``tests/test_multihost.py`` once-per-session capability probe, grown
+into a library both the test suite and the ``heat2d-tpu-dist`` driver
+legs share.
+
+Two capabilities, probed separately because this platform genuinely
+splits them:
+
+- **rendezvous** — ``jax.distributed.initialize`` + the coordination
+  service (KV store, barriers, global device enumeration). Works on
+  plain CPU builds; the DCN halo route and every dist/ bring-up test
+  rides it.
+- **collectives** — cross-process XLA computations (shard_map over a
+  host-spanning mesh). Some jax builds cannot ("Multiprocess
+  computations aren't implemented on the CPU backend") — tests that
+  need them SKIP with that exact backend error line, which is what
+  ``collectives_unsupported_reason`` extracts.
+
+Each probe runs at most once per session (module-level memo), spawns
+REAL processes, and kills-on-timeout with output capture — a probe
+must never hang the suite it protects."""
+
+from __future__ import annotations
+
+import os
+import re
+import socket
+import subprocess
+import sys
+from dataclasses import dataclass
+from typing import Callable, List, Optional, Sequence
+
+#: repo root — children run from here so ``-m heat2d_tpu...`` resolves
+REPO = os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__))))
+
+#: env vars a parent test session may carry that would distort a
+#: child world (device-count forcing, platform pinning)
+_STRIP = ("JAX_PLATFORMS", "XLA_FLAGS")
+
+
+def free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("localhost", 0))
+        return s.getsockname()[1]
+
+
+def clean_env(extra: Optional[dict] = None) -> dict:
+    """The parent's environment minus the vars that would leak this
+    session's platform/device forcing into a child world, plus
+    ``extra`` overrides."""
+    env = {k: v for k, v in os.environ.items() if k not in _STRIP}
+    if extra:
+        env.update(extra)
+    return env
+
+
+@dataclass
+class ProcResult:
+    process_id: int
+    returncode: Optional[int]
+    output: str          # stdout+stderr, merged
+
+    @property
+    def ok(self) -> bool:
+        return self.returncode == 0
+
+
+def first_error_line(outputs: Sequence[str]) -> Optional[str]:
+    """The distinguishing ``...Error:...`` line from a failed world's
+    merged outputs — the exact backend reason a skip must surface."""
+    for out in outputs:
+        m = re.search(r"^.*(?:Error|error):.*$", out, re.MULTILINE)
+        if m:
+            return m.group(0).strip()[:200]
+    return None
+
+
+def spawn_world(num_processes: int,
+                argv_fn: Callable[[int, str], List[str]], *,
+                env: Optional[dict] = None,
+                timeout: float = 180.0,
+                cwd: str = REPO) -> List[ProcResult]:
+    """Launch ``num_processes`` rendezvousing children and collect
+    them: ``argv_fn(process_id, coordinator)`` builds each launch
+    line (the mpiexec analogue — same binary, different rank). One
+    shared free port becomes ``localhost:<port>``; stdout/stderr are
+    merged and captured; a world that outlives ``timeout`` is killed
+    whole (never leave half a rendezvous running under the suite).
+
+    Returns per-process results in process-id order. Timeout marks
+    returncode None — callers treat that as failure, with whatever
+    output made it out."""
+    coordinator = f"localhost:{free_port()}"
+    env = clean_env() if env is None else env
+    procs = [subprocess.Popen(
+        argv_fn(i, coordinator), cwd=cwd, env=env, text=True,
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+        for i in range(num_processes)]
+    results: List[ProcResult] = []
+    timed_out = False
+    for i, p in enumerate(procs):
+        try:
+            out = p.communicate(
+                timeout=None if timed_out else timeout)[0]
+            rc: Optional[int] = p.returncode
+        except subprocess.TimeoutExpired:
+            timed_out = True
+            for q in procs:
+                if q.poll() is None:
+                    q.kill()
+            out = p.communicate()[0]
+            rc = None
+        results.append(ProcResult(i, rc, out or ""))
+    return results
+
+
+# ------------------------------------------------------------------ #
+# once-per-session capability probes
+# ------------------------------------------------------------------ #
+
+_memo: dict = {}
+
+
+def rendezvous_unsupported_reason() -> Optional[str]:
+    """None when a real 2-process ``jax.distributed`` rendezvous +
+    KV round trip works here; otherwise the reason every
+    rendezvous-needing test should skip with."""
+    if "rendezvous" in _memo:
+        return _memo["rendezvous"]
+    prog = (
+        "import sys, jax\n"
+        "jax.distributed.initialize(sys.argv[1], 2, int(sys.argv[2]))\n"
+        "from jax._src import distributed\n"
+        "c = distributed.global_state.client\n"
+        "c.key_value_set('probe/%s' % sys.argv[2], 'up')\n"
+        "peer = '10'[int(sys.argv[2])]\n"
+        "assert c.blocking_key_value_get('probe/' + peer, 60000) == 'up'\n"
+        "print('RENDEZVOUS_OK', jax.process_count())\n")
+    results = spawn_world(
+        2, lambda i, coord: [sys.executable, "-c", prog, coord, str(i)],
+        env=clean_env({"JAX_PLATFORMS": "cpu"}), timeout=120)
+    if all(r.ok for r in results):
+        _memo["rendezvous"] = None
+    else:
+        _memo["rendezvous"] = (
+            first_error_line([r.output for r in results])
+            or f"rendezvous probe exited "
+               f"{[r.returncode for r in results]}")
+    return _memo["rendezvous"]
+
+
+def collectives_unsupported_reason() -> Optional[str]:
+    """None when this harness can run a real 2-process cross-process
+    XLA computation (a minimal dist2d step over a (2, 1) host-spanning
+    mesh); otherwise the exact backend error line — e.g.
+    ``XlaRuntimeError: ... Multiprocess computations aren't
+    implemented on the CPU backend`` — that the multihost test file
+    skips with (green-or-skipped, never silently red)."""
+    if "collectives" in _memo:
+        return _memo["collectives"]
+    import tempfile
+
+    with tempfile.TemporaryDirectory() as td:
+        results = spawn_world(
+            2, lambda i, coord: [
+                sys.executable, "-m", "heat2d_tpu.cli",
+                "--mode", "dist2d", "--gridx", "2", "--gridy", "1",
+                "--nxprob", "8", "--nyprob", "8", "--steps", "1",
+                "--platform", "cpu", "--host-device-count", "1",
+                "--coordinator", coord,
+                "--num-processes", "2", "--process-id", str(i),
+                "--dat-layout", "none", "--outdir", td],
+            timeout=180)
+    if all(r.ok for r in results):
+        _memo["collectives"] = None
+    elif any(r.returncode is None for r in results):
+        _memo["collectives"] = "2-process probe timed out after 180s"
+    else:
+        _memo["collectives"] = (
+            first_error_line([r.output for r in results])
+            or f"probe exited {[r.returncode for r in results]} with "
+               f"no recognizable error line")
+    return _memo["collectives"]

@@ -1,0 +1,100 @@
+"""The global 2-axis ('batch', 'xy') mesh spanning hosts.
+
+The single-process mesh engines (mesh/runner.py batch route, the
+PR 7 fused-halo spatial route) consume a flat DEVICE ORDER and build
+their own meshes — so the pod layer's whole job is to hand them the
+RIGHT order: host-major, so the 'xy' (spatial, halo-ppermute) axis
+stays inside one host wherever the shape allows and only the 'batch'
+axis crosses DCN. SNIPPETS.md [2]'s "8-chip to 6000-chip without
+changing application code" pattern is exactly this: the application
+never learns the pod exists, the arrangement does.
+
+``seam_profile`` prices what the arrangement could not avoid: for a
+(batch, xy) grid it walks every xy-adjacent pair (ring closure
+included — the fused route's halo ppermute is a ring) and classifies
+each seam via ``DistWorld.link_kind``; the scheduler folds the
+resulting DCN/ICI seam counts and per-step bytes into its decision
+rows (mesh/scheduler.py), and tune/measure.py's link model prices the
+same asymmetry for depth tuning."""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+from heat2d_tpu.dist.runtime import DistWorld
+
+
+def pod_device_order(world: DistWorld) -> List[int]:
+    """Global device ordinals, host-major (process-major), stable
+    within a host — the flat order every existing runner consumes."""
+    return [g for p in range(world.process_count)
+            for g in world.devices_of(p)]
+
+
+def arrange_pod(world: DistWorld, batch: int, xy: int) -> List[List[int]]:
+    """Host-major order reshaped (batch, xy): with uniform per-host
+    device counts and ``xy`` dividing them (or them dividing ``xy``),
+    every xy-row touches as few hosts as possible, so halo traffic
+    stays ICI and only batch dispatch crosses DCN."""
+    order = pod_device_order(world)
+    if batch * xy != len(order):
+        raise ValueError(
+            f"({batch}, {xy}) mesh wants {batch * xy} devices, the "
+            f"pod has {len(order)}")
+    return [order[r * xy:(r + 1) * xy] for r in range(batch)]
+
+
+def seam_profile(world: DistWorld, arrangement: Sequence[Sequence[int]],
+                 ny: int, itemsize: int = 4) -> dict:
+    """Classify every xy-adjacent device pair (including the ring
+    wrap) and price the per-step halo edge traffic:
+
+    - ``ici_seams`` / ``dcn_seams`` — seam counts by link class;
+    - ``seam_bytes_per_step`` — 2·ny·itemsize per seam (one strip
+      each way, the fused route's per-step edge traffic);
+    - ``dcn_bytes_per_step`` — the share crossing hosts, the number
+      the scheduler prices against the DCN link bandwidth.
+    """
+    counts = {"ici": 0, "dcn": 0}
+    per_seam = 2 * ny * itemsize
+    dcn_bytes = 0
+    for row in arrangement:
+        k = len(row)
+        if k < 2:
+            continue
+        for j in range(k):
+            a, b = row[j], row[(j + 1) % k]
+            if a == b:
+                continue
+            kind = world.link_kind(a, b)
+            kind = "ici" if kind == "local" else kind
+            counts[kind] += 1
+            if kind == "dcn":
+                dcn_bytes += per_seam
+    total = counts["ici"] + counts["dcn"]
+    return {"ici_seams": counts["ici"], "dcn_seams": counts["dcn"],
+            "seam_bytes_per_step": per_seam * total,
+            "dcn_bytes_per_step": dcn_bytes}
+
+
+def pod_mesh(world: Optional[DistWorld] = None,
+             batch: Optional[int] = None, xy: Optional[int] = None):
+    """A real ``jax.sharding.Mesh`` with axes ('batch', 'xy') over the
+    pod-aware device order. Defaults: the whole world, all devices on
+    'batch' ('xy'=1 — the safe shape everywhere; spatial shapes are
+    the scheduler's call). Requires a backend that can actually run
+    cross-process computations — the CPU CI backend cannot, which is
+    exactly what dist/harness.py's capability probe reports."""
+    import jax
+
+    if world is None:
+        world = DistWorld.from_env()
+    devs = jax.devices()
+    if batch is None or xy is None:
+        batch, xy = len(devs), 1
+    import numpy as np
+
+    grid = np.array(
+        [[devs[g] for g in row]
+         for row in arrange_pod(world, batch, xy)], dtype=object)
+    return jax.sharding.Mesh(grid, ("batch", "xy"))

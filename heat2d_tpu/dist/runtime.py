@@ -1,0 +1,423 @@
+"""Multihost bring-up: rendezvous, topology, bounded liveness.
+
+``parallel/multihost.py`` (the MPI_Init analogue) grew into this
+module: it still owns the raw ``jax.distributed.initialize`` call and
+stays importable unchanged, while everything a *pod* needs on top
+lives here —
+
+- ``bring_up`` — rendezvous + a ``DistWorld``: the process topology,
+  local/global device maps, and DCN-vs-ICI link classification per
+  device pair that every other dist layer consults.
+- ``KVBarrier`` — a BOUNDED barrier over the coordination-service KV
+  store: a peer that never arrives is a ``HostLostError`` naming the
+  missing process(es), not an eternal hang. Clock and sleep are
+  injectable, so the timeout arithmetic is deterministically
+  testable against a fake client.
+- ``Heartbeat`` — seq-keyed liveness beacons per process; age is
+  measured by the LOCAL clock since a peer's counter last advanced
+  (no cross-host clock comparison — the reference's MPI world never
+  had synchronized clocks either, SURVEY.md §2.4).
+
+KV discipline (probed semantics of this jaxlib's coordination
+service): ``key_value_set`` on an existing key raises ALREADY_EXISTS
+— so every writer here uses UNIQUE sequence-numbered keys and
+explicit ``key_value_delete`` GC; ``blocking_key_value_get`` raises
+DEADLINE_EXCEEDED on timeout — mapped to ``HostLostError`` at every
+call site via ``kv_get_bytes``.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Optional, Tuple
+
+from heat2d_tpu.parallel.multihost import (   # noqa: F401  (grown API)
+    gather_to_host, initialize_distributed, shutdown_distributed,
+    world_summary)
+
+#: every coordination-service key this repo writes lives under one
+#: namespace, so a ``key_value_dir_get`` sweep can enumerate (and a
+#: delete can GC) without touching jax-internal keys
+KV_NS = "heat2d/"
+
+#: link classes ``DistWorld.link_kind`` hands out — the vocabulary the
+#: tune link model (tune/measure.py) and the scheduler's seam pricing
+#: (mesh/scheduler.py) price against (docs/DISTRIBUTED.md link table)
+LINK_KINDS = ("local", "ici", "dcn")
+
+
+class HostLostError(RuntimeError):
+    """A peer process (= host) failed to show up inside a bounded
+    wait: missed a barrier, stopped heartbeating, or never published
+    its halo/checkpoint shard. Carries WHICH hosts and during WHAT
+    phase, so recovery can quarantine the right failure domain
+    instead of guessing from a timeout."""
+
+    def __init__(self, hosts, phase: str, detail: str = ""):
+        self.hosts = tuple(sorted(int(h) for h in hosts))
+        self.phase = phase
+        msg = (f"host(s) {list(self.hosts)} lost during {phase}"
+               + (f": {detail}" if detail else ""))
+        super().__init__(msg)
+
+
+def elect_recovery_owner(survivors) -> int:
+    """The deterministic post-loss election: the LOWEST surviving
+    process index owns recovery (assembles state, relaunches, writes
+    the record) — every survivor computes the same answer from the
+    same ``HostLostError``, no extra round trip."""
+    survivors = sorted(int(s) for s in survivors)
+    if not survivors:
+        raise ValueError("no survivors to elect from")
+    return survivors[0]
+
+
+def kv_client():
+    """The coordination-service KV client (the jax.distributed
+    rendezvous already owns one; this just reaches it). Raises
+    RuntimeError when the process never rendezvoused — callers in
+    single-process worlds must not get here."""
+    from jax._src import distributed
+
+    client = getattr(distributed.global_state, "client", None)
+    if client is None:
+        raise RuntimeError(
+            "no coordination-service client: jax.distributed was "
+            "never initialized in this process (single-process "
+            "world, or bring_up() not called)")
+    return client
+
+
+def _is_deadline(exc: BaseException) -> bool:
+    """The timeout verdicts both the real coordination service
+    (XlaRuntimeError DEADLINE_EXCEEDED) and test fakes
+    (TimeoutError) hand back."""
+    return (isinstance(exc, TimeoutError)
+            or "DEADLINE_EXCEEDED" in str(exc))
+
+
+def _is_severed(exc: BaseException) -> bool:
+    """The coordination service itself became unreachable — the
+    COORDINATOR host (process 0 runs the service in-process) is the
+    casualty, whatever key we were waiting on."""
+    s = str(exc)
+    return any(tag in s for tag in
+               ("UNAVAILABLE", "failed to connect", "Connection res",
+                "DISCONNECTED", "CANCELLED"))
+
+
+def kv_get_bytes(client, key: str, timeout_s: float, *,
+                 lost_host: int, phase: str) -> bytes:
+    """Blocking KV get with the one loss-mapping every dist layer
+    shares: a deadline is a ``HostLostError`` naming the host that
+    was supposed to publish ``key``; a severed service names the
+    coordinator (host 0)."""
+    try:
+        return client.blocking_key_value_get_bytes(
+            key, int(timeout_s * 1000))
+    except Exception as e:                   # noqa: BLE001 — re-raised
+        if _is_deadline(e):
+            raise HostLostError(
+                (lost_host,), phase,
+                f"no value at {key!r} within {timeout_s}s") from e
+        if _is_severed(e):
+            raise HostLostError(
+                (0,), phase,
+                f"coordination service unreachable waiting on "
+                f"{key!r}") from e
+        raise
+
+
+@dataclass(frozen=True)
+class DistWorld:
+    """The pod topology every dist layer consults: who am I, who else
+    exists, which devices live where, and what class of link joins
+    any device pair.
+
+    ``device_process[g]`` is the owning process of global device
+    ordinal ``g``; ``device_slice`` (optional) is the ICI domain per
+    device — on TPU pods devices on DIFFERENT hosts within one slice
+    still talk ICI, so slice identity (not process identity) decides
+    ici-vs-dcn when the platform exposes it. Constructable directly
+    with injected maps for simulation tests; ``from_env`` reads the
+    live jax state."""
+
+    process_index: int
+    process_count: int
+    coordinator: Optional[str] = None
+    device_process: Tuple[int, ...] = field(default_factory=tuple)
+    device_slice: Optional[Tuple[int, ...]] = None
+
+    @classmethod
+    def from_env(cls, coordinator: Optional[str] = None) -> "DistWorld":
+        import jax
+
+        devs = jax.devices()
+        slices = tuple(getattr(d, "slice_index", None) for d in devs)
+        # slice identity only means ICI on accelerators; CPU devices
+        # report slice_index 0 too, but cross-process CPU transport
+        # is socket (DCN-class) — fall back to process identity there
+        use_slices = (bool(devs)
+                      and all(s is not None for s in slices)
+                      and not all(getattr(d, "platform", "") == "cpu"
+                                  for d in devs))
+        return cls(
+            process_index=jax.process_index(),
+            process_count=jax.process_count(),
+            coordinator=coordinator,
+            device_process=tuple(d.process_index for d in devs),
+            device_slice=slices if use_slices else None)
+
+    # -- identity ------------------------------------------------------ #
+
+    @property
+    def is_coordinator(self) -> bool:
+        """Process 0 hosts the coordination service (jax.distributed
+        runs it inside the process at the coordinator address)."""
+        return self.process_index == 0
+
+    @property
+    def n_devices(self) -> int:
+        return len(self.device_process)
+
+    def devices_of(self, process: int) -> Tuple[int, ...]:
+        """Global device ordinals owned by ``process`` — the failure
+        domain a host loss takes out in one piece."""
+        return tuple(g for g, p in enumerate(self.device_process)
+                     if p == process)
+
+    def local_devices(self) -> Tuple[int, ...]:
+        return self.devices_of(self.process_index)
+
+    def peers(self) -> Tuple[int, ...]:
+        return tuple(p for p in range(self.process_count)
+                     if p != self.process_index)
+
+    # -- links --------------------------------------------------------- #
+
+    def link_kind(self, a: int, b: int) -> str:
+        """'local' (same device), 'ici' (same ICI domain: same slice
+        when the platform says, same process otherwise), 'dcn'
+        (everything across). The asymmetry the tune link model and
+        the scheduler's seam pricing consume."""
+        if a == b:
+            return "local"
+        if self.device_slice is not None:
+            return ("ici" if self.device_slice[a] == self.device_slice[b]
+                    else "dcn")
+        return ("ici" if self.device_process[a] == self.device_process[b]
+                else "dcn")
+
+    def link_census(self) -> dict:
+        """Unordered device-pair counts per link class — the run
+        record's one-glance topology shape."""
+        out = {k: 0 for k in LINK_KINDS if k != "local"}
+        n = self.n_devices
+        for a in range(n):
+            for b in range(a + 1, n):
+                out[self.link_kind(a, b)] += 1
+        return out
+
+    def summary(self) -> dict:
+        return {
+            "process_index": self.process_index,
+            "process_count": self.process_count,
+            "coordinator": self.coordinator,
+            "n_devices": self.n_devices,
+            "device_process": list(self.device_process),
+            "links": self.link_census(),
+        }
+
+
+def bring_up(coordinator: Optional[str] = None,
+             num_processes: Optional[int] = None,
+             process_id: Optional[int] = None, *,
+             registry=None,
+             clock: Callable[[], float] = time.monotonic) -> DistWorld:
+    """Rendezvous (when a multi-process launch line asks for one) and
+    return the ``DistWorld``. Single-process degrades to a 1-process
+    world without touching jax.distributed — the same code path runs
+    under mpiexec-style launches and plain CLI invocations.
+
+    Records ``dist_rendezvous_s`` (wall time from call to connected
+    world) when a registry rides along."""
+    t0 = clock()
+    multi = (num_processes or 1) > 1 or coordinator is not None
+    if multi:
+        initialize_distributed(coordinator, num_processes, process_id)
+    world = DistWorld.from_env(coordinator)
+    if registry is not None:
+        registry.gauge("dist_rendezvous_s", clock() - t0)
+    return world
+
+
+class KVBarrier:
+    """A named, BOUNDED barrier over the KV store.
+
+    Each ``wait(name)`` call publishes a unique per-invocation key
+    (``heat2d/bar/<name>/<n>/<pid>`` — the per-process invocation
+    counter ``n`` must agree across processes, the same call-ordering
+    contract MPI barriers carry) and polls the directory until all
+    ``process_count`` peers appear or the deadline passes — at which
+    point the MISSING peers are named in a ``HostLostError``. Keys
+    from two invocations back are GC'd (a straggler may still be
+    reading the previous round's).
+
+    Why not the service's native ``wait_at_barrier``: its timeout
+    verdict says only "deadline exceeded", not WHO was missing — this
+    barrier exists precisely to name the corpse."""
+
+    def __init__(self, world: DistWorld, client=None, *,
+                 clock: Callable[[], float] = time.monotonic,
+                 sleep: Callable[[float], None] = time.sleep,
+                 poll: float = 0.02, registry=None):
+        self.world = world
+        self._client = client
+        self.clock = clock
+        self.sleep = sleep
+        self.poll = poll
+        self.registry = registry
+        self._counts: dict = {}
+
+    def _kv(self):
+        if self._client is None:
+            self._client = kv_client()
+        return self._client
+
+    def wait(self, name: str, timeout_s: float = 60.0) -> float:
+        """Block until every process arrives; returns seconds waited.
+        Single-process worlds return immediately."""
+        if self.world.process_count <= 1:
+            return 0.0
+        n = self._counts[name] = self._counts.get(name, -1) + 1
+        client = self._kv()
+        prefix = f"{KV_NS}bar/{name}/{n}/"
+        t0 = self.clock()
+        client.key_value_set(prefix + str(self.world.process_index), "1")
+        want = set(range(self.world.process_count))
+        while True:
+            rows = client.key_value_dir_get(prefix)
+            seen = {int(k.rsplit("/", 1)[-1]) for k, _ in rows}
+            if seen >= want:
+                break
+            if self.clock() - t0 >= timeout_s:
+                raise HostLostError(
+                    sorted(want - seen), f"barrier:{name}",
+                    f"{len(seen)}/{len(want)} arrived in {timeout_s}s")
+            self.sleep(self.poll)
+        waited = self.clock() - t0
+        if self.registry is not None:
+            self.registry.observe("dist_barrier_wait_s", waited,
+                                  barrier=name)
+        if n >= 2:
+            # GC the round a straggler can no longer be reading
+            client.key_value_delete(f"{KV_NS}bar/{name}/{n - 2}/")
+        return waited
+
+
+class Heartbeat:
+    """Per-process liveness beacons with local-clock aging.
+
+    ``beat()`` publishes the next sequence-numbered key under
+    ``heat2d/hb/<pid>/`` and GCs two behind; ``start()`` runs beats on
+    a daemon thread every ``interval_s``. ``ages()`` reads every
+    peer's directory and reports seconds since that peer's counter
+    LAST ADVANCED — measured entirely by this process's clock, so no
+    cross-host clock agreement is assumed. ``require_live`` turns a
+    stale peer into a named ``HostLostError``.
+
+    Clock is injectable (and ``beat``/``ages`` are callable without
+    the thread) so staleness arithmetic is deterministic in tests."""
+
+    def __init__(self, world: DistWorld, client=None, *,
+                 interval_s: float = 1.0,
+                 clock: Callable[[], float] = time.monotonic,
+                 registry=None):
+        self.world = world
+        self._client = client
+        self.interval_s = interval_s
+        self.clock = clock
+        self.registry = registry
+        self._n = 0
+        self._last: dict = {}   # peer -> (last counter, local time)
+        self._t0 = clock()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def _kv(self):
+        if self._client is None:
+            self._client = kv_client()
+        return self._client
+
+    # -- writer -------------------------------------------------------- #
+
+    def beat(self) -> int:
+        """Publish one beacon; returns its sequence number."""
+        client = self._kv()
+        self._n += 1
+        pid = self.world.process_index
+        client.key_value_set(f"{KV_NS}hb/{pid}/{self._n}", "1")
+        if self._n >= 3:
+            client.key_value_delete(f"{KV_NS}hb/{pid}/{self._n - 2}")
+        return self._n
+
+    def start(self) -> None:
+        if self.world.process_count <= 1 or self._thread is not None:
+            return
+        self.beat()
+
+        def loop() -> None:
+            while not self._stop.wait(self.interval_s):
+                try:
+                    self.beat()
+                except Exception:      # noqa: BLE001 — beacon only;
+                    return             # a dead service ends the loop
+
+        self._thread = threading.Thread(
+            target=loop, name="heat2d-dist-heartbeat", daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2 * self.interval_s)
+            self._thread = None
+
+    # -- monitor ------------------------------------------------------- #
+
+    def ages(self) -> dict:
+        """{peer process -> seconds since its counter last advanced}.
+        A peer that never published ages from this monitor's birth."""
+        if self.world.process_count <= 1:
+            return {}
+        client = self._kv()
+        now = self.clock()
+        out = {}
+        for peer in self.world.peers():
+            rows = client.key_value_dir_get(f"{KV_NS}hb/{peer}/")
+            cur = max((int(k.rsplit("/", 1)[-1]) for k, _ in rows),
+                      default=0)
+            last_n, last_t = self._last.get(peer, (0, self._t0))
+            if cur > last_n:
+                last_n, last_t = cur, now
+                self._last[peer] = (last_n, last_t)
+            age = now - last_t
+            out[peer] = age
+            if self.registry is not None:
+                self.registry.gauge("dist_heartbeat_age_s", age,
+                                    process=str(peer))
+        return out
+
+    def stale(self, max_age_s: float) -> Tuple[int, ...]:
+        return tuple(sorted(p for p, age in self.ages().items()
+                            if age > max_age_s))
+
+    def require_live(self, max_age_s: float,
+                     phase: str = "heartbeat") -> None:
+        dead = self.stale(max_age_s)
+        if dead:
+            raise HostLostError(
+                dead, phase,
+                f"no beacon advance within {max_age_s}s")

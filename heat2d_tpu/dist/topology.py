@@ -1,0 +1,149 @@
+"""Failure-domain unification: one host loss, one coordinated move.
+
+Before this module the platform had two disjoint failure brains: the
+fleet supervisor answers PROCESS death (restart the worker), the mesh
+health monitor answers DEVICE failure (quarantine, shrink, requeue).
+A lost HOST is both at once — its process dies AND every device it
+owned vanishes from the global mesh — and handling the two halves
+independently races: a mesh launch could pick the dead host's devices
+after the supervisor already declared the process gone.
+
+``FailureDomainBridge.on_host_lost`` makes it ONE transaction under
+the existing seq-fence discipline (mesh/health.py, mesh/degrade.py):
+
+1. capture the monitor's event ordinal,
+2. quarantine every device of the lost host (reason ``host_lost`` —
+   now part of the documented ``mesh_quarantine_total{reason}``
+   vocabulary),
+3. run the worker-failover action (resume from the last committed
+   checkpoint on the shrunken world) while the fence already covers
+   the quarantines,
+4. append the transaction row.
+
+Any launch fenced AFTER the transaction sees only survivor devices,
+so the unchanged ``serving_invariant`` proves the combined move the
+same way it proves single-host quarantines — the acceptance check
+the host-kill soak (dist/cli.py --soak --kill-host) runs end to end.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, Dict, Optional, Tuple
+
+from heat2d_tpu.dist.runtime import DistWorld
+
+
+class PodTopology:
+    """host -> global device ordinals. Built from a live ``DistWorld``
+    (hosts are processes) or from an injected map for simulation — the
+    bridge and its tests never care which."""
+
+    def __init__(self, device_host: Dict[int, int]):
+        self.device_host = dict(device_host)
+        if not self.device_host:
+            raise ValueError("topology needs at least one device")
+
+    @classmethod
+    def from_world(cls, world: DistWorld) -> "PodTopology":
+        return cls({g: p for g, p in enumerate(world.device_process)})
+
+    @property
+    def n_devices(self) -> int:
+        return len(self.device_host)
+
+    @property
+    def hosts(self) -> Tuple[int, ...]:
+        return tuple(sorted(set(self.device_host.values())))
+
+    def devices_of(self, host: int) -> Tuple[int, ...]:
+        return tuple(sorted(g for g, h in self.device_host.items()
+                            if h == host))
+
+    def host_of(self, device: int) -> int:
+        return self.device_host[device]
+
+
+def pod_monitor(n_devices: int, *, registry=None,
+                clock: Callable[[], float] = time.monotonic):
+    """A ``HealthMonitor`` whose device space is the POD's ordinals.
+
+    The stock constructor sizes itself from the locally attached
+    device list — correct for the single-host mesh engines, wrong for
+    a bridge convicting devices on OTHER hosts when the backend does
+    not enumerate them globally. The monitor itself is index-based
+    throughout (quarantine/survivors/seq never touch a jax device),
+    so widening the count is safe; only ``probe()`` would — and the
+    bridge never probes a dead host."""
+    from heat2d_tpu.mesh.health import HealthMonitor
+
+    m = HealthMonitor(registry=registry, clock=clock)
+    m.n_devices = int(n_devices)
+    return m
+
+
+class FailureDomainBridge:
+    """The one place a host loss turns into mesh state (module
+    docstring). ``monitor`` is the existing ``mesh.health
+    .HealthMonitor`` — it must span the POD's devices, not one
+    host's, or the bridge would convict devices it cannot name."""
+
+    def __init__(self, topology: PodTopology, monitor, *,
+                 registry=None,
+                 clock: Callable[[], float] = time.monotonic):
+        if monitor.n_devices < topology.n_devices:
+            raise ValueError(
+                f"monitor spans {monitor.n_devices} devices but the "
+                f"pod has {topology.n_devices} — quarantines would "
+                "fall outside the book")
+        self.topology = topology
+        self.monitor = monitor
+        self.registry = registry
+        self.clock = clock
+        #: every coordinated shrink+failover, in order — the run
+        #: record's ``transactions`` block
+        self.transactions: list = []
+
+    def on_host_lost(self, host: int, *,
+                     failover: Optional[Callable[[], dict]] = None
+                     ) -> dict:
+        """The coordinated move: quarantine the host's devices, run
+        the failover action, return the transaction row. Idempotent
+        per device (re-reporting a lost host re-quarantines nothing);
+        the failover still runs — a second report may carry a fresher
+        checkpoint to resume from."""
+        t0 = self.clock()
+        seq_before = self.monitor.seq()
+        devices = self.topology.devices_of(host)
+        convicted = [d for d in devices
+                     if self.monitor.quarantine(d, "host_lost")]
+        # the fence every post-loss launch must carry: it covers the
+        # quarantines above, so serving_invariant can prove no launch
+        # fenced here-or-later ever touched the dead host's devices
+        fence = self.monitor.seq()
+        result = failover() if failover is not None else None
+        row = {
+            "host": int(host),
+            "devices": list(devices),
+            "quarantined": convicted,
+            "seq_before": seq_before,
+            "health_seq": fence,
+            "survivors": list(self.monitor.survivors()),
+            "failover": result,
+            "recovery_s": self.clock() - t0,
+        }
+        self.transactions.append(row)
+        if self.registry is not None:
+            self.registry.counter("dist_host_lost_total")
+            self.registry.observe("dist_host_recovery_s",
+                                  row["recovery_s"])
+        return row
+
+    def snapshot(self) -> dict:
+        """Run-record block: topology + monitor + transactions."""
+        return {
+            "hosts": list(self.topology.hosts),
+            "n_devices": self.topology.n_devices,
+            "monitor": self.monitor.snapshot(),
+            "transactions": [dict(t) for t in self.transactions],
+        }

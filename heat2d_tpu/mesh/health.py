@@ -50,9 +50,10 @@ PROBE_N = 16
 PROBE_DEADLINE_S = 5.0
 
 #: quarantine reasons (the ``mesh_quarantine_total{reason}`` label
-#: vocabulary — docs/SCALING.md)
+#: vocabulary — docs/SCALING.md; ``host_lost`` is the whole-host
+#: failure domain the dist bridge convicts with, docs/DISTRIBUTED.md)
 QUARANTINE_REASONS = ("probe_failure", "device_fail", "mesh_stall",
-                     "silent_corruption")
+                     "silent_corruption", "host_lost")
 
 #: consecutive verified probe passes a quarantined device must string
 #: together before ``HealthMonitor.parole`` re-admits it — one lucky

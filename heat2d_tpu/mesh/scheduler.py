@@ -99,12 +99,18 @@ class MeshScheduler:
     ``serve_signature_requests_total`` in-process). ``halo`` is the
     spatial route's requested halo (default "fused" — the proven
     overlap route; degradation is the plan's job, not the
-    scheduler's)."""
+    scheduler's). ``world`` is an optional ``dist.runtime.DistWorld``:
+    with it, spatial decision rows carry a ``links`` block — the
+    DCN/ICI seam census of the submesh the member would decompose
+    over and the modeled per-step seam seconds, priced with the same
+    link bandwidths depth tuning uses (tune/measure.py) — so launch
+    records show when a spatial split would push halo traffic across
+    hosts."""
 
     def __init__(self, n_devices: Optional[int] = None, registry=None,
                  halo: str = "fused",
                  spatial_bytes_threshold: Optional[int] = None,
-                 demand_source=None):
+                 demand_source=None, world=None):
         from heat2d_tpu.mesh.runner import attached_devices
         from heat2d_tpu.obs.metrics import CounterDeltas
 
@@ -115,6 +121,7 @@ class MeshScheduler:
             _per_chip_vmem_bytes() if spatial_bytes_threshold is None
             else int(spatial_bytes_threshold))
         self.demand_source = demand_source
+        self.world = world
         self._deltas = CounterDeltas()
         self._decisions: dict = {}
         self._lock = AuditedLock("mesh.scheduler")
@@ -199,7 +206,31 @@ class MeshScheduler:
             return dict(out, route="single", reason="unplannable",
                         plan=plan)
         return dict(out, route="spatial", reason="exceeds_chip",
-                    spatial_grid=(gx, gy), plan=plan)
+                    spatial_grid=(gx, gy), plan=plan,
+                    links=self._seam_links(gx, gy, req0.ny))
+
+    def _seam_links(self, gx: int, gy: int, ny: int) -> Optional[dict]:
+        """The spatial row's cross-host seam pricing (class docstring):
+        seam census over the (gx, gy) arrangement of the pod's
+        host-major device order, plus the modeled seconds one step's
+        edge traffic costs on each link class. None without a world
+        (the single-host schedulers lose nothing) or when the submesh
+        does not cover the pod exactly (no arrangement to census)."""
+        if self.world is None:
+            return None
+        from heat2d_tpu.dist.mesh import arrange_pod, seam_profile
+        from heat2d_tpu.tune.measure import link_bytes_per_s
+
+        if gx * gy != self.world.n_devices:
+            return None
+        prof = seam_profile(self.world, arrange_pod(self.world, gx, gy),
+                            ny)
+        ici_bytes = (prof["seam_bytes_per_step"]
+                     - prof["dcn_bytes_per_step"])
+        prof["seam_s_per_step"] = (
+            ici_bytes / link_bytes_per_s("ici")
+            + prof["dcn_bytes_per_step"] / link_bytes_per_s("dcn"))
+        return prof
 
     def decisions(self) -> dict:
         """signature -> decision row (a copy; run-record provenance)."""

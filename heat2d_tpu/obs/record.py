@@ -77,10 +77,17 @@ RECORD_SCHEMA = "heat2d-tpu/run-record/v1"
 #: static-provisioning baseline with the savings fraction, and the
 #: live-migration rows (checkpoint iteration, wire bytes, destination
 #: slot, bitwise-vs-oracle verdict) beside the autoscale_* metric
-#: families — heat2d_tpu/autoscale/, docs/CONTROL.md "Actuation").
+#: families — heat2d_tpu/autoscale/, docs/CONTROL.md "Actuation"),
+#: "dist" (heat2d-tpu-dist: the multihost pod runtime — per-leg rows
+#: from the worker (bring-up world summary + link census, the dist_*
+#: metric totals, the failure-domain bridge snapshot with its
+#: seq-fenced shrink+failover transactions, serving_invariant
+#: verdict) and from the drivers (--selftest bitwise-parity verdict
+#: vs the single-process program, --soak --kill-host recovery
+#: verdict) — heat2d_tpu/dist/, docs/DISTRIBUTED.md).
 RECORD_KINDS = ("run", "ensemble", "bench", "sweep", "serve", "tune",
                 "fleet", "inverse", "multichip", "load", "control",
-                "mesh_chaos", "perf", "autoscale")
+                "mesh_chaos", "perf", "autoscale", "dist")
 
 
 def run_context() -> dict:

@@ -13,6 +13,14 @@ On TPU pods the coordinator/process-id/count triple is normally discovered
 from the environment (TPU metadata), so ``initialize_distributed()`` with
 no arguments is the common path; explicit arguments mirror the mpiexec
 launch line for CPU/GPU-style bring-up.
+
+This module stays the thin, dependency-free floor; the full pod
+runtime GREW OUT of it into ``heat2d_tpu.dist`` (docs/DISTRIBUTED.md):
+``dist/runtime.py`` wraps the same bring-up in a ``DistWorld``
+topology object plus bounded KV barriers/heartbeats that turn a dead
+peer into a named ``HostLostError``, and ``heat2d-tpu-dist`` is the
+mpiexec-style launcher. New code should reach for ``dist``; the
+helpers here remain the shared primitives both layers use.
 """
 
 from __future__ import annotations

@@ -43,6 +43,29 @@ NOISE_FLOOR_S = 0.05
 #: for either to be believed (see two_point_estimate).
 AGREE_FACTOR = 1.5
 
+#: Per-direction link bandwidths by class (DistWorld.link_kind
+#: vocabulary — docs/DISTRIBUTED.md link table). ICI is the
+#: v5e-class order of magnitude the fused-route model always used;
+#: DCN is the per-host share of a pod's data-center fabric — the
+#: ~7x asymmetry is the POINT: a route that hides its edge traffic
+#: under ICI may be bandwidth-bound over DCN, so depth/route tuning
+#: and the scheduler's seam pricing must see which class a seam
+#: crosses.
+LINK_BYTES_PER_S = {"ici": 45e9, "dcn": 6.25e9}
+
+
+def link_bytes_per_s(kind: str) -> float:
+    """Bandwidth of a link CLASS ('local' prices as HBM — on-chip
+    traffic is the kernel's own stream, not a seam)."""
+    if kind == "local":
+        return SimulatedBackend.HBM_BYTES_PER_S
+    try:
+        return LINK_BYTES_PER_S[kind]
+    except KeyError:
+        raise ValueError(
+            f"unknown link kind {kind!r}; expected 'local' or one of "
+            f"{sorted(LINK_BYTES_PER_S)}") from None
+
 
 def two_point_estimate(timed_run, lo, hi0, max_hi,
                        floor=NOISE_FLOOR_S, agree=AGREE_FACTOR):
@@ -466,9 +489,20 @@ class SimulatedBackend:
     #: SHAPE: a fixed per-step edge-traffic term the interior sweep can
     #: hide, a seam-recompute tax growing with T, and a launch term
     #: shrinking with T, so the depth has an interior optimum).
-    ICI_BYTES_PER_S = 45e9
+    ICI_BYTES_PER_S = LINK_BYTES_PER_S["ici"]
     #: ext-row compile envelope per row width (the probed-table analogue)
     EXT_ROWS = {32 * 1024: 64, 16 * 1024: 176, 8 * 1024: 336}
+
+    def __init__(self, link: str = "ici"):
+        """``link`` classifies the seam the fused route's edge
+        traffic crosses (the multihost asymmetry): 'ici' is the
+        historical default — every existing frontier reproduces
+        bit-identically — while 'dcn' prices the same per-step
+        strips at the cross-host bandwidth, so depth tuning SEES
+        that a DCN seam is ~7x harder to hide under the interior
+        sweep and pays off deeper T before the seam tax wins."""
+        self.link = link
+        self.link_bytes_per_s = link_bytes_per_s(link)
 
     def step_time(self, problem: Problem, cand: Candidate) -> float:
         nx, ny, itemsize = problem.nx, problem.ny, problem.itemsize
@@ -490,7 +524,7 @@ class SimulatedBackend:
                 raise SimulatedOOM(
                     f"fused working set {est / 2**20:.1f} MB over the "
                     f"{self.HARD_LIMIT_BYTES / 2**20:.0f} MB core")
-            ici_s = 2 * (nx + ny) * itemsize / self.ICI_BYTES_PER_S
+            ici_s = 2 * (nx + ny) * itemsize / self.link_bytes_per_s
             seam = 6 * t * (nx + ny) / problem.cells
             return (max(compute, ici_s) + compute * seam
                     + self.LAUNCH_S_PER_PROGRAM / t)

@@ -1,0 +1,499 @@
+"""The multihost pod runtime (heat2d_tpu/dist/, docs/DISTRIBUTED.md).
+
+Unit layers run against a fake in-memory KV client and injected
+clocks — bounded barriers, heartbeats, the DCN halo route's bitwise
+parity, and the failure-domain bridge's seq-fenced shrink+failover —
+so the loss arithmetic is deterministic with no processes spawned.
+The REAL 2-process legs at the bottom ride dist/harness's rendezvous
+probe: they need only ``jax.distributed`` + the coordination service
+(which plain CPU builds support), NOT cross-process XLA collectives
+(which this CI backend cannot run — those tests live in
+tests/test_multihost.py and skip with the backend's exact reason).
+"""
+
+import json
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from heat2d_tpu.dist.exchange import (
+    DcnHaloExchanger, run_process_slab, slab_split)
+from heat2d_tpu.dist.mesh import arrange_pod, pod_device_order, seam_profile
+from heat2d_tpu.dist.runtime import (
+    KV_NS, DistWorld, Heartbeat, HostLostError, KVBarrier,
+    elect_recovery_owner)
+from heat2d_tpu.dist.topology import (
+    FailureDomainBridge, PodTopology, pod_monitor)
+from heat2d_tpu.obs.metrics import MetricsRegistry
+
+
+class FakeKV:
+    """The coordination-service KV semantics this jaxlib exhibits
+    (probed: dist/runtime.py module docstring): set raises on
+    overwrite, blocking get times out with DEADLINE_EXCEEDED,
+    dir_get lists (key, value) pairs, delete takes a key or a
+    ``.../`` prefix."""
+
+    def __init__(self):
+        self.store = {}
+        self.lock = threading.Lock()
+
+    def _set(self, key, value):
+        with self.lock:
+            if key in self.store:
+                raise RuntimeError(f"ALREADY_EXISTS: {key}")
+            self.store[key] = value
+
+    key_value_set = _set
+    key_value_set_bytes = _set
+
+    def blocking_key_value_get_bytes(self, key, timeout_ms):
+        deadline = time.monotonic() + timeout_ms / 1000.0
+        while True:
+            with self.lock:
+                if key in self.store:
+                    v = self.store[key]
+                    return v if isinstance(v, bytes) else v.encode()
+            if time.monotonic() >= deadline:
+                raise TimeoutError(f"DEADLINE_EXCEEDED: {key}")
+            time.sleep(0.001)
+
+    def key_value_dir_get(self, prefix):
+        with self.lock:
+            return [(k, v) for k, v in self.store.items()
+                    if k.startswith(prefix)]
+
+    def key_value_delete(self, key):
+        with self.lock:
+            if key.endswith("/"):
+                for k in [k for k in self.store
+                          if k.startswith(key)]:
+                    del self.store[k]
+            else:
+                self.store.pop(key, None)
+
+
+def _world(pid, count, device_process=None, device_slice=None):
+    if device_process is None:
+        device_process = tuple(range(count))
+    return DistWorld(process_index=pid, process_count=count,
+                     device_process=tuple(device_process),
+                     device_slice=device_slice)
+
+
+# ------------------------------------------------------------------ #
+# slabs + the DCN halo route
+# ------------------------------------------------------------------ #
+
+def test_slab_split_partitions_exactly():
+    for nx, p in ((48, 2), (17, 3), (5, 5), (64, 1)):
+        slabs = slab_split(nx, p)
+        assert slabs[0][0] == 0 and slabs[-1][1] == nx
+        for (lo, hi), (lo2, _) in zip(slabs, slabs[1:]):
+            assert hi == lo2 and hi > lo
+    with pytest.raises(ValueError):
+        slab_split(2, 3)
+    with pytest.raises(ValueError):
+        slab_split(8, 0)
+
+
+def test_single_process_slab_is_the_compiled_program():
+    """P=1 run_process_slab == one compiled stencil_step per step
+    (the segment fori_loop changes nothing — the selftest's
+    bitwise_vs_plain_loop anchor)."""
+    import jax
+
+    from heat2d_tpu.ops import inidat, stencil_step
+
+    got, step = run_process_slab(24, 16, 10, depth=4)
+    assert step == 10
+    u = inidat(24, 16)
+    jstep = jax.jit(stencil_step)
+    for _ in range(10):
+        u = jstep(u, 0.1, 0.1)
+    assert got.tobytes() == np.asarray(u, np.float32).tobytes()
+
+
+def test_two_thread_dcn_halo_bitwise_and_bounded_store():
+    """Two in-process 'hosts' over the fake KV: owned slabs
+    concatenate BITWISE to the single-process grid, and every halo
+    key is consumed (the store stays bounded)."""
+    kv = FakeKV()
+    reg = MetricsRegistry()
+    nx, ny, steps, depth = 32, 24, 12, 4
+    out = {}
+
+    def run(pid):
+        ex = DcnHaloExchanger(_world(pid, 2), depth, client=kv,
+                              timeout_s=30, registry=reg)
+        out[pid], _ = run_process_slab(
+            nx, ny, steps, depth=depth, process_index=pid,
+            process_count=2, exchanger=ex)
+
+    ts = [threading.Thread(target=run, args=(p,)) for p in range(2)]
+    [t.start() for t in ts]
+    [t.join(timeout=120) for t in ts]
+    assert set(out) == {0, 1}
+    ref, _ = run_process_slab(nx, ny, steps, depth=depth)
+    got = np.concatenate([out[0], out[1]], axis=0)
+    assert got.tobytes() == ref.tobytes()
+    leaked = [k for k in kv.store if k.startswith(f"{KV_NS}halo/")]
+    assert leaked == []
+    moved = sum(reg.find_counters("dist_halo_bytes_total").values())
+    # 3 exchanges (steps 0,4,8) x 2 processes, each sending one
+    # (depth, ny) f32 strip and receiving one
+    assert moved == 3 * 2 * 2 * depth * ny * 4
+
+
+def test_halo_timeout_names_the_silent_host():
+    """A neighbor that never publishes is a HostLostError naming THAT
+    host and the halo phase — detection names the corpse."""
+    ex = DcnHaloExchanger(_world(0, 2), 2, client=FakeKV(),
+                          timeout_s=0.05)
+    strip = np.zeros((2, 8), np.float32)
+    with pytest.raises(HostLostError) as ei:
+        ex.exchange("s0", strip, strip)
+    assert ei.value.hosts == (1,)
+    assert ei.value.phase == "halo:s0"
+
+
+def test_run_process_slab_guards():
+    with pytest.raises(ValueError, match="exchanger"):
+        run_process_slab(32, 16, 4, process_index=0, process_count=2)
+    with pytest.raises(ValueError, match="halo"):
+        run_process_slab(6, 16, 4, depth=4, process_index=0,
+                         process_count=2,
+                         exchanger=DcnHaloExchanger(
+                             _world(0, 2), 4, client=FakeKV()))
+    with pytest.raises(ValueError, match="shape"):
+        run_process_slab(8, 8, 2, u0=np.zeros((4, 4), np.float32))
+
+
+# ------------------------------------------------------------------ #
+# bounded liveness: barrier + heartbeat
+# ------------------------------------------------------------------ #
+
+def _fake_clock():
+    state = {"t": 0.0}
+
+    def clock():
+        return state["t"]
+
+    def sleep(dt):
+        state["t"] += dt
+
+    return state, clock, sleep
+
+
+def test_kv_barrier_names_missing_peers():
+    state, clock, sleep = _fake_clock()
+    bar = KVBarrier(_world(0, 3), client=FakeKV(), clock=clock,
+                    sleep=sleep)
+    with pytest.raises(HostLostError) as ei:
+        bar.wait("go", timeout_s=5.0)
+    assert ei.value.hosts == (1, 2)
+    assert ei.value.phase == "barrier:go"
+    assert state["t"] >= 5.0
+
+
+def test_kv_barrier_completes_and_gcs_old_rounds():
+    state, clock, sleep = _fake_clock()
+    kv = FakeKV()
+    reg = MetricsRegistry()
+    bar = KVBarrier(_world(0, 2), client=kv, clock=clock, sleep=sleep,
+                    registry=reg)
+    for n in range(3):
+        kv.key_value_set(f"{KV_NS}bar/go/{n}/1", "1")  # peer arrives
+        assert bar.wait("go", timeout_s=5.0) == 0.0
+    # round 0 GC'd once round 2 completed; round 1+ still present
+    assert not any(k.startswith(f"{KV_NS}bar/go/0/") for k in kv.store)
+    assert any(k.startswith(f"{KV_NS}bar/go/2/") for k in kv.store)
+    # single-process worlds never touch the KV store
+    assert KVBarrier(_world(0, 1), client=None).wait("solo") == 0.0
+
+
+def test_heartbeat_ages_by_local_clock_and_convicts_stale():
+    state, clock, _ = _fake_clock()
+    kv = FakeKV()
+    reg = MetricsRegistry()
+    hb = Heartbeat(_world(0, 2), client=kv, clock=clock, registry=reg)
+    kv.key_value_set(f"{KV_NS}hb/1/1", "1")      # peer's first beacon
+    assert hb.ages() == {1: 0.0}
+    state["t"] = 4.0                             # no new beacon
+    assert hb.ages() == {1: 4.0}
+    assert hb.stale(3.0) == (1,)
+    with pytest.raises(HostLostError) as ei:
+        hb.require_live(3.0, phase="soak")
+    assert ei.value.hosts == (1,) and ei.value.phase == "soak"
+    kv.key_value_set(f"{KV_NS}hb/1/2", "1")      # counter advances
+    assert hb.ages() == {1: 0.0}
+    assert hb.stale(3.0) == ()
+    gauges = reg.find_gauges("dist_heartbeat_age_s")
+    assert gauges, "ages() must gauge dist_heartbeat_age_s"
+
+
+def test_heartbeat_beat_gcs_behind_itself():
+    kv = FakeKV()
+    hb = Heartbeat(_world(0, 2), client=kv)
+    for _ in range(5):
+        hb.beat()
+    keys = sorted(k for k in kv.store if k.startswith(f"{KV_NS}hb/0/"))
+    assert keys == [f"{KV_NS}hb/0/4", f"{KV_NS}hb/0/5"]
+
+
+def test_elect_recovery_owner():
+    assert elect_recovery_owner([2, 0, 3]) == 0
+    assert elect_recovery_owner((3, 2)) == 2
+    with pytest.raises(ValueError):
+        elect_recovery_owner([])
+
+
+# ------------------------------------------------------------------ #
+# topology: links, arrangement, seam pricing
+# ------------------------------------------------------------------ #
+
+def test_world_link_kind_by_process_and_slice():
+    w = _world(0, 2, device_process=(0, 0, 1, 1))
+    assert w.link_kind(0, 0) == "local"
+    assert w.link_kind(0, 1) == "ici"
+    assert w.link_kind(1, 2) == "dcn"
+    assert w.link_census() == {"ici": 2, "dcn": 4}
+    assert w.devices_of(1) == (2, 3)
+    assert w.peers() == (1,)
+    # slice identity (TPU pods) overrides process identity
+    ws = _world(0, 2, device_process=(0, 0, 1, 1),
+                device_slice=(0, 0, 0, 0))
+    assert ws.link_kind(1, 2) == "ici"
+    assert ws.link_census() == {"ici": 6, "dcn": 0}
+
+
+def test_arrange_pod_keeps_xy_intra_host():
+    w = _world(0, 2, device_process=(0, 0, 1, 1))
+    assert pod_device_order(w) == [0, 1, 2, 3]
+    rows = arrange_pod(w, 2, 2)
+    assert rows == [[0, 1], [2, 3]]
+    prof = seam_profile(w, rows, ny=64)
+    assert prof["dcn_seams"] == 0 and prof["ici_seams"] == 4
+    assert prof["dcn_bytes_per_step"] == 0
+    assert prof["seam_bytes_per_step"] == 4 * 2 * 64 * 4
+    # the transposed (bad) arrangement pays every seam over DCN
+    bad = seam_profile(w, [[0, 2], [1, 3]], ny=64)
+    assert bad["dcn_seams"] == 4
+    assert bad["dcn_bytes_per_step"] == 4 * 2 * 64 * 4
+    with pytest.raises(ValueError):
+        arrange_pod(w, 3, 2)
+
+
+def test_scheduler_prices_cross_host_seams():
+    from heat2d_tpu.mesh.scheduler import MeshScheduler
+    from heat2d_tpu.tune.measure import link_bytes_per_s
+
+    w = _world(0, 2, device_process=(0, 0, 1, 1))
+    sched = MeshScheduler(n_devices=1, world=w)
+    links = sched._seam_links(2, 2, ny=64)
+    assert links["dcn_seams"] == 0 and links["ici_seams"] == 4
+    assert links["seam_s_per_step"] == pytest.approx(
+        4 * 2 * 64 * 4 / link_bytes_per_s("ici"))
+    # a submesh that does not cover the pod has no arrangement
+    assert sched._seam_links(1, 2, ny=64) is None
+    # and without a world the scheduler prices nothing (unchanged
+    # single-host behavior)
+    assert MeshScheduler(n_devices=1)._seam_links(2, 2, 64) is None
+
+
+def test_measure_link_model_prices_the_asymmetry():
+    from heat2d_tpu.tune.measure import (
+        LINK_BYTES_PER_S, SimulatedBackend, link_bytes_per_s)
+    from heat2d_tpu.tune.space import Candidate, Problem
+
+    assert link_bytes_per_s("ici") == LINK_BYTES_PER_S["ici"]
+    assert link_bytes_per_s("dcn") == LINK_BYTES_PER_S["dcn"]
+    assert link_bytes_per_s("dcn") < link_bytes_per_s("ici")
+    assert (link_bytes_per_s("local")
+            == SimulatedBackend.HBM_BYTES_PER_S)
+    with pytest.raises(ValueError):
+        link_bytes_per_s("carrier_pigeon")
+
+    p, c = Problem(640, 512), Candidate("fused", 0, 8)
+    ici = SimulatedBackend().step_time(p, c)
+    # default link must stay bitwise-identical to explicit 'ici'
+    # (every existing frontier reproduces)
+    assert ici == SimulatedBackend(link="ici").step_time(p, c)
+    # the same edge traffic over DCN is strictly harder to hide
+    assert SimulatedBackend(link="dcn").step_time(p, c) > ici
+
+
+# ------------------------------------------------------------------ #
+# failure domains: one host loss, one transaction
+# ------------------------------------------------------------------ #
+
+def _pod4():
+    topo = PodTopology({0: 0, 1: 0, 2: 1, 3: 1})
+    reg = MetricsRegistry()
+    return topo, pod_monitor(4, registry=reg), reg
+
+
+def test_pod_topology_maps_failure_domains():
+    topo, monitor, _ = _pod4()
+    assert topo.n_devices == 4 and topo.hosts == (0, 1)
+    assert topo.devices_of(1) == (2, 3)
+    assert topo.host_of(0) == 0
+    assert monitor.n_devices == 4    # pod ordinals, not local clamp
+    w = _world(0, 2, device_process=(0, 0, 1, 1))
+    assert PodTopology.from_world(w).devices_of(1) == (2, 3)
+    with pytest.raises(ValueError):
+        PodTopology({})
+
+
+def test_bridge_rejects_a_monitor_too_small_for_the_pod():
+    topo, _, _ = _pod4()
+    with pytest.raises(ValueError, match="outside the book"):
+        FailureDomainBridge(topo, pod_monitor(2))
+
+
+def test_host_loss_is_one_seq_fenced_transaction():
+    """The tentpole's failure-domain contract: quarantines land
+    BEFORE the transaction's fence, the failover runs under it, and
+    the unchanged serving_invariant proves launches on both sides."""
+    from heat2d_tpu.mesh.degrade import serving_invariant
+
+    topo, monitor, reg = _pod4()
+    bridge = FailureDomainBridge(topo, monitor, registry=reg)
+    log = [{"signature": "pre",
+            "mesh": {"devices": [0, 1, 2, 3],
+                     "health_seq": monitor.seq()}}]
+
+    called = {}
+
+    def failover():
+        called["fence"] = monitor.seq()
+        called["survivors"] = monitor.survivors()
+        return {"resumed": True}
+
+    txn = bridge.on_host_lost(1, failover=failover)
+    assert txn["devices"] == [2, 3] and txn["quarantined"] == [2, 3]
+    assert txn["survivors"] == [0, 1]
+    assert txn["failover"] == {"resumed": True}
+    assert txn["health_seq"] > txn["seq_before"]
+    # the failover already saw the post-quarantine fence + survivors
+    assert called == {"fence": txn["health_seq"], "survivors": (0, 1)}
+    assert monitor.quarantined() == (2, 3)
+
+    log.append({"signature": "post",
+                "mesh": {"devices": [0, 1],
+                         "health_seq": txn["health_seq"]}})
+    inv = serving_invariant(monitor, log)
+    assert inv["ok"] and inv["checked"] == 2
+
+    # a launch fenced at the transaction that still names a dead
+    # host's device is exactly what the invariant must catch
+    bad = log + [{"signature": "bad",
+                  "mesh": {"devices": [2],
+                           "health_seq": txn["health_seq"]}}]
+    inv2 = serving_invariant(monitor, bad)
+    assert not inv2["ok"]
+    assert inv2["violations"][0]["device"] == 2
+    assert inv2["violations"][0]["event"]["reason"] == "host_lost"
+
+    assert sum(reg.find_counters("dist_host_lost_total").values()) == 1
+    snap = bridge.snapshot()
+    assert snap["transactions"] == [txn]
+    # re-reporting re-quarantines nothing (idempotent per device)
+    assert bridge.on_host_lost(1)["quarantined"] == []
+
+
+def test_host_lost_is_a_documented_quarantine_reason():
+    from heat2d_tpu.mesh.health import QUARANTINE_REASONS
+    assert "host_lost" in QUARANTINE_REASONS
+
+
+def test_dist_is_a_record_kind():
+    from heat2d_tpu.obs.record import RECORD_KINDS, build_record
+    assert "dist" in RECORD_KINDS
+    rec = build_record("dist", extra={"leg": "selftest"})
+    assert rec["kind"] == "dist" and rec["leg"] == "selftest"
+
+
+# ------------------------------------------------------------------ #
+# harness + real 2-process legs (rendezvous only — no collectives)
+# ------------------------------------------------------------------ #
+
+def test_harness_helpers():
+    from heat2d_tpu.dist.harness import clean_env, first_error_line, free_port
+
+    assert 0 < free_port() < 65536
+    env = clean_env({"EXTRA": "1"})
+    assert env["EXTRA"] == "1"
+    assert "JAX_PLATFORMS" not in clean_env()
+    line = first_error_line(["all fine", "x\nValueError: boom\ny"])
+    assert line == "ValueError: boom"
+    assert first_error_line(["nothing here"]) is None
+
+
+def _require_rendezvous():
+    from heat2d_tpu.dist.harness import rendezvous_unsupported_reason
+    reason = rendezvous_unsupported_reason()
+    if reason is not None:
+        pytest.skip(f"2-process rendezvous unavailable: {reason}")
+
+
+def test_real_two_process_worker_bitwise(tmp_path):
+    """REAL 2-process world end to end through the worker CLI: the
+    gathered final grid is bitwise the single-process program's (the
+    tentpole's correctness anchor), and the kind='dist' record
+    carries serving_invariant ok with the dist_* metric totals."""
+    from heat2d_tpu.dist.harness import clean_env, spawn_world
+
+    _require_rendezvous()
+    nx, ny, steps, seg = 32, 24, 12, 4
+    out = tmp_path / "dist_final.bin"
+    rec_path = tmp_path / "rec.json"
+    results = spawn_world(
+        2, lambda i, coord: [
+            sys.executable, "-m", "heat2d_tpu.dist.cli",
+            "--coordinator", coord,
+            "--num-processes", "2", "--process-id", str(i),
+            "--nx", str(nx), "--ny", str(ny), "--steps", str(steps),
+            "--segment", str(seg), "--heartbeat", "0.5",
+            "--out", str(out), "--run-record", str(rec_path)],
+        env=clean_env({"JAX_PLATFORMS": "cpu"}), timeout=300)
+    assert all(r.ok for r in results), [r.output for r in results]
+
+    got = np.fromfile(out, np.float32).reshape(nx, ny)
+    ref, _ = run_process_slab(nx, ny, steps, depth=seg)
+    assert got.tobytes() == ref.tobytes()
+
+    rec = json.loads(rec_path.read_text())
+    assert rec["kind"] == "dist" and rec["leg"] == "run"
+    assert rec["serving_invariant"]["ok"]
+    assert rec["world"]["process_count"] == 2
+    assert rec["metrics"]["dist_halo_bytes_total"] > 0
+
+
+@pytest.mark.slow
+def test_real_soak_kill_host(tmp_path):
+    """The acceptance-criteria soak: SIGKILL one host mid-run, the
+    survivor recovers through the unified shrink+failover, bitwise
+    parity + serving_invariant ok (CI's dist-gate runs this leg
+    directly; here it is the slow-tier pytest wrapper)."""
+    from heat2d_tpu.dist.harness import REPO, clean_env
+
+    _require_rendezvous()
+    rec_path = tmp_path / "soak.json"
+    rc = subprocess.run(
+        [sys.executable, "-m", "heat2d_tpu.dist.cli", "--soak",
+         "--kill-host", "--nx", "48", "--ny", "32", "--steps", "32",
+         "--segment", "4", "--checkpoint-every", "8",
+         "--pace", "0.4", "--outdir", str(tmp_path),
+         "--run-record", str(rec_path)],
+        cwd=REPO, env=clean_env({"JAX_PLATFORMS": "cpu"}),
+        capture_output=True, text=True, timeout=540)
+    assert rc.returncode == 0, rc.stdout + rc.stderr
+    rec = json.loads(rec_path.read_text())
+    assert rec["leg"] == "soak_kill_host" and rec["verdict_ok"]
+    assert rec["worker_record"]["leg"] == "host_loss_recovery"
+    assert rec["worker_record"]["serving_invariant"]["ok"]

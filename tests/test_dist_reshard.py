@@ -1,0 +1,95 @@
+"""N-process-save → M-process-restore: the reshard contract.
+
+A collective checkpoint commits the FULL grid through the same
+crash-consistent single-file path as every other checkpoint
+(io/binary.py), so the saving and restoring process counts are
+independent — each restoring process loads the full grid and slices
+its own slab (dist/exchange.run_process_slab's ``u0`` contract).
+These tests pin it BITWISE both ways (2-save → 1-restore and
+1-save → 2-restore) against an uninterrupted single-process run,
+with real processes; they need rendezvous + the coordination-service
+KV store only, so they run on plain CPU builds where cross-process
+XLA collectives are unavailable.
+"""
+
+import sys
+
+import numpy as np
+import pytest
+
+from heat2d_tpu.dist.exchange import run_process_slab
+from heat2d_tpu.dist.harness import (
+    clean_env, rendezvous_unsupported_reason, spawn_world)
+from heat2d_tpu.io import load_checkpoint
+
+NX, NY, SEG = 32, 24, 4
+HALF, FULL = 8, 16
+
+
+@pytest.fixture(autouse=True)
+def _require_rendezvous():
+    reason = rendezvous_unsupported_reason()
+    if reason is not None:
+        pytest.skip(f"2-process rendezvous unavailable: {reason}")
+
+
+def _worker_argv(extra):
+    def argv_fn(i, coord):
+        return [sys.executable, "-m", "heat2d_tpu.dist.cli",
+                "--coordinator", coord,
+                "--num-processes", "2", "--process-id", str(i),
+                "--nx", str(NX), "--ny", str(NY),
+                "--segment", str(SEG)] + extra
+    return argv_fn
+
+
+def _spawn2(extra):
+    results = spawn_world(
+        2, _worker_argv(extra),
+        env=clean_env({"JAX_PLATFORMS": "cpu"}), timeout=300)
+    assert all(r.ok for r in results), [r.output for r in results]
+
+
+def _run1(extra):
+    results = spawn_world(
+        1, lambda i, coord: [
+            sys.executable, "-m", "heat2d_tpu.dist.cli",
+            "--num-processes", "1",
+            "--nx", str(NX), "--ny", str(NY),
+            "--segment", str(SEG)] + extra,
+        env=clean_env({"JAX_PLATFORMS": "cpu"}), timeout=300)
+    assert all(r.ok for r in results), [r.output for r in results]
+
+
+def _reference():
+    ref, _ = run_process_slab(NX, NY, FULL, depth=SEG)
+    return np.asarray(ref, np.float32)
+
+
+def test_two_process_save_one_process_restore(tmp_path):
+    ck = tmp_path / "ck.bin"
+    out = tmp_path / "final.bin"
+    _spawn2(["--steps", str(HALF),
+             "--checkpoint", str(ck), "--checkpoint-every", str(SEG)])
+    grid, step, cfg = load_checkpoint(str(ck))
+    assert step == HALF and grid.shape == (NX, NY)
+    assert cfg["processes"] == 2
+
+    _run1(["--steps", str(FULL), "--resume", str(ck),
+           "--out", str(out)])
+    got = np.fromfile(out, np.float32).reshape(NX, NY)
+    assert got.tobytes() == _reference().tobytes()
+
+
+def test_one_process_save_two_process_restore(tmp_path):
+    ck = tmp_path / "ck.bin"
+    out = tmp_path / "final.bin"
+    _run1(["--steps", str(HALF),
+           "--checkpoint", str(ck), "--checkpoint-every", str(SEG)])
+    grid, step, cfg = load_checkpoint(str(ck))
+    assert step == HALF and cfg["processes"] == 1
+
+    _spawn2(["--steps", str(FULL), "--resume", str(ck),
+             "--out", str(out)])
+    got = np.fromfile(out, np.float32).reshape(NX, NY)
+    assert got.tobytes() == _reference().tobytes()

@@ -8,105 +8,59 @@ processes and the dist2d shard_map program runs with genuinely
 non-addressable remote shards — covering the cross-host gather, the
 rank-0 output discipline, and coordinator bring-up that single-process
 tests cannot reach.
+
+Spawn/rendezvous/collect plumbing and the once-per-session capability
+probe live in ``heat2d_tpu.dist.harness`` (this file's original probe,
+promoted to a library the ``heat2d-tpu-dist`` driver legs share).
+These tests need cross-process XLA COLLECTIVES — the stronger of the
+two probed capabilities — so builds whose backend cannot host them
+skip with the exact backend error line; the rendezvous-only dist/
+tests (tests/test_dist.py) keep running there.
 """
 
-import os
-import re
 import subprocess
-import socket
 import sys
-import tempfile
 
 import numpy as np
 import pytest
 
-REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-
-
-def _free_port():
-    with socket.socket() as s:
-        s.bind(("localhost", 0))
-        return s.getsockname()[1]
-
-
-_probe = {"done": False, "reason": None}
-
-
-def _two_process_unsupported_reason():
-    """Probe ONCE whether this harness can actually run a 2-process
-    jax.distributed computation: a minimal cross-process dist2d step
-    (2 processes x 1 virtual device, (2,1) mesh). Some jax builds
-    cannot — e.g. ``XlaRuntimeError: Multiprocess computations aren't
-    implemented on the CPU backend`` — and there the module must SKIP
-    with that reason, not fail red (the tests are correct; the harness
-    cannot host them)."""
-    if _probe["done"]:
-        return _probe["reason"]
-    _probe["done"] = True
-    port = _free_port()
-    env = {k: v for k, v in os.environ.items()
-           if k not in ("JAX_PLATFORMS", "XLA_FLAGS")}
-    with tempfile.TemporaryDirectory() as td:
-        procs = [subprocess.Popen(
-            [sys.executable, "-m", "heat2d_tpu.cli", "--mode", "dist2d",
-             "--gridx", "2", "--gridy", "1",
-             "--nxprob", "8", "--nyprob", "8", "--steps", "1",
-             "--platform", "cpu", "--host-device-count", "1",
-             "--coordinator", f"localhost:{port}",
-             "--num-processes", "2", "--process-id", str(i),
-             "--dat-layout", "none", "--outdir", td],
-            cwd=REPO, env=env,
-            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
-            for i in range(2)]
-        try:
-            outs = [p.communicate(timeout=180)[0] for p in procs]
-        except subprocess.TimeoutExpired:
-            for p in procs:
-                p.kill()
-            _probe["reason"] = "2-process probe timed out after 180s"
-            return _probe["reason"]
-    if all(p.returncode == 0 for p in procs):
-        return None
-    # Surface the distinguishing error line in the skip reason.
-    for out in outs:
-        m = re.search(r"^.*(?:Error|error):.*$", out, re.MULTILINE)
-        if m:
-            _probe["reason"] = m.group(0).strip()[:200]
-            return _probe["reason"]
-    _probe["reason"] = (
-        f"probe exited {[p.returncode for p in procs]} with no "
-        f"recognizable error line")
-    return _probe["reason"]
+from heat2d_tpu.dist.harness import (
+    REPO, clean_env, collectives_unsupported_reason, spawn_world)
 
 
 @pytest.fixture(autouse=True)
 def _require_two_process_harness():
-    """Every test here spawns a REAL 2-process jax.distributed run;
-    skip-with-reason (not fail) when the environment can't host one —
-    tier-1 stays green-or-skipped instead of silently red."""
-    reason = _two_process_unsupported_reason()
+    """Every test here spawns a REAL 2-process jax.distributed
+    computation; skip-with-reason (not fail) when the environment
+    can't host one — tier-1 stays green-or-skipped instead of
+    silently red."""
+    reason = collectives_unsupported_reason()
     if reason is not None:
         pytest.skip(f"2-process harness unavailable: {reason}")
 
 
+def _launch_dist2d(outdir, extra, *, env=None, steps=10,
+                   gridx=2, gridy=2, host_devices=2, timeout=220):
+    """One 2-process dist2d world through the shared harness; returns
+    the merged per-process outputs (asserting both ranks exited 0)."""
+    results = spawn_world(
+        2, lambda i, coord: [
+            sys.executable, "-m", "heat2d_tpu.cli", "--mode", "dist2d",
+            "--gridx", str(gridx), "--gridy", str(gridy),
+            "--nxprob", "16", "--nyprob", "16", "--steps", str(steps),
+            "--platform", "cpu",
+            "--host-device-count", str(host_devices),
+            "--coordinator", coord,
+            "--num-processes", "2", "--process-id", str(i),
+            "--outdir", str(outdir)] + extra(i),
+        env=env, timeout=timeout)
+    outs = [r.output for r in results]
+    assert all(r.ok for r in results), outs
+    return outs
+
+
 def test_two_process_dist2d_matches_serial(tmp_path, oracle):
-    port = _free_port()
-    env = {k: v for k, v in os.environ.items()
-           if k not in ("JAX_PLATFORMS", "XLA_FLAGS")}
-    procs = []
-    for i in range(2):
-        procs.append(subprocess.Popen(
-            [sys.executable, "-m", "heat2d_tpu.cli", "--mode", "dist2d",
-             "--gridx", "2", "--gridy", "2",
-             "--nxprob", "16", "--nyprob", "16", "--steps", "10",
-             "--platform", "cpu", "--host-device-count", "2",
-             "--coordinator", f"localhost:{port}",
-             "--num-processes", "2", "--process-id", str(i),
-             "--outdir", str(tmp_path)],
-            cwd=REPO, env=env,
-            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True))
-    outs = [p.communicate(timeout=220)[0] for p in procs]
-    assert all(p.returncode == 0 for p in procs), outs
+    outs = _launch_dist2d(tmp_path, lambda i: [])
 
     # Rank-0 output discipline: exactly one process printed the banner.
     banners = sum("Problem size:16x16" in o for o in outs)
@@ -126,29 +80,14 @@ def test_two_process_periodic_checkpoint_device_resident(tmp_path):
     gather), restart points ride the collective per-shard path, and the
     final per-shard binary must be byte-identical to an unsegmented
     2-process run of the same problem."""
-    env = {k: v for k, v in os.environ.items()
-           if k not in ("JAX_PLATFORMS", "XLA_FLAGS")}
-    env["HEAT2D_FORBID_GATHER"] = "1"
+    env = clean_env({"HEAT2D_FORBID_GATHER": "1"})
 
     def launch(outdir, extra):
-        port = _free_port()
-        procs = []
-        for i in range(2):
-            procs.append(subprocess.Popen(
-                [sys.executable, "-m", "heat2d_tpu.cli", "--mode",
-                 "dist2d", "--gridx", "2", "--gridy", "2",
-                 "--nxprob", "16", "--nyprob", "16", "--steps", "10",
-                 "--platform", "cpu", "--host-device-count", "2",
-                 "--coordinator", f"localhost:{port}",
-                 "--num-processes", "2", "--process-id", str(i),
-                 "--binary-dumps", "--dat-layout", "none",
-                 "--run-record", str(outdir / f"rec{i}.json"),
-                 "--outdir", str(outdir)] + extra,
-                cwd=REPO, env=env,
-                stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
-                text=True))
-        outs = [p.communicate(timeout=220)[0] for p in procs]
-        assert all(p.returncode == 0 for p in procs), outs
+        _launch_dist2d(
+            outdir, lambda i: [
+                "--binary-dumps", "--dat-layout", "none",
+                "--run-record", str(outdir / f"rec{i}.json")] + extra,
+            env=env)
 
     seg = tmp_path / "seg"
     ref = tmp_path / "ref"
@@ -202,32 +141,16 @@ def test_two_process_convergence_with_periodic_checkpoint(tmp_path):
     s_mid = (res[8] * res[12]) ** 0.5    # first check below: step 12
     s_bnd = (res[4] * res[8]) ** 0.5     # first check below: step 8
 
-    env = {k: v for k, v in os.environ.items()
-           if k not in ("JAX_PLATFORMS", "XLA_FLAGS")}
-    env["HEAT2D_FORBID_GATHER"] = "1"
+    env = clean_env({"HEAT2D_FORBID_GATHER": "1"})
 
     def launch(outdir, sens, extra):
-        port = _free_port()
-        procs = []
-        for i in range(2):
-            procs.append(subprocess.Popen(
-                [sys.executable, "-m", "heat2d_tpu.cli", "--mode",
-                 "dist2d", "--gridx", "2", "--gridy", "2",
-                 "--nxprob", str(nx), "--nyprob", str(ny),
-                 "--steps", "200", "--convergence",
-                 "--interval", str(interval),
-                 "--sensitivity", repr(sens),
-                 "--platform", "cpu", "--host-device-count", "2",
-                 "--coordinator", f"localhost:{port}",
-                 "--num-processes", "2", "--process-id", str(i),
-                 "--binary-dumps", "--dat-layout", "none",
-                 "--run-record", str(outdir / f"rec{i}.json"),
-                 "--outdir", str(outdir)] + extra,
-                cwd=REPO, env=env,
-                stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
-                text=True))
-        outs = [p.communicate(timeout=220)[0] for p in procs]
-        assert all(p.returncode == 0 for p in procs), outs
+        _launch_dist2d(
+            outdir, lambda i: [
+                "--convergence", "--interval", str(interval),
+                "--sensitivity", repr(sens),
+                "--binary-dumps", "--dat-layout", "none",
+                "--run-record", str(outdir / f"rec{i}.json")] + extra,
+            env=env, steps=200)
         rec = json.loads((outdir / "rec0.json").read_text())
         return rec["steps_done"]
 
@@ -266,25 +189,12 @@ def test_two_process_parallel_binary_write(tmp_path):
     writes its shards into the one file; result must be byte-identical to
     a serial run's dump, with text conversion fed by rank-0 read-back
     (no cross-host allgather in the --dat-layout none path at all)."""
-    port = _free_port()
-    env = {k: v for k, v in os.environ.items()
-           if k not in ("JAX_PLATFORMS", "XLA_FLAGS")}
-    procs = []
-    for i in range(2):
-        procs.append(subprocess.Popen(
-            [sys.executable, "-m", "heat2d_tpu.cli", "--mode", "dist2d",
-             "--gridx", "2", "--gridy", "2",
-             "--nxprob", "16", "--nyprob", "16", "--steps", "10",
-             "--platform", "cpu", "--host-device-count", "2",
-             "--coordinator", f"localhost:{port}",
-             "--num-processes", "2", "--process-id", str(i),
-             "--binary-dumps", "--dat-layout", "none",
-             "--checkpoint", str(tmp_path / "ck.bin"),
-             "--outdir", str(tmp_path)],
-            cwd=REPO, env=env,
-            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True))
-    outs = [p.communicate(timeout=220)[0] for p in procs]
-    assert all(p.returncode == 0 for p in procs), outs
+    env = clean_env()
+    _launch_dist2d(
+        tmp_path, lambda i: [
+            "--binary-dumps", "--dat-layout", "none",
+            "--checkpoint", str(tmp_path / "ck.bin")],
+        env=env)
 
     # Serial single-process run for the byte-identical reference files.
     sdir = tmp_path / "serial"
@@ -317,31 +227,14 @@ def test_two_process_managed_resume_parity(tmp_path):
     the global grid on one host."""
     import json
 
-    env = {k: v for k, v in os.environ.items()
-           if k not in ("JAX_PLATFORMS", "XLA_FLAGS")}
-    env["HEAT2D_FORBID_GATHER"] = "1"
+    env = clean_env({"HEAT2D_FORBID_GATHER": "1"})
 
     def launch(outdir, steps, extra):
-        port = _free_port()
-        procs = []
-        for i in range(2):
-            procs.append(subprocess.Popen(
-                [sys.executable, "-m", "heat2d_tpu.cli", "--mode",
-                 "dist2d", "--gridx", "2", "--gridy", "2",
-                 "--nxprob", "16", "--nyprob", "16",
-                 "--steps", str(steps),
-                 "--platform", "cpu", "--host-device-count", "2",
-                 "--coordinator", f"localhost:{port}",
-                 "--num-processes", "2", "--process-id", str(i),
-                 "--binary-dumps", "--dat-layout", "none",
-                 "--run-record", str(outdir / f"rec{i}.json"),
-                 "--outdir", str(outdir)] + extra,
-                cwd=REPO, env=env,
-                stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
-                text=True))
-        outs = [p.communicate(timeout=220)[0] for p in procs]
-        assert all(p.returncode == 0 for p in procs), outs
-        return outs
+        return _launch_dist2d(
+            outdir, lambda i: [
+                "--binary-dumps", "--dat-layout", "none",
+                "--run-record", str(outdir / f"rec{i}.json")] + extra,
+            env=env, steps=steps)
 
     ref = tmp_path / "ref"
     first = tmp_path / "first"
@@ -370,24 +263,11 @@ def test_two_process_spatial_ensemble(tmp_path):
     y=1) mesh spanning 2 processes x 2 devices — members ride the batch
     axis while each decomposes spatially; final member dumps must match
     single-process runs of the same members byte-for-byte."""
-    port = _free_port()
-    env = {k: v for k, v in os.environ.items()
-           if k not in ("JAX_PLATFORMS", "XLA_FLAGS")}
-    procs = []
-    for i in range(2):
-        procs.append(subprocess.Popen(
-            [sys.executable, "-m", "heat2d_tpu.cli", "--mode", "dist2d",
-             "--gridx", "2", "--gridy", "1",
-             "--nxprob", "16", "--nyprob", "16", "--steps", "10",
-             "--ensemble-cx", "0.1,0.2", "--ensemble-cy", "0.1,0.1",
-             "--platform", "cpu", "--host-device-count", "2",
-             "--coordinator", f"localhost:{port}",
-             "--num-processes", "2", "--process-id", str(i),
-             "--outdir", str(tmp_path)],
-            cwd=REPO, env=env,
-            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True))
-    outs = [p.communicate(timeout=220)[0] for p in procs]
-    assert all(p.returncode == 0 for p in procs), outs
+    env = clean_env()
+    outs = _launch_dist2d(
+        tmp_path, lambda i: ["--ensemble-cx", "0.1,0.2",
+                             "--ensemble-cy", "0.1,0.1"],
+        env=env, gridx=2, gridy=1)
     assert sum("spatial submesh" in o for o in outs) == 1, outs
 
     sdir = tmp_path / "single"

@@ -553,6 +553,28 @@ def main() -> int:
           f"{s['adi_wall_speedup']:.1f}x at matched accuracy "
           f"({s['adi_steps_ratio']:.0f}x fewer steps)")
 
+    # Multihost pod leg (docs/DISTRIBUTED.md): only means something
+    # under a real multi-process launch (one process per host). When
+    # it runs, prove the pod world assembled — full topology, an ICI
+    # census inside each host — and that the global ('batch','xy')
+    # mesh actually builds over every device in the pod.
+    if jax.process_count() > 1:
+        from heat2d_tpu.dist.mesh import pod_mesh
+        from heat2d_tpu.dist.runtime import DistWorld
+
+        world = DistWorld.from_env()
+        assert world.process_count == jax.process_count(), world
+        census = world.link_census()
+        assert census.get("ici", 0) > 0, census
+        mesh = pod_mesh(world, batch=world.process_count,
+                        xy=world.n_devices // world.process_count)
+        assert mesh.devices.size == world.n_devices, mesh
+        print(f"PASS pod world: {world.summary()} "
+              f"links={census} mesh={dict(mesh.shape)}")
+    else:
+        print("SKIP pod leg: single-process launch "
+              "(run one process per host to exercise it)")
+
     print("ALL TPU SMOKE PATHS PASS")
     return 0
 
